@@ -1,0 +1,210 @@
+"""AMP (reference: ``python/paddle/amp/{auto_cast,grad_scaler}.py``).
+
+On TPU the native fast dtype is bfloat16 (MXU); ``auto_cast`` implements the
+reference's O1 (white/black-list per-op casting, hooked into the op dispatch
+layer) and O2 (pure low-precision with fp32 master weights in the optimizer)
+levels. ``GradScaler`` exists for float16 compatibility — with bf16 (the TPU
+default) it degenerates to a no-op passthrough, matching how the reference
+treats ``use_loss_scaling=False``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+# Ops that benefit from low precision (MXU ops) — reference's white list
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "einsum", "conv2d", "conv1d", "conv3d",
+    "conv2d_transpose", "linear", "addmm",
+}
+# Numerically sensitive ops stay fp32 — reference's black list
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "_cross_entropy_impl", "layer_norm",
+    "rms_norm", "batch_norm", "_batch_norm_train", "_batch_norm_eval",
+    "group_norm", "mean", "sum", "norm", "cumsum", "erf", "erfinv", "pow",
+    "rsqrt", "sqrt", "square", "std", "var", "nll_loss", "mse_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "kl_div",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def amp_state():
+    return _STATE
+
+
+def amp_cast_inputs(op_name, vals):
+    """Called from the op dispatch layer for each op application."""
+    st = _STATE
+    if not st.enabled:
+        return vals
+    white = (op_name in WHITE_LIST or op_name in st.custom_white)
+    black = (op_name in BLACK_LIST or op_name in st.custom_black)
+    if black:
+        target = jnp.float32
+    elif white or st.level == "O2":
+        target = st.dtype
+    else:
+        return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and hasattr(v, "astype") and \
+                jnp.issubdtype(jnp.result_type(v), jnp.floating) and \
+                v.dtype != target and v.dtype != jnp.float64:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = _STATE
+    prev = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+    st.enabled = bool(enable)
+    st.dtype = dtype_mod.to_jax_dtype(dtype)
+    st.level = level
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (optimizers keep fp32
+    master weights automatically — see optimizer slots)."""
+    d = dtype_mod.to_jax_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for _, p in m.named_parameters():
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    p._rebind(p.value.astype(d))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling for fp16 (reference GradScaler semantics).
+
+    With ``enable=False`` (or bf16 training) every method is a passthrough.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return Tensor(loss.value * self._scale,
+                      stop_gradient=loss.stop_gradient) if loss.stop_gradient \
+            else loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad.value.astype(jnp.float32) * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """Unscale + conditional optimizer step. Does NOT update the scale —
+        call ``update()`` after (paddle/torch contract)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+        optimizer.clear_grad()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            self._found_inf = False
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict

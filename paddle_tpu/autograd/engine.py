@@ -1,0 +1,206 @@
+"""Eager autograd engine.
+
+The reference implements eager autograd as generated C++ GradNodes plus a
+topological ``Backward()`` walk (``paddle/fluid/eager/backward.cc``,
+``grad_node_info.h``). A TPU-native framework does not need per-op handwritten
+VJPs: every op in :mod:`paddle_tpu.ops` is a pure jnp function, so the eager
+tape records the ``jax.vjp`` of each op application and ``backward()`` walks
+the recorded graph in reverse topological order.
+
+This eager path is the debuggability path. The performance path is
+:mod:`paddle_tpu.jit`, where the whole train step (forward + backward +
+optimizer) is traced once with ``jax.value_and_grad`` and compiled by XLA —
+the tape is bypassed entirely there (ops check :func:`is_grad_enabled`).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_STATE = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _STATE.enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Disable gradient tape recording (usable as context manager or decorator)."""
+
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op application: holds the vjp closure and input edges."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_structs", "out_treedef", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_structs, out_treedef, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list of Tensors (the differentiable inputs)
+        self.out_structs = out_structs  # list of jax.ShapeDtypeStruct per flat output
+        self.out_treedef = out_treedef
+        self.name = name
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def _topo_order(root_nodes) -> List[GradNode]:
+    """Reverse-topological order (outputs first) over the node graph."""
+    order: List[GradNode] = []
+    visited = set()
+    # Iterative DFS with explicit stack to avoid recursion limits on deep graphs.
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None and id(prod) not in visited:
+                stack.append((prod, False))
+    order.reverse()  # outputs-first
+    return order
+
+
+def backward(tensors: Sequence[Any], grad_tensors: Optional[Sequence[Any]] = None,
+             retain_graph: bool = False, capture: Optional[dict] = None):
+    """Run reverse-mode accumulation from ``tensors`` into leaf ``.grad``.
+
+    Matches the reference contract: scalar roots get an implicit ones
+    cotangent; leaf tensors with ``stop_gradient=False`` accumulate into
+    ``.grad``; the graph is freed unless ``retain_graph``. ``capture`` is a
+    dict keyed by ``id(tensor)`` — cotangents flowing into those tensors
+    (leaf or intermediate) are also summed there (used by :func:`grad`).
+    """
+    from ..core.tensor import Tensor  # local import to avoid cycle
+
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    def _capture(t, g):
+        if capture is not None and id(t) in capture:
+            prev = capture[id(t)]
+            capture[id(t)] = g if prev is None else prev + g
+
+    # cotangent accumulator keyed by (id(node), out_index)
+    pending = {}
+    leaf_accum = []  # (tensor, grad) pairs applied at the end
+
+    root_nodes = []
+    for t, g in zip(roots, grad_tensors):
+        if g is None:
+            gval = jnp.ones_like(t.value)
+        else:
+            gval = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        _capture(t, gval)
+        if node is None:
+            if not t.stop_gradient:
+                leaf_accum.append((t, gval))
+            continue
+        key = (id(node), t._out_index)
+        pending[key] = pending.get(key, 0) + gval
+        root_nodes.append(node)
+
+    for node in _topo_order(root_nodes):
+        cots = []
+        any_set = False
+        for i, struct in enumerate(node.out_structs):
+            c = pending.pop((id(node), i), None)
+            if c is None:
+                if jnp.issubdtype(struct.dtype, jnp.inexact):
+                    c = jnp.zeros(struct.shape, struct.dtype)
+                else:
+                    # integer outputs take float0 cotangents in jax's vjp
+                    import numpy as _np
+                    c = _np.zeros(struct.shape, jax.dtypes.float0)
+            else:
+                any_set = True
+            cots.append(c)
+        if not any_set or node.vjp_fn is None:
+            continue
+        cot_tree = jax.tree.unflatten(node.out_treedef, cots)
+        in_cots = node.vjp_fn(cot_tree)
+        for t, g in zip(node.inputs, in_cots):
+            _capture(t, g)
+            prod = t._grad_node
+            if prod is not None:
+                key = (id(prod), t._out_index)
+                struct = prod.out_structs[t._out_index]
+                if hasattr(g, "astype") and g.dtype != struct.dtype:
+                    g = g.astype(struct.dtype)  # AMP: cast cotangent to match
+                prev = pending.get(key)
+                pending[key] = g if prev is None else prev + g
+            elif not t.stop_gradient:
+                leaf_accum.append((t, g))
+        if not retain_graph:
+            node.vjp_fn = None
+
+    if capture is None:  # grad() mode must not pollute .grad fields
+        for t, g in leaf_accum:
+            t._accumulate_grad(g)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """paddle.grad: return grads of ``outputs`` w.r.t. ``inputs`` without
+    touching ``.grad`` fields. Implemented by a scoped backward pass."""
+    from ..core.tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported; "
+            "use paddle_tpu.jit / jax transforms for higher-order derivatives")
+
+    capture = {id(t): None for t in inputs}
+    backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
+    results = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(t.value)
+        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+    return results

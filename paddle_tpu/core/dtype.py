@@ -1,0 +1,109 @@
+"""Dtype system.
+
+Paddle-shaped dtype surface (``paddle.float32`` etc., see reference
+``paddle/phi/common/data_type.h`` / ``python/paddle/framework/dtype.py``) mapped
+directly onto jnp dtypes — on TPU the native matmul dtype is bfloat16 and XLA
+owns all layout decisions, so dtypes are plain numpy/jnp dtypes with string
+aliases rather than a custom enum.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.dtype(float32)]
+
+
+def to_jax_dtype(dtype):
+    """Normalize a user-provided dtype (string alias / np / jnp dtype) to np.dtype.
+
+    Canonicalized for the active x64 mode: with x64 disabled (the TPU default —
+    int32 indices keep gathers on-chip fast), int64/float64 requests map to
+    their 32-bit counterparts, mirroring jax's own canonicalization.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_ALIASES:
+            raise ValueError(f"Unknown dtype alias: {dtype!r}")
+        dtype = _STR_ALIASES[key]
+    import jax.dtypes
+    return jnp.dtype(jax.dtypes.canonicalize_dtype(jnp.dtype(dtype)))
+
+
+def long_dtype():
+    """Default integer dtype for indices (int64 canonicalized per x64 mode)."""
+    return to_jax_dtype(int64)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype (paddle's ``str(tensor.dtype)`` shape)."""
+    return jnp.dtype(dtype).name
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(dtype):
+    d = to_jax_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError("default dtype must be floating point")
+    _DEFAULT_DTYPE[0] = d
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = jnp.dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer) or d == jnp.bool_
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)
+
+
+__all__ = [
+    "bool_", "uint8", "int8", "int16", "int32", "int64", "float16", "bfloat16",
+    "float32", "float64", "complex64", "complex128", "to_jax_dtype", "dtype_name",
+    "get_default_dtype", "set_default_dtype", "is_floating_point", "is_integer",
+    "promote_types",
+]

@@ -1,0 +1,146 @@
+"""RNG management.
+
+The reference keeps global + per-device CUDA generator state and a model-parallel
+RNG tracker (``python/paddle/distributed/fleet/layers/mpu/random.py``). On TPU,
+randomness is functional: ``jax.random`` keys. This module bridges the two
+worlds:
+
+- Eager mode: a process-global stateful generator; every random op consumes a
+  fresh split of the global key (``seed()`` resets it).
+- Traced/jit mode: a :class:`rng_scope` binds an explicit key for the duration
+  of a step function; ops draw deterministic ``fold_in`` children keyed by a
+  call counter, so the same trace gives the same dropout masks for a given step
+  key and different masks across steps. The jit helpers thread the step key.
+- Model-parallel: :class:`RNGStatesTracker` mirrors the reference's
+  ``get_rng_state_tracker()`` — named streams (e.g. ``local_seed`` for dropout
+  inside tensor-parallel regions, ``global_seed`` elsewhere) derived by folding
+  a stream id and the mesh-axis rank into the active key.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class _GlobalGenerator:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = int(seed)
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_GENERATOR = _GlobalGenerator(0)
+
+
+class _RngScope(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_SCOPE = _RngScope()
+
+
+def seed(s: int):
+    """Set the global random seed (paddle.seed)."""
+    _GENERATOR.manual_seed(s)
+    np.random.seed(s % (2**32))
+    return _GENERATOR
+
+
+def get_rng_state():
+    return _GENERATOR.get_state()
+
+
+def set_rng_state(state):
+    _GENERATOR.set_state(state)
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Bind an explicit PRNG key; random ops inside draw deterministic children.
+
+    Used by the jit train-step helpers so that traced random ops depend on the
+    step key argument instead of baking a constant key into the compiled
+    program.
+    """
+    frame = {"key": key, "count": 0}
+    _SCOPE.stack.append(frame)
+    try:
+        yield
+    finally:
+        _SCOPE.stack.pop()
+
+
+def in_rng_scope() -> bool:
+    return bool(_SCOPE.stack)
+
+
+def next_key():
+    """Fresh PRNG key: fold-in child under an rng_scope, global split otherwise."""
+    if _SCOPE.stack:
+        frame = _SCOPE.stack[-1]
+        k = jax.random.fold_in(frame["key"], frame["count"])
+        frame["count"] += 1
+        return k
+    return _GENERATOR.next_key()
+
+
+class RNGStatesTracker:
+    """Named RNG streams for model parallelism.
+
+    Mirrors the reference's per-rank tracker used so dropout inside
+    tensor-parallel regions differs per mp rank while replicated regions share
+    a stream. Here a stream is an integer salt folded into whatever key source
+    is active; mp-rank salting comes from ``add`` with a rank-dependent seed.
+    """
+
+    def __init__(self):
+        self._streams = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._streams and self._streams[name] != int(seed):
+            raise ValueError(f"RNG stream {name!r} already exists with a different seed")
+        self._streams[name] = int(seed)
+
+    def reset(self):
+        self._streams.clear()
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._streams:
+            raise ValueError(f"RNG stream {name!r} not registered")
+        salt = self._streams[name]
+        base = next_key()
+        with rng_scope(jax.random.fold_in(base, salt)):
+            yield
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+__all__ = [
+    "seed", "next_key", "rng_scope", "in_rng_scope", "get_rng_state",
+    "set_rng_state", "RNGStatesTracker", "get_rng_state_tracker",
+]

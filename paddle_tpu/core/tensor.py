@@ -1,0 +1,262 @@
+"""Tensor: eager, paddle-shaped wrapper over ``jax.Array``.
+
+The reference's ``phi::DenseTensor`` + eager ``Tensor`` (pybind
+``paddle/fluid/pybind/eager_method.cc``) expose a mutable tensor with
+``stop_gradient`` / ``.grad`` / in-place ``set_value``. On TPU the underlying
+value is an immutable ``jax.Array`` (or a tracer inside jit); mutation is
+modelled by rebinding ``_value``. All math is delegated to
+:mod:`paddle_tpu.ops`, which installs the operator methods on this class at
+import time (the "phi op library" layer).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from ..autograd import engine
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
+                 "name", "persistable", "_hooks", "__weakref__", "__dict__")
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None,
+                 dtype=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if dtype is not None:
+            value = jnp.asarray(value, dtype_mod.to_jax_dtype(dtype))
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = _default_cast(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+
+    # ------------------------------------------------------------------ basics
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def data(self):
+        return self
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def place(self):
+        from . import device
+        return device.get_device()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+                f"stop_gradient={sg},\n       {self._value})")
+
+    # ------------------------------------------------------------------ grad
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def _accumulate_grad(self, g_value):
+        # register_hook transforms run at accumulation time, like the
+        # reference's gradient hooks on GradAccumulation nodes.
+        if self._hooks:
+            for h in self._hooks:
+                out = h(Tensor(g_value, stop_gradient=True))
+                if out is not None:
+                    g_value = out.value if isinstance(out, Tensor) else out
+        if self._grad is None:
+            self._grad = Tensor(g_value, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad.value + g_value, stop_gradient=True)
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        return _HookHandle(self, hook)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    # ------------------------------------------------------------------ mutation
+    def set_value(self, value):
+        """In-place rebind (paddle ``Tensor.set_value``). Shape must match."""
+        new = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(new.shape)} vs {tuple(self._value.shape)}")
+        if new.dtype != self._value.dtype:
+            new = new.astype(self._value.dtype)
+        self._value = new
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def _rebind(self, value):
+        """Rebind without shape check — used by jit param binding."""
+        self._value = value
+        return self
+
+    # ------------------------------------------------------------------ misc
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu") :
+                continue
+            dtype = a
+        if dtype is None:
+            return self
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+
+class _HookHandle:
+    def __init__(self, tensor, hook):
+        self._tensor = tensor
+        self._hook = hook
+
+    def remove(self):
+        if self._tensor._hooks and self._hook in self._tensor._hooks:
+            self._tensor._hooks.remove(self._hook)
+
+
+def _default_cast(value):
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.dtype(dtype_mod.get_default_dtype()))
+    elif arr.dtype == np.int64:
+        pass  # keep int64 indices; x64 may be disabled so jnp will downcast
+    return jnp.asarray(arr)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (``paddle.nn.Parameter`` / ``create_parameter`` result)."""
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor."""
+    if isinstance(data, Tensor):
+        value = data.value
+        if dtype is not None:
+            value = value.astype(dtype_mod.to_jax_dtype(dtype))
+        return Tensor(value, stop_gradient=stop_gradient)
+    return Tensor(data, stop_gradient=stop_gradient, dtype=dtype)

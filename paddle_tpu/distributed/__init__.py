@@ -1,0 +1,17 @@
+"""paddle.distributed-shaped namespace — re-export of paddle_tpu.parallel
+(the reference package path is ``paddle.distributed``; the implementation
+lives in ``paddle_tpu/parallel`` per this repo's layout)."""
+from ..parallel import *  # noqa: F401,F403
+from ..parallel import (DataParallel, Group, ParallelEnv, ReduceOp, all_gather,
+                        all_reduce, alltoall, barrier, broadcast, fleet,
+                        get_rank, get_world_size, init_parallel_env,
+                        is_initialized, new_group, recv, reduce,
+                        reduce_scatter, scatter, send, spawn,
+                        load_state_dict, save_state_dict,
+                        group_sharded_parallel, save_group_sharded_model)
+from ..parallel import checkpoint, moe
+from ..parallel.fleet.recompute import recompute
+from ..parallel import launch  # noqa: F401
+from ..parallel.auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from ..parallel import auto_parallel  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,1 @@
+from ...parallel.launch.main import build_parser, launch

@@ -1,0 +1,5 @@
+import sys
+
+from ...parallel.launch.main import launch
+
+sys.exit(launch())

@@ -1,0 +1,2 @@
+from ...parallel.fleet.recompute import recompute
+from ...parallel.fleet import sp as sequence_parallel_utils

@@ -1,0 +1,2 @@
+from .param_attr import ParamAttr
+from . import io

@@ -1,0 +1,4 @@
+"""paddle.incubate surface (reference: ``python/paddle/incubate/``) — fused
+layers/functional (Pallas-backed on TPU) and the distributed models (MoE)."""
+from . import nn
+from . import distributed

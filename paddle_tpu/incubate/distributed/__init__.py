@@ -1,0 +1,2 @@
+class models:
+    from ...parallel import moe

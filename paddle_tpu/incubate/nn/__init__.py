@@ -1,0 +1,3 @@
+from . import functional
+from .layer import (FusedFeedForward, FusedMultiHeadAttention,
+                    FusedMultiTransformer)

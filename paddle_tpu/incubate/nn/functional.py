@@ -1,0 +1,164 @@
+"""Fused functional ops (reference: ``python/paddle/incubate/nn/functional/``
+wrapping CUDA kernels: fused_rope (``fused_rope_kernel.cu``),
+fused_bias_dropout_residual_layer_norm, flash_attention, fused rms norm).
+
+On TPU each has (a) a jnp reference body that XLA already fuses well and
+(b) a Pallas fast path in :mod:`paddle_tpu.kernels` used when beneficial
+(flash attention for long sequences). Signatures follow the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_mod
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...ops._op import tensor_op
+
+
+@tensor_op
+def _rope_impl(q, k, v, sin, cos, use_neox):
+    def rot(x):
+        if x is None:
+            return None
+        if use_neox:
+            # neox style: rotate halves
+            d = x.shape[-1]
+            x1, x2 = x[..., : d // 2], x[..., d // 2:]
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            # GPT-J interleaved style
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos + rotated * sin
+    outs = tuple(rot(t) for t in (q, k, v) if t is not None)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference signature: q/k/v are [batch, seq, heads, head_dim]."""
+    if sin is None or cos is None:
+        seq = q.shape[1]
+        dim = q.shape[-1]
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        sin_v = jnp.sin(emb)[None, :, None, :]
+        cos_v = jnp.cos(emb)[None, :, None, :]
+    else:
+        sin_v = sin.value if isinstance(sin, Tensor) else sin
+        cos_v = cos.value if isinstance(cos, Tensor) else cos
+        if sin_v.ndim == 2:
+            sin_v = sin_v[None, :, None, :]
+            cos_v = cos_v[None, :, None, :]
+    if position_ids is not None:
+        pid = position_ids.value if isinstance(position_ids, Tensor) else position_ids
+        sin_v = jnp.take(sin_v[0, :, 0], pid, axis=0)[:, :, None, :]
+        cos_v = jnp.take(cos_v[0, :, 0], pid, axis=0)[:, :, None, :]
+    outs = _rope_impl(q, k, v, Tensor(sin_v), Tensor(cos_v),
+                      use_neox_rotary_style)
+    n_out = sum(x is not None for x in (q, k, v))
+    if n_out == 1:
+        return outs, None, None
+    outs = list(outs) + [None] * (3 - len(outs))
+    return tuple(outs)
+
+
+@tensor_op
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train"):
+    """out = LayerNorm(residual + dropout(x + bias)) — the reference's fused
+    CUDA epilogue (``fused_bias_dropout_residual_layer_norm_kernel.cu``).
+    XLA fuses this chain into the producing matmul on TPU."""
+    h = x if bias is None else x + bias
+    if training and dropout_rate > 0:
+        key = random_mod.next_key()
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(key, keep, h.shape)
+        h = jnp.where(mask, h / keep, 0.0).astype(h.dtype)
+    h = residual + h
+    xf = h.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + ln_epsilon)
+    out = out.astype(h.dtype)
+    if ln_scale is not None:
+        out = out * ln_scale
+    if ln_bias is not None:
+        out = out + ln_bias
+    return out
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis=1,
+                     **kwargs):
+    shape = tuple(x.shape[begin_norm_axis:])
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Reference ``F.flash_attention`` ([b, s, h, d] layout). Routes to the
+    Pallas flash kernel on TPU, jnp reference otherwise."""
+    from ...kernels import flash_attention as fa
+    out = fa.flash_attention(query, key, value, causal=causal,
+                             dropout=dropout, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, training=True, name=None):
+    """Varlen flash attention over packed sequences. TPU path: segment-masked
+    dense attention (static shapes); the segment ids derive from cu_seqlens."""
+    from ...kernels import flash_attention as fa
+    return fa.flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                causal=causal), None
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ...ops import matmul
+    out = matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ...ops import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    return getattr(F, activation)(out)
+
+
+def swiglu(x, y=None):
+    """Reference incubate swiglu: silu(x) * y (llama MLP)."""
+    if y is None:
+        from ...ops import split
+        a, b = split(x, 2, axis=-1)
+        return F.silu(a) * b
+    return F.silu(x) * y
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p=p, training=training, mode=mode) + y

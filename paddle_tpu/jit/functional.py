@@ -1,0 +1,104 @@
+"""Functional bridge: stateful Layers <-> pure pytree functions.
+
+The reference needs dy2static (AST rewriting, ``python/paddle/jit/dy2static``)
+to get from eager code to a compilable program. Here the bridge is direct:
+``split_state`` flattens a Layer tree to {name: array} dicts, and ``bind``
+temporarily rebinds (possibly traced) arrays into the live Layer objects while
+``forward`` runs under the eager tape disabled. Buffer mutations (batchnorm
+running stats) are collected and returned functionally, so the same Layer code
+is pure from XLA's point of view.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Tuple
+
+import jax
+
+from ..autograd.engine import no_grad
+from ..core.tensor import Tensor
+
+
+def split_state(layer) -> Tuple[Dict[str, object], Dict[str, object]]:
+    """Layer -> (params {name: array}, buffers {name: array})."""
+    params = {n: p.value for n, p in layer.named_parameters()
+              if not p.stop_gradient}
+    frozen = {n: p.value for n, p in layer.named_parameters()
+              if p.stop_gradient}
+    buffers = {n: b.value for n, b in layer.named_buffers()}
+    buffers = dict(buffers)
+    buffers.update({"__frozen__." + n: v for n, v in frozen.items()})
+    return params, buffers
+
+
+def _param_objs(layer):
+    out = {}
+    for n, p in layer.named_parameters():
+        out[("p", n) if not p.stop_gradient else ("f", "__frozen__." + n)] = p
+    for n, b in layer.named_buffers():
+        out[("b", n)] = b
+    return out
+
+
+@contextlib.contextmanager
+def bind(layer, params: dict, buffers: dict):
+    """Rebind arrays into the live layer tree; restore originals on exit.
+
+    Yields a collector that, when called, returns the (possibly mutated)
+    buffer dict as plain arrays — call it *inside* the context, after forward.
+    """
+    objs = _param_objs(layer)
+    saved = {}
+    for key, t in objs.items():
+        kind, name = key
+        saved[key] = t._value
+        if kind == "p":
+            if name in params:
+                t._value = params[name]
+        else:
+            if name in buffers:
+                t._value = buffers[name]
+
+    def collect():
+        out = {}
+        for key, t in objs.items():
+            kind, name = key
+            if kind != "p":
+                out[name] = t._value
+        return out
+
+    try:
+        yield collect
+    finally:
+        for key, t in objs.items():
+            t._value = saved[key]
+
+
+def rebind_results(layer, params: dict, buffers: dict):
+    """Write updated arrays back into the live layer (post-step)."""
+    for n, p in layer.named_parameters():
+        if not p.stop_gradient and n in params:
+            p._value = params[n]
+        elif p.stop_gradient and "__frozen__." + n in buffers:
+            p._value = buffers["__frozen__." + n]
+    for n, b in layer.named_buffers():
+        if n in buffers:
+            b._value = buffers[n]
+
+
+def call_functional(layer, params, buffers, args, kwargs=None):
+    """Pure forward: (params, buffers, inputs) -> (outputs, new_buffers).
+
+    Inputs/outputs are raw arrays; Tensor wrapping happens inside.
+    """
+    kwargs = kwargs or {}
+    with no_grad():
+        with bind(layer, params, buffers) as collect:
+            t_args = jax.tree.map(Tensor, args)
+            t_kwargs = jax.tree.map(Tensor, kwargs)
+            out = layer(*t_args, **t_kwargs)
+            new_buffers = collect()
+    out_vals = jax.tree.map(
+        lambda t: t.value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+    return out_vals, new_buffers

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the fused-op layer (reference's CUDA kernel zoo:
+flash_attn, fused_rope, fused_bias_dropout_residual_ln,
+fused_multi_transformer, MoE dispatch).
+
+Each kernel module exposes the op with a jnp reference implementation and,
+where profitable, a Pallas TPU kernel selected at runtime
+(FLAGS_use_pallas_kernels + platform check). jnp paths are used on CPU test
+meshes; numerics match within bf16 tolerance.
+"""
+from . import flash_attention

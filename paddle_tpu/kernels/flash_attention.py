@@ -1,0 +1,106 @@
+"""Flash attention (reference: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``
+wrapping the cutlass flash-attention lib; varlen variant
+``FlashAttnUnpadded``).
+
+TPU: memory-efficient attention as a Pallas kernel (tiled online-softmax,
+one pass over KV in VMEM-sized blocks). The jnp reference path is used off
+TPU and for small sequences where XLA's fusion already saturates the MXU.
+Layout follows paddle: [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..ops._op import tensor_op
+from ..utils.flags import get_flag
+
+
+def _use_pallas(seq_len):
+    if not get_flag("FLAGS_use_pallas_kernels", True):
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    # axon = tunneled TPU platform name in this environment
+    return platform in ("tpu", "axon") and seq_len >= 1024
+
+
+# --------------------------------------------------------------- jnp reference
+def _ref_attention(q, k, v, causal, segment_ids=None):
+    Bq, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    Hk = k.shape[2]
+    scale = 1.0 / math.sqrt(D)
+    if Hk != H:  # grouped-query attention: repeat kv heads
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))[None, None]
+    if segment_ids is not None:
+        seg = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out
+
+
+@tensor_op
+def _flash_impl(q, k, v, causal):
+    if _use_pallas(q.shape[1]):
+        from .pallas_flash import flash_attention_pallas
+        return flash_attention_pallas(q, k, v, causal=causal)
+    return _ref_attention(q, k, v, causal)
+
+
+@tensor_op
+def _flash_dropout_impl(q, k, v, causal, dropout, key):
+    out = _ref_attention(q, k, v, causal)  # dropout path: reference only
+    # NOTE: the reference applies dropout to attention probs; post-output
+    # dropout is not equivalent, so recompute with probs dropout:
+    return out
+
+
+def flash_attention(query, key, value, causal=False, dropout=0.0,
+                    training=True):
+    if dropout and training:
+        # fall back to the general sdpa (probs dropout needs the probs)
+        from ..nn import functional as F
+        return F.scaled_dot_product_attention(query, key, value,
+                                              dropout_p=dropout,
+                                              is_causal=causal,
+                                              training=training)
+    return _flash_impl(query, key, value, bool(causal))
+
+
+@tensor_op
+def _flash_varlen_impl(q, k, v, seg_q, causal):
+    # q: [total_q, H, D] packed; add batch dim 1 and use segment mask
+    out = _ref_attention(q[None], k[None], v[None], causal,
+                         segment_ids=seg_q[None])
+    return out[0]
+
+
+def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False):
+    """Packed/varlen attention via segment-id masking (static shapes — the
+    TPU answer to FlashAttnUnpadded's ragged batching)."""
+    import numpy as np
+    cs = cu_seqlens_q.value if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q
+    cs = np.asarray(cs)
+    total = int(cs[-1])
+    seg = np.zeros(total, np.int32)
+    for i in range(len(cs) - 1):
+        seg[cs[i]:cs[i + 1]] = i
+    return _flash_varlen_impl(q, k, v, jnp.asarray(seg), bool(causal))

@@ -1,0 +1,151 @@
+"""Metrics (reference: ``python/paddle/metric/metrics.py``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        return pred, label
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pv = np.asarray(pred.value if isinstance(pred, Tensor) else pred)
+        lv = np.asarray(label.value if isinstance(label, Tensor) else label)
+        maxk = max(self.topk)
+        order = np.argsort(-pv, axis=-1)[..., :maxk]
+        if lv.ndim == order.ndim:
+            lv = lv.squeeze(-1)
+        correct = (order == lv[..., None])
+        return correct
+
+    def update(self, correct, *args):
+        correct = np.asarray(correct)
+        n = correct.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(correct[..., :k].sum())
+            self.count[i] += n
+        return self.total[0] / max(self.count[0], 1)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.value if isinstance(labels, Tensor) else labels)
+        pred_pos = (p.round() if p.dtype.kind == "f" else p) == 1
+        self.tp += int(((pred_pos) & (l == 1)).sum())
+        self.fp += int(((pred_pos) & (l == 0)).sum())
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fp, 1)
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.value if isinstance(labels, Tensor) else labels)
+        pred_pos = (p.round() if p.dtype.kind == "f" else p) == 1
+        self.tp += int((pred_pos & (l == 1)).sum())
+        self.fn += int((~pred_pos & (l == 1)).sum())
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fn, 1)
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.value if isinstance(labels, Tensor) else labels).ravel()
+        pos_prob = p[:, 1] if p.ndim == 2 else p
+        bins = (pos_prob * self.num_thresholds).astype(int)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds, descending
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    m = Accuracy(topk=(k,))
+    c = m.compute(input, label)
+    m.update(c)
+    return Tensor(np.asarray(m.accumulate(), np.float32))

@@ -1,0 +1,32 @@
+"""paddle_tpu.nn — the layer library (reference: ``python/paddle/nn/``)."""
+from ..core.tensor import Parameter
+from ..framework.param_attr import ParamAttr
+from . import functional
+from . import initializer
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                   clip_grad_norm_)
+from .layer import Layer, LayerList, ParameterList, Sequential
+from .layers.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
+                                Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                                LogSoftmax, Mish, PReLU, ReLU, ReLU6, RReLU,
+                                Sigmoid, Silu, Softmax, Softplus, Softshrink,
+                                Softsign, Swish, Tanh, Tanhshrink)
+from .layers.common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
+                            Dropout2D, Embedding, Flatten, Identity, Linear,
+                            Pad2D, PixelShuffle, Upsample)
+from .layers.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .layers.loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
+                          KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                          NLLLoss, SmoothL1Loss)
+from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                          GroupNorm, InstanceNorm2D, LayerNorm,
+                          LocalResponseNorm, RMSNorm, SpectralNorm,
+                          SyncBatchNorm)
+from .layers.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                             AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
+                             MaxPool1D, MaxPool2D)
+from .layers.transformer import (MultiHeadAttention, Transformer,
+                                 TransformerDecoder, TransformerDecoderLayer,
+                                 TransformerEncoder, TransformerEncoderLayer)
+
+from . import utils  # noqa: E402

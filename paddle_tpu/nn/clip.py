@@ -1,0 +1,112 @@
+"""Gradient clipping (reference: ``python/paddle/nn/clip.py``).
+
+Clip objects are attached to optimizers (``grad_clip=...``) and applied to the
+(param, grad) list before the update — both in eager mode (Tensor grads) and
+inside the jitted train step (pure-array pytrees via ``apply_pure``). The
+hybrid-parallel optimizer extends global-norm clip with cross-group norm
+reduction (see paddle_tpu.parallel.fleet)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def apply_pure(self, grads_tree):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.value, self.min, self.max))))
+        return out
+
+    def apply_pure(self, grads_tree):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads_tree)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(self._clip_one(g.value)) if g is not None else None)
+                for p, g in params_grads]
+
+    def apply_pure(self, grads_tree):
+        return jax.tree.map(self._clip_one, grads_tree)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. ``group_norm_fn`` hook lets hybrid-parallel wrappers
+    all-reduce the squared norm across mp/pp/sharding groups before scaling
+    (the reference does this in HybridParallelClipGrad)."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_norm_fn = None
+
+    def _global_norm_sq(self, leaves):
+        return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+    def _scale(self, sq):
+        if self.group_norm_fn is not None:
+            sq = self.group_norm_fn(sq)
+        norm = jnp.sqrt(sq)
+        return jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+
+    def __call__(self, params_grads):
+        grads = [g.value for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        scale = self._scale(self._global_norm_sq(grads))
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g.value.astype(jnp.float32) * scale)
+                                      .astype(g.value.dtype))))
+        return out
+
+    def apply_pure(self, grads_tree):
+        leaves = jax.tree.leaves(grads_tree)
+        if not leaves:
+            return grads_tree
+        scale = self._scale(self._global_norm_sq(leaves))
+        return jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+            grads_tree)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility paddle also exposes (paddle.nn.utils)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.asarray(0.0))
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(p.grad.value.astype(jnp.float32)))
+                         for p in params))
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad = Tensor(p.grad.value * scale)
+    return Tensor(total)

@@ -1,0 +1,912 @@
+"""nn.functional (reference: ``python/paddle/nn/functional/``).
+
+Paddle-shaped signatures over jnp/lax bodies. Convs lower to
+``lax.conv_general_dilated`` (XLA tiles these onto the MXU), pooling to
+``lax.reduce_window``; attention has a pure-jnp reference path here and a
+Pallas flash-attention fast path in :mod:`paddle_tpu.kernels` that the
+transformer layers call when available.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..ops._op import tensor_op, unwrap
+
+# ----------------------------------------------------------------- activations
+
+
+@tensor_op
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@tensor_op
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@tensor_op
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@tensor_op
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@tensor_op
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@tensor_op
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@tensor_op
+def softplus(x, beta=1.0, threshold=20.0):
+    # clamp the untaken branch's argument so its VJP can't produce inf*0=NaN
+    safe = jnp.minimum(x * beta, threshold)
+    return jnp.where(x * beta > threshold, x, jnp.log1p(jnp.exp(safe)) / beta)
+
+
+@tensor_op
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@tensor_op
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@tensor_op
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@tensor_op
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@tensor_op
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@tensor_op
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+@tensor_op
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@tensor_op
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@tensor_op
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@tensor_op
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@tensor_op
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@tensor_op
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@tensor_op
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch_axis] = w.shape[0]
+        w = jnp.reshape(w, shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@tensor_op
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    if training:
+        k = random_mod.next_key()
+        a = jax.random.uniform(k, x.shape, x.dtype, lower, upper)
+    else:
+        a = (lower + upper) / 2
+    return jnp.where(x >= 0, x, a * x)
+
+
+@tensor_op
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@tensor_op
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype_mod.to_jax_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@tensor_op
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype_mod.to_jax_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@tensor_op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    k = random_mod.next_key()
+    g = jax.random.gumbel(k, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        y_hard = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+# ----------------------------------------------------------------- linear/embed
+@tensor_op
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@tensor_op
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        # zero the gradient contribution of padding rows (reference semantics)
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jax.lax.stop_gradient(out), out)
+    return out
+
+
+@tensor_op(differentiable=False)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype_mod.get_default_dtype())
+
+
+# ----------------------------------------------------------------- dropout
+@tensor_op
+def _dropout_impl(x, key, p, upscale):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ..ops.math import scale as _scale
+            return _scale(x, scale=1.0 - p)
+        return x
+    if p == 1.0:
+        from ..ops import zeros_like
+        return zeros_like(x) if mode != "upscale_in_train" else zeros_like(x)
+    if axis is not None:
+        # broadcast dropout along given axes (paddle axis semantics)
+        shape = list(x.shape)
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        for i in range(len(shape)):
+            if i not in axes:
+                shape[i] = 1
+        key = random_mod.next_key()
+        return _dropout_axis(x, key, p, tuple(shape), mode == "upscale_in_train")
+    key = random_mod.next_key()
+    return _dropout_impl(x, key, float(p), mode == "upscale_in_train")
+
+
+@tensor_op
+def _dropout_axis(x, key, p, mask_shape, upscale):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout(x, random_mod.next_key(), float(p))
+
+
+@tensor_op
+def _alpha_dropout(x, key, p):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+# ----------------------------------------------------------------- conv / pool
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, spatial, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style full-form padding: take spatial entries
+        return [tuple(p) for p in padding[-spatial:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+@tensor_op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, 2)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+    w = weight
+    if data_format != "NCHW":
+        # paddle weights are always OIHW; convert for NHWC lowering
+        w = jnp.transpose(weight, (2, 3, 1, 0))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@tensor_op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=("NCH", "OIH", "NCH"), feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1))
+    return out
+
+
+@tensor_op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+@tensor_op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _conv_padding(padding, 2)
+    # paddle weight layout for transpose conv: [in, out/groups, kh, kw]
+    kh, kw = weight.shape[2], weight.shape[3]
+    # lax transposed conv = conv with lhs_dilation
+    pad_t = [
+        (dilation[0] * (kh - 1) - pads[0][0],
+         dilation[0] * (kh - 1) - pads[0][1] + opad[0]),
+        (dilation[1] * (kw - 1) - pads[1][0],
+         dilation[1] * (kw - 1) - pads[1][1] + opad[1]),
+    ]
+    if groups > 1:
+        ic = weight.shape[0]
+        w = jnp.reshape(weight, (groups, ic // groups) + tuple(weight.shape[1:]))
+        w = jnp.flip(w, axis=(-2, -1))
+        w = jnp.swapaxes(w, 1, 2)  # [g, out/g, in/g, kh, kw]
+        w = jnp.reshape(w, (w.shape[0] * w.shape[1],) + tuple(w.shape[2:]))
+    else:
+        w = jnp.swapaxes(jnp.flip(weight, axis=(-2, -1)), 0, 1)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad_t, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out
+
+
+def _ceil_extra(size, k, s, pad):
+    """Extra right/bottom padding so ceil-mode partial windows are included."""
+    span = size + 2 * pad - k
+    out_floor = span // s + 1
+    out_ceil = -(-span // s) + 1
+    if out_ceil > out_floor:
+        return (out_ceil - 1) * s + k - size - 2 * pad
+    return 0
+
+
+@tensor_op
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pads = _conv_padding(padding, 2)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    if isinstance(pads, str):
+        if return_mask:
+            raise NotImplementedError("return_mask with string padding")
+        return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
+                                     (1, 1) + s, padding=pads)
+    eh = _ceil_extra(x.shape[2], k[0], s[0], pads[0][0]) if ceil_mode else 0
+    ew = _ceil_extra(x.shape[3], k[1], s[1], pads[1][0]) if ceil_mode else 0
+    pad_cfg = [(0, 0), (0, 0), (pads[0][0], pads[0][1] + eh),
+               (pads[1][0], pads[1][1] + ew)]
+    out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
+                                padding=pad_cfg)
+    if not return_mask:
+        return out
+    # mask = flattened H*W input index of each window max (paddle semantics);
+    # computed from explicit -inf-padded patches
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    OH, OW = patches.shape[2], patches.shape[3]
+    pr = patches.reshape(N, C, k[0] * k[1], OH, OW)
+    widx = jnp.argmax(pr, axis=2)
+    wi, wj = widx // k[1], widx % k[1]
+    oh = jnp.arange(OH)[None, None, :, None]
+    ow = jnp.arange(OW)[None, None, None, :]
+    in_i = oh * s[0] - pads[0][0] + wi
+    in_j = ow * s[1] - pads[1][0] + wj
+    mask = (in_i * W + in_j).astype(dtype_mod.long_dtype())
+    return out, mask
+
+
+@tensor_op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pads = _conv_padding(padding, 2)
+    if isinstance(pads, str):
+        pad_cfg = pads
+    else:
+        eh = _ceil_extra(x.shape[2], k[0], s[0], pads[0][0]) if ceil_mode else 0
+        ew = _ceil_extra(x.shape[3], k[1], s[1], pads[1][0]) if ceil_mode else 0
+        pad_cfg = [(0, 0), (0, 0), (pads[0][0], pads[0][1] + eh),
+                   (pads[1][0], pads[1][1] + ew)]
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, padding=pad_cfg)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive and not isinstance(pad_cfg, str):
+        ones = jnp.ones((1, 1) + x.shape[-2:], x.dtype)
+        count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1) + k,
+                                      (1, 1) + s, padding=pad_cfg)
+        return summed / count
+    return summed / (k[0] * k[1])
+
+
+@tensor_op
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    H, W = x.shape[-2], x.shape[-1]
+    if oh == 1 and ow == 1:
+        return jnp.mean(x, axis=(-2, -1), keepdims=True)
+    if H % oh == 0 and W % ow == 0:
+        xr = jnp.reshape(x, x.shape[:-2] + (oh, H // oh, ow, W // ow))
+        return jnp.mean(xr, axis=(-3, -1))
+    rows = [jnp.mean(x[..., (i * H) // oh:-(-(i + 1) * H // oh), :], axis=-2,
+                     keepdims=True) for i in range(oh)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.mean(xh[..., :, (j * W) // ow:-(-(j + 1) * W // ow)], axis=-1,
+                     keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@tensor_op
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    H, W = x.shape[-2], x.shape[-1]
+    if H % oh == 0 and W % ow == 0:
+        xr = jnp.reshape(x, x.shape[:-2] + (oh, H // oh, ow, W // ow))
+        return jnp.max(xr, axis=(-3, -1))
+    rows = [jnp.max(x[..., (i * H) // oh:-(-(i + 1) * H // oh), :], axis=-2,
+                    keepdims=True) for i in range(oh)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.max(xh[..., :, (j * W) // ow:-(-(j + 1) * W // ow)], axis=-1,
+                    keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False):
+    from ..ops import unsqueeze, squeeze
+    out = max_pool2d(unsqueeze(x, -1), (_pair(kernel_size, 1)[0], 1),
+                     (_pair(stride, 1)[0], 1) if stride is not None else None,
+                     padding=(_pair(padding, 1)[0], 0), ceil_mode=ceil_mode)
+    return squeeze(out, -1)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    from ..ops import unsqueeze, squeeze
+    out = avg_pool2d(unsqueeze(x, -1), (_pair(kernel_size, 1)[0], 1),
+                     (_pair(stride, 1)[0], 1) if stride is not None else None,
+                     padding=(_pair(padding, 1)[0], 0), exclusive=exclusive)
+    return squeeze(out, -1)
+
+
+# ----------------------------------------------------------------- norms
+@tensor_op
+def _batch_norm_train(x, mean, var, weight, bias, momentum, epsilon, axes, bshape):
+    batch_mean = jnp.mean(x, axis=axes)
+    batch_var = jnp.var(x, axis=axes)
+    new_mean = momentum * mean + (1 - momentum) * batch_mean
+    new_var = momentum * var + (1 - momentum) * batch_var
+    inv = jax.lax.rsqrt(batch_var.reshape(bshape) + epsilon)
+    out = (x - batch_mean.reshape(bshape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+@tensor_op
+def _batch_norm_eval(x, mean, var, weight, bias, epsilon, bshape):
+    inv = jax.lax.rsqrt(var.reshape(bshape) + epsilon)
+    out = (x - mean.reshape(bshape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out.astype(x.dtype)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Functional batch norm. In training mode the running stats tensors are
+    updated in place (rebind), mirroring the reference's mutable outputs; the
+    jit functional wrapper snapshots buffer mutations (see jit.functional)."""
+    nd = x.ndim
+    ch_axis = 1 if data_format.startswith("NC") else nd - 1
+    axes = tuple(i for i in range(nd) if i != ch_axis)
+    bshape = tuple(x.shape[ch_axis] if i == ch_axis else 1 for i in range(nd))
+    use_stats = use_global_stats if use_global_stats is not None else not training
+    if use_stats:
+        return _batch_norm_eval(x, running_mean, running_var, weight, bias,
+                                float(epsilon), bshape)
+    out, new_mean, new_var = _batch_norm_train(
+        x, running_mean, running_var, weight, bias, float(momentum),
+        float(epsilon), axes, bshape)
+    running_mean._rebind(new_mean.value if isinstance(new_mean, Tensor) else new_mean)
+    running_var._rebind(new_var.value if isinstance(new_var, Tensor) else new_var)
+    return out
+
+
+@tensor_op
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # reduce in fp32 for bf16 stability (standard TPU practice)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@tensor_op
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@tensor_op
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    N = x.shape[0]
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if ch_axis != 1:
+        x = jnp.moveaxis(x, ch_axis, 1)
+    C = x.shape[1]
+    spatial = x.shape[2:]
+    xg = jnp.reshape(x, (N, num_groups, C // num_groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = (xg - mean) * jax.lax.rsqrt(var + epsilon)
+    out = jnp.reshape(out, (N, C) + spatial)
+    bshape = (1, C) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * jnp.reshape(weight, bshape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, bshape)
+    if ch_axis != 1:
+        out = jnp.moveaxis(out, 1, ch_axis)
+    return out.astype(x.dtype)
+
+
+@tensor_op
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * jnp.reshape(weight, bshape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, bshape)
+    return out.astype(x.dtype)
+
+
+@tensor_op
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                      1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+@tensor_op
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    padded = jnp.pad(sq, pads)
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+# ----------------------------------------------------------------- losses
+@tensor_op
+def mse_loss(input, label, reduction="mean"):
+    l = jnp.square(input - label)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def l1_loss(input, label, reduction="mean"):
+    l = jnp.abs(input - label)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    l = -(label * jnp.log(jnp.clip(input, eps, None))
+          + (1 - label) * jnp.log(jnp.clip(1 - input, eps, None)))
+    if weight is not None:
+        l = l * weight
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    neg_abs = -jnp.abs(logit)
+    l = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1) * label + 1
+        l = l * log_weight
+    if weight is not None:
+        l = l * weight
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    picked = jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
+    l = -picked
+    mask = (label != ignore_index)
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(mask, label, 0))
+        l = l * w
+    l = jnp.where(mask, l, 0.0)
+    if reduction == "mean":
+        denom = (jnp.sum(jnp.take(weight, jnp.where(mask, label, 0)) * mask)
+                 if weight is not None else jnp.sum(mask))
+        return jnp.sum(l) / jnp.maximum(denom, 1)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def kl_div(input, label, reduction="mean"):
+    l = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def _cross_entropy_impl(input, label, weight, ignore_index, reduction,
+                        soft_label, axis, use_softmax, label_smoothing):
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input.astype(jnp.float32), 1e-12, None))
+    nclass = input.shape[axis]
+    if soft_label:
+        soft = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+        l = -jnp.sum(soft * logp, axis=axis)
+        mask = None
+        safe = None
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        mask = (lbl != ignore_index)
+        safe = jnp.where(mask, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                     axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            mean_logp = jnp.mean(logp, axis=axis)
+            l = -(1 - label_smoothing) * picked - label_smoothing * mean_logp
+        else:
+            l = -picked
+        if weight is not None:
+            l = l * jnp.take(weight, safe)
+        l = jnp.where(mask, l, 0.0)
+    if reduction == "mean":
+        if mask is not None:
+            if weight is not None:
+                denom = jnp.sum(jnp.take(weight, safe) * mask)
+            else:
+                denom = jnp.sum(mask)
+            return jnp.sum(l) / jnp.maximum(denom, 1)
+        return jnp.mean(l)
+    return _reduce(l, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    return _cross_entropy_impl(input, label, weight, ignore_index, reduction,
+                               soft_label, axis, use_softmax, label_smoothing)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ..ops import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+@tensor_op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@tensor_op
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    l = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    l = jnp.where(label == 1, input, jnp.maximum(0.0, margin - input))
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+def _reduce(l, reduction):
+    if reduction == "mean":
+        return jnp.mean(l)
+    if reduction == "sum":
+        return jnp.sum(l)
+    return l
+
+
+# ----------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Paddle layout: [batch, seq, num_heads, head_dim]. Reference wraps
+    flash-attention CUDA (``paddle/phi/kernels/gpu/flash_attn_kernel.cu``);
+    here the default is a fused-friendly jnp path, and the transformer layers
+    call the Pallas flash kernel for long sequences (paddle_tpu.kernels)."""
+    dk = random_mod.next_key() if (dropout_p and training) else None
+    return _sdpa(query, key, value, attn_mask, float(dropout_p), bool(is_causal),
+                 bool(training), dk)
+
+
+@tensor_op
+def _sdpa(q, k, v, attn_mask, dropout_p, is_causal, training, drop_key):
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p and training and drop_key is not None:
+        keep = 1.0 - dropout_p
+        m = jax.random.bernoulli(drop_key, keep, probs.shape)
+        probs = jnp.where(m, probs / keep, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+# ----------------------------------------------------------------- geometry
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if data_format == "NCHW":
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+    return _interp(x, tuple(size), method, data_format)
+
+
+@tensor_op
+def _interp(x, size, method, data_format):
+    if data_format.startswith("NC"):
+        out_shape = x.shape[:2] + size
+    else:
+        out_shape = (x.shape[0],) + size + (x.shape[-1],)
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       data_format=data_format)
+
+
+@tensor_op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    N, C, H, W = x.shape
+    out = jnp.reshape(x, (N, C // (r * r), r, r, H, W))
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(out, (N, C // (r * r), H * r, W * r))
+
+
+@tensor_op
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    N, C, H, W = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return jnp.reshape(patches, (N, patches.shape[1], -1))
+
+
+# pad comes from the generic ops layer
+from ..ops.manipulation import pad  # noqa: E402,F401
+from ..ops.math import sigmoid as _sig  # noqa: E402
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    ln = unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(np.asarray(ln).max())
+    out = jnp.arange(m)[None, :] < jnp.reshape(ln, (-1, 1))
+    return Tensor(out.astype(dtype_mod.to_jax_dtype(dtype)))

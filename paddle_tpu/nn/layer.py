@@ -1,0 +1,420 @@
+"""nn.Layer — the module system (reference:
+``python/paddle/nn/layer/layers.py``).
+
+Layers hold :class:`Parameter` and buffer Tensors in ordered dicts and compose
+into a tree. Unlike the reference (mutable C++ tensors), parameters here wrap
+immutable jax Arrays; the jit helpers (:mod:`paddle_tpu.jit`) flatten the tree
+to a pytree of arrays, trace ``forward`` functionally, and rebind results —
+so one Layer definition serves both eager debugging and compiled TPU execution.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Parameter, Tensor
+from . import initializer as init_mod
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        # use object.__setattr__ to dodge our own __setattr__ hook
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_dtype", dtype_mod.to_jax_dtype(dtype))
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "_name_scope", name_scope or type(self).__name__.lower())
+
+    # ------------------------------------------------------------ attr plumbing
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            _strip(self, name)
+            params[name] = value
+        elif isinstance(value, Layer):
+            _strip(self, name)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                del params[name]
+                object.__setattr__(self, name, value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name!r}")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name].set_value(value)
+        elif layers is not None and name in layers and value is None:
+            del layers[name]
+            object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+        return sorted(set(super().__dir__() + extra))
+
+    # ------------------------------------------------------------ construction
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Reference ``Layer.create_parameter`` — initializer resolution order:
+        explicit attr initializer > default_initializer > (bias ? zeros :
+        Xavier-uniform, paddle's historical default)."""
+        dtype = dtype_mod.to_jax_dtype(dtype) or self._dtype
+        initializer = None
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            initializer = attr.initializer
+        elif default_initializer is not None:
+            initializer = default_initializer
+        elif is_bias:
+            initializer = init_mod.Constant(0.0)
+        else:
+            initializer = init_mod.XavierUniform()
+        value = initializer(shape, dtype)
+        p = Parameter(value)
+        if attr is not None and getattr(attr, "name", None):
+            p.name = attr.name
+        lr = getattr(attr, "learning_rate", None) if attr is not None else None
+        if lr is not None:
+            p.optimize_attr = {"learning_rate": lr}
+        if attr is not None and getattr(attr, "trainable", True) is False:
+            p.trainable = False
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        _strip(self, name)
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        _strip(self, name)
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        _strip(self, name)
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ------------------------------------------------------------ iteration
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._traverse(sub_prefix, True)
+
+    def sublayers(self, include_self=False):
+        out = []
+        for name, layer in self._traverse(""):
+            if layer is self and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, layer in self._traverse(prefix):
+            if layer is self and not include_self:
+                continue
+            yield name, layer
+
+    def children(self):
+        return (l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return ((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------ mode / dtype
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", True)
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", False)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = dtype_mod.to_jax_dtype(dtype)
+            for p in self.parameters():
+                p._rebind(p.value.astype(d))
+            for b in self.buffers():
+                if jnp.issubdtype(b.dtype, jnp.floating):
+                    b._rebind(b.value.astype(d))
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        out = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            out[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            leaf = name.rsplit(".", 1)[-1]
+            owner = self._locate(name)
+            if owner is not None and leaf in owner._non_persistable_buffer_names:
+                continue
+            out[name] = b
+        return out
+
+    def _locate(self, qualified_name):
+        parts = qualified_name.split(".")
+        layer = self
+        for p in parts[:-1]:
+            layer = layer._sub_layers.get(p)
+            if layer is None:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                val = src.value if isinstance(src, Tensor) else jnp.asarray(src)
+                if tuple(val.shape) != tuple(target.value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: {tuple(val.shape)} vs "
+                        f"{tuple(target.value.shape)}")
+                target._rebind(val.astype(target.dtype))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook):
+        handle = _LayerHookHandle(self._forward_pre_hooks, hook)
+        self._forward_pre_hooks[id(handle)] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _LayerHookHandle(self._forward_post_hooks, hook)
+        self._forward_post_hooks[id(handle)] = hook
+        return handle
+
+    # ------------------------------------------------------------ call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): " + ("\n  ".join(sub_repr)))
+        body = ",\n  ".join(lines)
+        if body:
+            return f"{type(self).__name__}({extra}\n  {body}\n)"
+        return f"{type(self).__name__}({extra})"
+
+
+def _strip(layer, name):
+    """Remove name from all stores + instance dict before re-registration."""
+    for store in ("_parameters", "_buffers", "_sub_layers"):
+        d = layer.__dict__.get(store)
+        if d is not None and name in d:
+            del d[name]
+    layer.__dict__.pop(name, None)
+    ns = layer.__dict__.get("_non_persistable_buffer_names")
+    if ns is not None:
+        ns.discard(name)
+
+
+class _LayerHookHandle:
+    _id = [0]
+
+    def __init__(self, store, hook):
+        self._store = store
+        self._hook = hook
+
+    def remove(self):
+        for k, v in list(self._store.items()):
+            if v is self._hook:
+                del self._store[k]
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[int(idx)]
+
+    def __setitem__(self, idx, layer):
+        self.add_sublayer(str(int(idx)), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self

@@ -1,0 +1,52 @@
+"""paddle.DataParallel (reference: ``python/paddle/distributed/parallel.py``
+DataParallel + C++ EagerReducer grad bucketing).
+
+TPU-native: data parallelism is a sharding, not a wrapper behavior. Inside the
+jitted train step the batch is sharded over the 'dp' mesh axis and XLA emits
+the bucketed/overlapped gradient reduce-scatter/all-reduce automatically
+(EagerReducer's job is done by the XLA latency-hiding scheduler). This class
+therefore delegates forward untouched and exists for API parity: it marks the
+model for dp-sharded stepping (consumed by jit.TrainStep / fleet helpers) and
+provides ``no_sync`` (under accumulation, sync is skipped because the jitted
+accum step only reduces on the boundary step).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        return
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

@@ -1,0 +1,17 @@
+from ..clip import clip_grad_norm_
+
+
+def parameters_to_vector(parameters):
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    return Tensor(jnp.concatenate([jnp.ravel(p.value) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    import numpy as np
+    offset = 0
+    v = vec.value
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(v[offset:offset + n].reshape(p.shape))
+        offset += n

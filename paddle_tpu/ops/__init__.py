@@ -1,0 +1,153 @@
+"""The op library: paddle-shaped functional surface over jnp/lax.
+
+Aggregates creation/math/manipulation/linalg ops and installs operator
+methods on :class:`~paddle_tpu.core.tensor.Tensor` (the reference does this
+via pybind ``eager_method.cc`` + monkey-patching in
+``python/paddle/tensor/__init__.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._op import OP_REGISTRY, tensor_op, unwrap, unwrap_tree, wrap
+from .creation import *  # noqa: F401,F403
+from .creation import (arange, assign, bernoulli, clone, diag, empty, empty_like,
+                       eye, full, full_like, linspace, meshgrid, multinomial,
+                       normal, ones, ones_like, rand, randint, randn, randperm,
+                       standard_normal, to_tensor, tril, triu, uniform, zeros,
+                       zeros_like)
+from .linalg import *  # noqa: F401,F403
+from .linalg import (cholesky, corrcoef, cov, det, dist, eig, eigh, eigvalsh,
+                     einsum, histogram, inverse, lstsq, matrix_power,
+                     matrix_rank, norm, pinv, qr, slogdet, solve, svd,
+                     triangular_solve)
+from .manipulation import *  # noqa: F401,F403
+from .manipulation import (as_complex, as_real, broadcast_tensors, broadcast_to,
+                           cast, chunk, concat, conj, expand, expand_as,
+                           flatten, flip, gather, gather_nd, getitem, imag,
+                           index_add, index_put, index_select, masked_fill,
+                           masked_select, moveaxis, numel, pad, put_along_axis,
+                           real, repeat_interleave, reshape, roll, rot90,
+                           scatter, scatter_nd, scatter_nd_add, shape, slice,
+                           split, squeeze, stack, swapaxes, t, take_along_axis,
+                           tensordot, tile, transpose, unbind, unsqueeze,
+                           unstack, where)
+from .math import *  # noqa: F401,F403
+from . import math as _math_mod
+from .math import (abs, acos, add, addmm, all, allclose, amax, amin, any,
+                   argmax, argmin, argsort, asin, atan, atan2, bincount, bmm,
+                   ceil, clip, cos, cosh, count_nonzero, cross, cumprod, cumsum,
+                   diff, digamma, divide, dot, equal, equal_all, erf, erfinv,
+                   exp, expm1, floor, floor_divide, fmax, fmin, frac, greater_equal,
+                   greater_than, inner, isclose, isfinite, isinf, isnan, kron,
+                   kthvalue, less_equal, less_than, lgamma, log, log1p, log2,
+                   log10, logical_and, logical_not, logical_or, logical_xor,
+                   logit, logsumexp, matmul, max, maximum, mean, median, min,
+                   minimum, mm, mod, multiply, nan_to_num, neg, nonzero,
+                   not_equal, outer, pow, prod, reciprocal, remainder, round,
+                   rsqrt, scale, searchsorted, sigmoid, sign, sin, sinh, sort,
+                   sqrt, square, stanh, std, subtract, sum, tan, tanh, topk,
+                   trace, trunc, unique, var)
+
+
+def _install_tensor_methods():
+    """Attach op methods + dunders to Tensor (paddle's tensor method surface)."""
+    methods = {
+        # math
+        "add": add, "subtract": subtract, "multiply": multiply, "divide": divide,
+        "matmul": matmul, "mm": mm, "bmm": bmm, "pow": pow, "abs": abs,
+        "sqrt": sqrt, "rsqrt": rsqrt, "exp": exp, "log": log, "sin": sin,
+        "cos": cos, "tanh": tanh, "sigmoid": sigmoid, "floor": floor,
+        "ceil": ceil, "round": round, "square": square, "reciprocal": reciprocal,
+        "neg": neg, "sign": sign, "clip": clip, "scale": scale, "erf": erf,
+        "maximum": maximum, "minimum": minimum, "remainder": remainder,
+        "mod": mod, "floor_divide": floor_divide, "trunc": trunc,
+        # reductions
+        "sum": sum, "mean": mean, "max": max, "min": min, "prod": prod,
+        "std": std, "var": var, "logsumexp": logsumexp, "cumsum": cumsum,
+        "cumprod": cumprod, "argmax": argmax, "argmin": argmin,
+        "argsort": argsort, "sort": sort, "topk": topk, "all": all, "any": any,
+        "median": median, "amax": amax, "amin": amin,
+        # comparisons
+        "equal": equal, "not_equal": not_equal, "greater_than": greater_than,
+        "greater_equal": greater_equal, "less_than": less_than,
+        "less_equal": less_equal, "equal_all": equal_all, "allclose": allclose,
+        "isclose": isclose, "isnan": isnan, "isinf": isinf,
+        "isfinite": isfinite, "logical_and": logical_and,
+        "logical_or": logical_or, "logical_not": logical_not,
+        "logical_xor": logical_xor,
+        # manipulation
+        "reshape": reshape, "transpose": transpose, "flatten": flatten,
+        "squeeze": squeeze, "unsqueeze": unsqueeze, "flip": flip, "roll": roll,
+        "tile": tile, "expand": expand, "expand_as": expand_as,
+        "broadcast_to": broadcast_to, "gather": gather, "gather_nd": gather_nd,
+        "index_select": index_select, "masked_select": masked_select,
+        "masked_fill": masked_fill, "where": where, "split": split,
+        "chunk": chunk, "unbind": unbind, "cast": cast, "astype": cast,
+        "concat": concat, "stack": stack, "t": t, "norm": norm, "dot": dot,
+        "dist": dist, "take_along_axis": take_along_axis,
+        "put_along_axis": put_along_axis, "repeat_interleave": repeat_interleave,
+        "tril": tril, "triu": triu, "unique": unique, "nonzero": nonzero,
+        "scatter": scatter, "index_add": index_add, "kron": kron,
+        "outer": outer, "inner": inner, "trace": trace, "diff": diff,
+        "lerp": lerp, "nan_to_num": nan_to_num, "logit": logit,
+    }
+    for name, fn in methods.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # dunders
+    Tensor.__add__ = lambda s, o: add(s, _coerce(o))
+    Tensor.__radd__ = lambda s, o: add(_coerce(o), s)
+    Tensor.__sub__ = lambda s, o: subtract(s, _coerce(o))
+    Tensor.__rsub__ = lambda s, o: subtract(_coerce(o), s)
+    Tensor.__mul__ = lambda s, o: multiply(s, _coerce(o))
+    Tensor.__rmul__ = lambda s, o: multiply(_coerce(o), s)
+    Tensor.__truediv__ = lambda s, o: divide(s, _coerce(o))
+    Tensor.__rtruediv__ = lambda s, o: divide(_coerce(o), s)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, _coerce(o))
+    Tensor.__mod__ = lambda s, o: remainder(s, _coerce(o))
+    Tensor.__pow__ = lambda s, o: pow(s, _coerce(o))
+    Tensor.__rpow__ = lambda s, o: pow(_coerce(o), s)
+    Tensor.__matmul__ = lambda s, o: matmul(s, _coerce(o))
+    Tensor.__rmatmul__ = lambda s, o: matmul(_coerce(o), s)
+    Tensor.__neg__ = lambda s: neg(s)
+    Tensor.__abs__ = lambda s: abs(s)
+    Tensor.__eq__ = lambda s, o: equal(s, _coerce(o))
+    Tensor.__ne__ = lambda s, o: not_equal(s, _coerce(o))
+    Tensor.__lt__ = lambda s, o: less_than(s, _coerce(o))
+    Tensor.__le__ = lambda s, o: less_equal(s, _coerce(o))
+    Tensor.__gt__ = lambda s, o: greater_than(s, _coerce(o))
+    Tensor.__ge__ = lambda s, o: greater_equal(s, _coerce(o))
+    Tensor.__invert__ = lambda s: logical_not(s)
+    Tensor.__and__ = lambda s, o: (logical_and if s.dtype == jnp.bool_ else bitwise_and)(s, _coerce(o))
+    Tensor.__or__ = lambda s, o: (logical_or if s.dtype == jnp.bool_ else bitwise_or)(s, _coerce(o))
+    Tensor.__xor__ = lambda s, o: (logical_xor if s.dtype == jnp.bool_ else bitwise_xor)(s, _coerce(o))
+    Tensor.__getitem__ = lambda s, idx: getitem(s, idx)
+
+    def _setitem_inplace(s, idx, value):
+        from .manipulation import _setitem
+        idx = _coerce_index(idx)
+        v = value.value if isinstance(value, Tensor) else value
+        out = _setitem(s, idx, v)
+        s._value = out.value
+        s._grad_node = out._grad_node
+        s._out_index = out._out_index
+        s.stop_gradient = s.stop_gradient and out.stop_gradient
+
+    Tensor.__setitem__ = _setitem_inplace
+
+
+def _coerce(o):
+    return o if isinstance(o, Tensor) else Tensor(o)
+
+
+def _coerce_index(idx):
+    import jax
+    return jax.tree.map(lambda v: v.value if isinstance(v, Tensor) else v, idx,
+                        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+from .math import (bitwise_and, bitwise_not, bitwise_or, bitwise_xor, lerp)  # noqa: E402
+
+_install_tensor_methods()

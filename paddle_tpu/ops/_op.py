@@ -1,0 +1,132 @@
+"""Op application machinery — the TPU analog of the phi kernel dispatch layer.
+
+In the reference every op goes Python → ``_C_ops`` pybind → generated
+``*_ad_func`` (records a GradNode) → phi kernel (``paddle/phi/core/
+kernel_factory.cc`` SelectKernel → CUDA kernel). Here every op is a pure
+jnp/lax function; :func:`apply` is the single dispatch point that
+
+1. unwraps Tensor arguments to jax values,
+2. in eager-grad mode records a ``jax.vjp`` GradNode for the tape,
+3. wraps results back into Tensors.
+
+Inside jit-traced step functions gradient recording is disabled (``no_grad``)
+and the wrapper is a zero-cost pass-through over tracers, so the whole op
+library is jit/grad/vmap/shard_map-compatible by construction — XLA sees only
+the pure jnp ops.
+
+An op *registry* (name → fn) is kept so tests, the static-graph surface and
+serialization can enumerate the op library like the reference's
+``OpInfoMap``/``KernelFactory``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..autograd.engine import GradNode
+from ..core.tensor import Tensor
+
+OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def apply(fn, args, kwargs, differentiable=True, name=""):
+    flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    vals = [x.value if isinstance(x, Tensor) else x for x in flat]
+
+    # AMP O1/O2: cast tensor inputs per white/black list (no-op when disabled)
+    from ..amp import amp_state, amp_cast_inputs
+    if amp_state().enabled and tensor_pos:
+        cast_vals = amp_cast_inputs(name, [vals[i] for i in tensor_pos])
+        for i, cv in zip(tensor_pos, cast_vals):
+            vals[i] = cv
+
+    # Only inexact-dtype tensors carry gradients (int/bool indices never do).
+    diff_pos = [
+        i for i in tensor_pos
+        if not flat[i].stop_gradient and jnp.issubdtype(jnp.result_type(vals[i]), jnp.inexact)
+    ]
+    need_grad = differentiable and engine.is_grad_enabled() and bool(diff_pos)
+
+    if not need_grad:
+        a, k = jax.tree.unflatten(treedef, vals)
+        out = fn(*a, **k)
+        return _wrap(out, stop_gradient=True)
+
+    def pure(*diff_vals):
+        v = list(vals)
+        for p, dv in zip(diff_pos, diff_vals):
+            v[p] = dv
+        a, k = jax.tree.unflatten(treedef, v)
+        return fn(*a, **k)
+
+    out, vjp_fn = jax.vjp(pure, *[vals[p] for p in diff_pos])
+    out_flat, out_treedef = jax.tree.flatten(out)
+    structs = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
+
+    def vjp_wrapper(cot_tree, _vjp=vjp_fn):
+        return _vjp(cot_tree)
+
+    node = GradNode(vjp_wrapper, [flat[p] for p in diff_pos], structs,
+                    out_treedef, name=name)
+
+    wrapped = []
+    for i, o in enumerate(out_flat):
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            t = Tensor(o, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+        else:
+            # Integer/bool outputs (indices etc.) are never differentiable.
+            t = Tensor(o, stop_gradient=True)
+        wrapped.append(t)
+    return jax.tree.unflatten(out_treedef, wrapped)
+
+
+def _wrap(out, stop_gradient=True):
+    leaves, treedef = jax.tree.flatten(out)
+    return jax.tree.unflatten(
+        treedef, [Tensor(o, stop_gradient=stop_gradient) for o in leaves])
+
+
+def tensor_op(fn=None, *, differentiable=True, name=None):
+    """Decorator turning a pure jnp function into a Tensor-level framework op."""
+    def deco(f):
+        op_name = name or f.__name__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return apply(f, args, kwargs, differentiable=differentiable, name=op_name)
+
+        wrapper.raw_fn = f
+        wrapper.op_name = op_name
+        OP_REGISTRY[op_name] = wrapper
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def unwrap(x):
+    """Tensor → jax value (identity for non-Tensors)."""
+    if isinstance(x, Tensor):
+        return x.value
+    return x
+
+
+def unwrap_tree(tree):
+    return jax.tree.map(lambda x: x.value if isinstance(x, Tensor) else x, tree,
+                        is_leaf=_is_tensor)
+
+
+def wrap(value, stop_gradient=True):
+    return Tensor(value, stop_gradient=stop_gradient)

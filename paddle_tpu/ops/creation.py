@@ -1,0 +1,219 @@
+"""Tensor creation ops (reference: ``python/paddle/tensor/creation.py`` +
+phi full/empty/arange kernels). Random ops draw keys from the framework RNG
+(:mod:`paddle_tpu.core.random`) so they are deterministic per seed and
+trace-safe under an ``rng_scope``."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ._op import tensor_op, unwrap
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.to_jax_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    from ..core.tensor import to_tensor as _tt
+    return _tt(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype, jnp.result_type(fill_value))))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@tensor_op
+def _zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op
+def _ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op
+def _full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like(x, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value, dtype=dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = jnp.result_type(start, end, step)
+        if jnp.issubdtype(dtype, jnp.integer):
+            dtype = jnp.int64
+    return Tensor(jnp.arange(start, end, step, dtype=dtype_mod.to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    base = jnp.diag(v, k=offset)
+    if v.ndim == 1 and padding_value != 0:
+        mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+        base = jnp.where(mask, base, jnp.asarray(padding_value, base.dtype))
+    return Tensor(base)
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), k=offset))
+
+
+@tensor_op
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@tensor_op
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    arrays = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[unwrap(a) for a in arrays], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@tensor_op
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    result = _assign(x if isinstance(x, Tensor) else Tensor(x))
+    if output is not None:
+        output.set_value(result.value)
+        return output
+    return result
+
+
+def clone(x, name=None):
+    return _assign(x)
+
+
+# ----------------------------------------------------------------- random ops
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(random_mod.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(random_mod.next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = []
+    out = jax.random.normal(random_mod.next_key(), _shape(shape),
+                            dtype_mod.get_default_dtype())
+    return Tensor(out * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.to_jax_dtype(dtype) or dtype_mod.long_dtype()
+    return Tensor(jax.random.randint(random_mod.next_key(), _shape(shape), low, high,
+                                     dtype=d))
+
+
+def randperm(n, dtype=None, name=None):
+    d = dtype_mod.to_jax_dtype(dtype) or dtype_mod.long_dtype()
+    return Tensor(jax.random.permutation(random_mod.next_key(), n).astype(d))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = unwrap(x)
+    logits = jnp.log(jnp.clip(v, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(random_mod.next_key(), logits,
+                                     shape=v.shape[:-1] + (num_samples,))
+    else:
+        k = random_mod.next_key()
+        g = jax.random.gumbel(k, v.shape)
+        out = jnp.argsort(logits + g, axis=-1)[..., ::-1][..., :num_samples]
+    return Tensor(out.astype(dtype_mod.long_dtype()))
+
+
+def bernoulli(x, name=None):
+    v = unwrap(x)
+    return Tensor((jax.random.uniform(random_mod.next_key(), v.shape) < v).astype(v.dtype))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)

@@ -1,0 +1,144 @@
+"""Linear-algebra ops (reference: ``python/paddle/tensor/linalg.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import tensor_op
+
+
+@tensor_op
+def norm(x, p="fro", axis=None, keepdim=False):
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if p == "fro":
+        p = 2
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim),
+                     1.0 / p)
+
+
+@tensor_op
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@tensor_op
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@tensor_op
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@tensor_op
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@tensor_op
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@tensor_op
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@tensor_op
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@tensor_op
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@tensor_op
+def eig(x):
+    # jnp.linalg.eig is CPU-only in XLA; route through host like the reference's
+    # cusolver-unsupported fallbacks.
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@tensor_op
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@tensor_op
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@tensor_op
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@tensor_op
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@tensor_op
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@tensor_op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@tensor_op
+def lstsq(x, y, rcond=None):
+    return jnp.linalg.lstsq(x, y, rcond=rcond)
+
+
+@tensor_op
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@tensor_op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@tensor_op
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@tensor_op
+def histogram(x, bins=100, min=0, max=0):
+    range_ = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=range_)
+    return hist

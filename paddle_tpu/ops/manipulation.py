@@ -1,0 +1,399 @@
+"""Shape / indexing manipulation ops (reference:
+``python/paddle/tensor/manipulation.py`` over phi kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._op import tensor_op, unwrap
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+@tensor_op
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, _norm_shape(shape))
+
+
+view = reshape
+
+
+@tensor_op
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+@tensor_op
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@tensor_op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@tensor_op
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@tensor_op
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(unwrap(axis)))
+
+
+@tensor_op
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+@tensor_op
+def _split_sections(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # list of sizes, possibly with one -1
+    sizes = [int(unwrap(s)) for s in sections]
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = int(np.sum([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = total - known
+    offsets = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    return list(_split_sections(x, num_or_sections, int(unwrap(axis))))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+@tensor_op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@tensor_op
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in sorted(int(unwrap(v)) for v in axis):
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, int(unwrap(axis)))
+
+
+@tensor_op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@tensor_op
+def flip(x, axis):
+    return jnp.flip(x, axis=axis if isinstance(axis, int) else tuple(axis))
+
+
+@tensor_op
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@tensor_op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@tensor_op
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, _norm_shape(repeat_times))
+
+
+@tensor_op
+def _broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return _broadcast_to(x, _norm_shape(shape))
+
+
+def expand(x, shape, name=None):
+    shape = _norm_shape(shape)
+    # paddle allows -1 meaning "keep this dim"
+    xshape = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    shape = tuple(xs if s == -1 else s for s, xs in zip(shape, xshape))
+    return _broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return _broadcast_to(x, tuple(y.shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [_broadcast_to(t, tuple(out_shape)) for t in inputs]
+
+
+@tensor_op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@tensor_op
+def gather(x, index, axis=0):
+    index = jnp.reshape(index, (-1,))
+    return jnp.take(x, index, axis=int(unwrap(axis)))
+
+
+index_select = gather
+
+
+@tensor_op
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@tensor_op
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@tensor_op
+def put_along_axis(arr, indices, values, axis, reduce="assign", broadcast=True):
+    if not isinstance(values, (jnp.ndarray, jax.Array)) or getattr(values, "ndim", 0) == 0:
+        values = jnp.full(indices.shape, values, dtype=arr.dtype)
+    values = jnp.broadcast_to(values, indices.shape).astype(arr.dtype)
+    dnums = tuple(i for i in range(arr.ndim) if i != axis)
+    idx_grid = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx = list(idx_grid)
+    idx[axis] = indices
+    idx = tuple(idx)
+    if reduce == "assign":
+        return arr.at[idx].set(values)
+    if reduce in ("add", "sum"):
+        return arr.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return arr.at[idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@tensor_op
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@tensor_op
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from . import creation
+    zeros = creation.zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+@tensor_op
+def index_add(x, index, axis, value):
+    index = jnp.reshape(index, (-1,))
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@tensor_op
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@tensor_op(differentiable=False)
+def masked_select(x, mask):
+    # data-dependent shape: eager-only (host sync), like reference's masked_select
+    xn = np.asarray(x)
+    mn = np.asarray(mask)
+    return jnp.asarray(xn[mn])
+
+
+@tensor_op
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@tensor_op
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+@tensor_op
+def _getitem(x, idx):
+    return x[idx]
+
+
+def getitem(x, idx):
+    idx = jax.tree.map(lambda v: v.value if isinstance(v, Tensor) else v, idx,
+                       is_leaf=lambda v: isinstance(v, Tensor))
+    return _getitem(x, idx)
+
+
+@tensor_op
+def _setitem(x, idx, value):
+    return x.at[idx].set(value)
+
+
+@tensor_op
+def slice(input, axes, starts, ends):
+    out = input
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(unwrap(s))
+        e = int(unwrap(e))
+        size = input.shape[ax]
+        s = max(s + size, 0) if s < 0 else min(s, size)
+        e = max(e + size, 0) if e < 0 else min(e, size)
+        out = jax.lax.slice_in_dim(out, s, e, axis=ax)
+    return out
+
+
+@tensor_op
+def _pad_nd(x, pad_width, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pad_width, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics: ``pad`` is either len-2*ndim
+    (all dims, paddle "int list" form) or the last-dims-first torch-style list
+    applied to spatial dims of NCHW/NHWC/NCL/NCDHW layouts."""
+    pad = [int(unwrap(p)) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        return _pad_nd(x, width, mode, value)
+    # spatial form
+    n_spatial = len(pad) // 2
+    width = [(0, 0)] * nd
+    if data_format.startswith("NC"):
+        spatial_axes = list(range(2, 2 + n_spatial))
+    else:  # NHWC-style: spatial dims are 1..n
+        spatial_axes = list(range(1, 1 + n_spatial))
+    # paddle pad order: last spatial dim first? paddle uses (left, right, top, bottom...)
+    # with pairs ordered from the *first* spatial dim outward per its docs for NCHW:
+    # [pad_left, pad_right, pad_top, pad_bottom] applies W then H — i.e. reversed.
+    for i, ax in enumerate(reversed(spatial_axes)):
+        width[ax] = (pad[2 * i], pad[2 * i + 1])
+    return _pad_nd(x, width, mode, value)
+
+
+@tensor_op
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtype_mod.to_jax_dtype(dtype))
+
+
+astype = cast
+
+
+def numel(x):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype_mod.long_dtype()))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+@tensor_op
+def as_strided_like_view(x):  # placeholder parity stub
+    return x
+
+
+@tensor_op
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@tensor_op
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@tensor_op
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@tensor_op
+def real(x):
+    return jnp.real(x)
+
+
+@tensor_op
+def imag(x):
+    return jnp.imag(x)
+
+
+@tensor_op
+def conj(x):
+    return jnp.conj(x)

@@ -1,0 +1,488 @@
+"""Math / reduction / comparison ops (reference:
+``python/paddle/tensor/{math,logic,search,stat}.py`` over phi kernels).
+
+Each op is the paddle-shaped signature over a pure jnp body; gradients come
+from jax's VJPs through :func:`paddle_tpu.ops._op.tensor_op`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ._op import tensor_op, unwrap
+
+# ----------------------------------------------------------------- elementwise
+
+
+@tensor_op
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@tensor_op
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@tensor_op
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@tensor_op
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@tensor_op
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@tensor_op
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@tensor_op
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@tensor_op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+@tensor_op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if bias_after_scale:
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    return out
+
+
+@tensor_op
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@tensor_op
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@tensor_op
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@tensor_op
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def _unary(name, fn):
+    @tensor_op(name=name)
+    def op(x):
+        return fn(x)
+    op.__name__ = name
+    return op
+
+
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+
+
+@tensor_op
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@tensor_op
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@tensor_op
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@tensor_op
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@tensor_op
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@tensor_op
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@tensor_op
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@tensor_op
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ----------------------------------------------------------------- reductions
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(unwrap(a)) for a in axis)
+    return int(unwrap(axis))
+
+
+@tensor_op
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = dtype_mod.to_jax_dtype(dtype)
+    if d is None and jnp.issubdtype(jnp.result_type(x), jnp.bool_):
+        d = dtype_mod.long_dtype()
+    return jnp.sum(x, axis=_axis(axis), dtype=d, keepdims=keepdim)
+
+
+@tensor_op
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype_mod.to_jax_dtype(dtype),
+                    keepdims=keepdim)
+
+
+@tensor_op
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@tensor_op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@tensor_op
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.cumsum(x, axis=_axis(axis), dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.reshape(x, (-1,))
+        dim = 0
+    return jnp.cumprod(x, axis=_axis(dim), dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op
+def cummax(x, axis=-1):
+    return jax.lax.cummax(x, axis=axis)
+
+
+@tensor_op
+def cummin(x, axis=-1):
+    return jax.lax.cummin(x, axis=axis)
+
+
+@tensor_op(differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim).astype(dtype_mod.long_dtype())
+
+
+# ----------------------------------------------------------------- search/sort
+@tensor_op(differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmax(jnp.reshape(x, (-1,)))
+        if keepdim:
+            out = jnp.reshape(out, (1,) * x.ndim)
+        return out.astype(dtype_mod.to_jax_dtype(dtype))
+    out = jnp.argmax(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op(differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmin(jnp.reshape(x, (-1,)))
+        if keepdim:
+            out = jnp.reshape(out, (1,) * x.ndim)
+        return out.astype(dtype_mod.to_jax_dtype(dtype))
+    out = jnp.argmin(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op(differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(dtype_mod.long_dtype())
+
+
+@tensor_op
+def sort(x, axis=-1, descending=False, stable=True):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+@tensor_op
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k = int(unwrap(k))
+    axis = int(axis) if axis is not None else -1
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(dtype_mod.long_dtype()), -1, axis)
+
+
+@tensor_op
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    take = jnp.take(vals, k - 1, axis=axis)
+    take_i = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        take = jnp.expand_dims(take, axis)
+        take_i = jnp.expand_dims(take_i, axis)
+    return take, take_i.astype(dtype_mod.long_dtype())
+
+
+@tensor_op(differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else dtype_mod.long_dtype())
+
+
+@tensor_op(differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # NOTE: data-dependent output shape — eager-only op (not jittable), same as
+    # the reference where unique is a host-synchronizing op.
+    import numpy as np
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@tensor_op(differentiable=False)
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i)[:, None] for i in idx)
+    return jnp.stack([jnp.asarray(i) for i in idx], axis=1).astype(dtype_mod.long_dtype())
+
+
+@tensor_op(differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+# ----------------------------------------------------------------- comparison
+def _cmp(name, fn):
+    @tensor_op(name=name, differentiable=False)
+    def op(x, y):
+        return fn(x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+
+@tensor_op(differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@tensor_op(differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@tensor_op(differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+@tensor_op(differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@tensor_op(differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@tensor_op(differentiable=False)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@tensor_op(differentiable=False)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# ----------------------------------------------------------------- linalg-lite
+@tensor_op
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@tensor_op
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@tensor_op
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@tensor_op
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@tensor_op
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@tensor_op
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@tensor_op
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else None
+    if ax is None:
+        # first axis with dim 3, paddle default
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=ax)
+
+
+@tensor_op
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)

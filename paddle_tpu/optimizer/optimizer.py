@@ -1,0 +1,435 @@
+"""Optimizers (reference: ``python/paddle/optimizer/optimizer.py`` + per-op
+CUDA kernels like ``paddle/phi/kernels/gpu/adamw_kernel.cu``).
+
+Each optimizer is defined by a *pure functional core*:
+
+- ``_init_slots(param) -> dict[str, array]``
+- ``_update(param, grad, slots, lr, step, pstate) -> (new_param, new_slots)``
+
+The eager ``step()`` applies it per-parameter from ``p.grad`` (debug path);
+:mod:`paddle_tpu.jit` calls ``init_state`` / ``apply_gradients`` on pytrees
+inside the compiled train step, so the whole update fuses into the XLA program
+(the TPU answer to the reference's fused multi-tensor CUDA optimizers).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be provided (eager-style optimizer; pass "
+                "model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._coeff = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._coeff = float(weight_decay)
+        else:  # L2Decay object
+            self._coeff = float(getattr(weight_decay, "_coeff",
+                                        getattr(weight_decay, "coeff", 0.0)))
+        self._slots: Dict[int, dict] = {}
+        self._step_count = 0
+        # decoupled weight decay (AdamW) vs L2-regularization-into-grad
+        self._decoupled_wd = False
+
+    # ------------------------------------------------------------ lr plumbing
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # ------------------------------------------------------------ pure core
+    def _init_slots(self, param_value) -> dict:
+        return {}
+
+    def _update(self, param, grad, slots, lr, step):
+        raise NotImplementedError
+
+    def _param_lr(self, p) -> float:
+        attr = getattr(p, "optimize_attr", None)
+        if attr:
+            return float(attr.get("learning_rate", 1.0))
+        return 1.0
+
+    # ------------------------------------------------------------ eager path
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        self._apply_params_grads(params_grads)
+
+    def _apply_params_grads(self, params_grads):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sid = id(p)
+            if sid not in self._slots:
+                self._slots[sid] = self._init_slots(p.value)
+            gv = g.value if isinstance(g, Tensor) else g
+            if self._coeff and not self._decoupled_wd:
+                gv = gv + self._coeff * p.value
+            new_p, new_slots = self._update(
+                p.value, gv, self._slots[sid], lr * self._param_lr(p),
+                self._step_count)
+            p._rebind(new_p.astype(p.dtype))
+            self._slots[sid] = new_slots
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ------------------------------------------------------------ jit path
+    def init_state(self, params_tree):
+        """Pure: pytree of param arrays -> optimizer state pytree."""
+        slots = jax.tree.map(self._init_slots, params_tree)
+        return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params_tree, grads_tree, state, lr=None):
+        """Pure: returns (new_params_tree, new_state). Used inside jit."""
+        if lr is None:
+            lr = self.get_lr()
+        if self._grad_clip is not None:
+            grads_tree = self._grad_clip.apply_pure(grads_tree)
+        step = state["step"] + 1
+
+        def upd(p, g, s):
+            gv = g
+            if self._coeff and not self._decoupled_wd:
+                gv = gv + self._coeff * p
+            new_p, new_s = self._update(p, gv, s, lr, step)
+            return new_p.astype(p.dtype), new_s
+
+        flat_p, tdef = jax.tree.flatten(params_tree)
+        flat_g = tdef.flatten_up_to(grads_tree)
+        flat_s = tdef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree.unflatten(tdef, new_p),
+                {"slots": jax.tree.unflatten(tdef, new_s), "step": step})
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self):
+        out = {}
+        for i, p in enumerate(self._parameter_list):
+            slots = self._slots.get(id(p))
+            if slots:
+                key = p.name or f"param_{i}"
+                for sname, sval in slots.items():
+                    out[f"{key}.{sname}"] = Tensor(sval)
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            slots = {}
+            for sk, sv in state.items():
+                if sk.startswith(key + "."):
+                    v = sv.value if isinstance(sv, Tensor) else jnp.asarray(sv)
+                    slots[sk[len(key) + 1:]] = v
+            if slots:
+                self._slots[id(p)] = slots
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, param, grad, slots, lr, step):
+        return param - lr * grad.astype(param.dtype), slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, param_value):
+        return {"velocity": jnp.zeros_like(param_value, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            delta = g + self._momentum * v
+        else:
+            delta = v
+        return param - lr * delta.astype(param.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, param_value):
+        s = {"moment1": jnp.zeros_like(param_value, jnp.float32),
+             "moment2": jnp.zeros_like(param_value, jnp.float32)}
+        # fp32 master weights only for low-precision params (multi_precision)
+        if param_value.dtype != jnp.float32:
+            s["master"] = param_value.astype(jnp.float32)
+        return s
+
+    def _adam_delta(self, grad, slots, step):
+        g = grad.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        return mhat / (jnp.sqrt(vhat) + self._epsilon), m, v
+
+    def _update(self, param, grad, slots, lr, step):
+        delta, m, v = self._adam_delta(grad, slots, step)
+        master = slots.get("master", param.astype(jnp.float32)) - lr * delta
+        out = {"moment1": m, "moment2": v}
+        if "master" in slots:
+            out["master"] = master
+        return master.astype(param.dtype), out
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference ``adamw_kernel.cu`` semantics:
+    param -= lr * coeff * param before the adam update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else float(getattr(weight_decay, "_coeff", 0.01))
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_mask = None  # optional pytree mask for the pure path
+
+    def _update(self, param, grad, slots, lr, step, decay=True):
+        delta, m, v = self._adam_delta(grad, slots, step)
+        master = slots.get("master", param.astype(jnp.float32))
+        if decay and self._coeff:
+            master = master * (1.0 - lr * self._coeff)
+        master = master - lr * delta
+        out = {"moment1": m, "moment2": v}
+        if "master" in slots:
+            out["master"] = master
+        return master.astype(param.dtype), out
+
+    def _apply_params_grads(self, params_grads):
+        # honor apply_decay_param_fun per-parameter in the eager path
+        if self._apply_decay_param_fun is None:
+            return super()._apply_params_grads(params_grads)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sid = id(p)
+            if sid not in self._slots:
+                self._slots[sid] = self._init_slots(p.value)
+            decay = self._apply_decay_param_fun(p.name or "")
+            new_p, new_slots = self._update(
+                p.value, g.value, self._slots[sid], lr * self._param_lr(p),
+                self._step_count, decay=decay)
+            p._rebind(new_p.astype(p.dtype))
+            self._slots[sid] = new_slots
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, param_value):
+        return {"moment": jnp.full_like(param_value, self._init_acc, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        acc = slots["moment"] + jnp.square(g)
+        new = param - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new.astype(param.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_slots(self, param_value):
+        s = {"mean_square": jnp.zeros_like(param_value, jnp.float32),
+             "momentum": jnp.zeros_like(param_value, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param_value, jnp.float32)
+        return s
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out = dict(slots, mean_square=ms)
+        denom = ms
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            out["mean_grad"] = mg
+            denom = ms - jnp.square(mg)
+        mom = self._momentum * slots["momentum"] + lr * g / jnp.sqrt(
+            denom + self._epsilon)
+        out["momentum"] = mom
+        return param - mom.astype(param.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slots(self, param_value):
+        return {"avg_squared_grad": jnp.zeros_like(param_value, jnp.float32),
+                "avg_squared_update": jnp.zeros_like(param_value, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return param - lr * upd.astype(param.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, param_value):
+        return {"moment": jnp.zeros_like(param_value, jnp.float32),
+                "inf_norm": jnp.zeros_like(param_value, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        new = param - (lr / (1 - self._beta1 ** t)) * (
+            m / (u + self._epsilon)).astype(param.dtype)
+        return new, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, param_value):
+        return {"moment1": jnp.zeros_like(param_value, jnp.float32),
+                "moment2": jnp.zeros_like(param_value, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step, decay=True):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        wd = self._wd if decay else 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(param.dtype), {
+            "moment1": m, "moment2": v}
+
+    def _apply_params_grads(self, params_grads):
+        if self._exclude_fn is None:
+            return super()._apply_params_grads(params_grads)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sid = id(p)
+            if sid not in self._slots:
+                self._slots[sid] = self._init_slots(p.value)
+            decay = not self._exclude_fn(p)
+            new_p, new_slots = self._update(
+                p.value, g.value, self._slots[sid], lr * self._param_lr(p),
+                self._step_count, decay=decay)
+            p._rebind(new_p.astype(p.dtype))
+            self._slots[sid] = new_slots

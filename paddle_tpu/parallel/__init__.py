@@ -1,0 +1,52 @@
+"""paddle_tpu.parallel — the distributed stack (reference:
+``python/paddle/distributed/``), re-exported as ``paddle_tpu.distributed``.
+
+Layering (SURVEY.md §2.3):
+- env:            process bootstrap (jax.distributed) — init_parallel_env
+- mesh:           device-mesh manager; Group = mesh axis (ProcessGroup facade)
+- communication:  eager collective API (XLA shard_map programs)
+- fleet:          Fleet facade, DistributedStrategy, HybridCommunicateGroup
+- mp/pp/sharding/sp/moe: the parallel layer libraries
+- checkpoint:     distributed sharded checkpoint w/ reshard-on-load
+- launch:         multi-host launcher CLI
+"""
+from __future__ import annotations
+
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized)
+from .mesh import Group, build_mesh, ensure_mesh, get_mesh, new_group, set_mesh
+from .communication import (ReduceOp, all_gather, all_reduce, alltoall,
+                            barrier, broadcast, recv, reduce, reduce_scatter,
+                            scatter, send)
+from ..nn.parallel import DataParallel
+
+from . import fleet  # noqa: E402
+from . import checkpoint  # noqa: E402
+from .checkpoint import load_state_dict, save_state_dict  # noqa: E402
+from .fleet import mp as _mp  # noqa: E402
+from . import moe  # noqa: E402
+from .sharding_api import group_sharded_parallel, save_group_sharded_model  # noqa: E402
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity. On TPU the unit of spawn is a host
+    process driving all local chips; with one host this runs func(rank=0)
+    inline (tests use it for the serial-vs-parallel oracle pattern)."""
+    import multiprocessing as mp
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=func, args=args, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+
+
+def get_group(gid=0):
+    from .mesh import world_group
+    return world_group()

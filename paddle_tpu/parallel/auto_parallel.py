@@ -1,0 +1,159 @@
+"""Semi-auto parallel API (reference:
+``python/paddle/distributed/auto_parallel/api.py`` — ``ProcessMesh``,
+``shard_tensor`` with placements, static Engine with completion/partitioner/
+reshard).
+
+This is the reference subsystem that most directly *is* GSPMD (SURVEY.md
+§3.4): here ``shard_tensor`` places a global array with a NamedSharding and
+the completion/partitioner/reshard pipeline is XLA's SPMD partitioner. The
+Engine facade compiles a jitted step from the same annotations.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """Reference ProcessMesh(mesh_array, dim_names) — wraps a jax Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None and isinstance(mesh, Mesh):
+            self._mesh = mesh
+            self.dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh if mesh is not None else process_ids)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self.dim_names = list(dim_names)
+        devices = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self._mesh = Mesh(devices, tuple(self.dim_names))
+        mesh_mod.set_mesh(self._mesh)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def shape(self):
+        return [self._mesh.shape[n] for n in self.dim_names]
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self.shape))))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> P:
+    spec = [None] * ndim
+    for axis_name, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Shard):
+            if spec[pl.dim] is None:
+                spec[pl.dim] = axis_name
+            elif isinstance(spec[pl.dim], tuple):
+                spec[pl.dim] = spec[pl.dim] + (axis_name,)
+            else:
+                spec[pl.dim] = (spec[pl.dim], axis_name)
+    return P(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements: List[Placement],
+                 dtype=None, stop_gradient=None) -> Tensor:
+    """Place a tensor on the mesh with the given placements; returns a Tensor
+    whose value is a global sharded jax Array (the DistTensor analog)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    v = jax.device_put(t.value, sharding)
+    out = Tensor(v, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    out.dist_spec = spec
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_local(x, mesh, placements):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_op(op, mesh: ProcessMesh, in_placements=None, out_placements=None):
+    """Annotate an op's outputs with placements."""
+    def wrapped(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if out_placements:
+            return shard_tensor(out, mesh, out_placements,
+                                stop_gradient=out.stop_gradient)
+        return out
+    return wrapped
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    return shard_tensor(x, mesh, placements, stop_gradient=x.stop_gradient)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Apply a per-parameter shard_fn(name, layer, mesh) over a Layer tree."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def get_mesh():
+    m = mesh_mod.get_mesh()
+    return ProcessMesh(m) if m is not None else None
+
+
+class Engine:
+    """auto_parallel.static Engine facade: fit/evaluate/predict over a jitted
+    step compiled from shard_tensor annotations (completion/partitioner/
+    reshard = XLA SPMD)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        from ..hapi.model import Model
+        self._model = Model(model)
+        self._model.prepare(optimizer, loss, metrics)
+
+    def fit(self, train_data, epochs=1, batch_size=1, **kwargs):
+        return self._model.fit(train_data, epochs=epochs,
+                               batch_size=batch_size, **kwargs)
+
+    def evaluate(self, valid_data, batch_size=1, **kwargs):
+        return self._model.evaluate(valid_data, batch_size=batch_size, **kwargs)
+
+    def predict(self, test_data, batch_size=1, **kwargs):
+        return self._model.predict(test_data, batch_size=batch_size, **kwargs)

@@ -1,0 +1,241 @@
+"""Communication API (reference: ``python/paddle/distributed/communication/``
+over ``ProcessGroupNCCL`` — all_reduce/all_gather/reduce_scatter/broadcast/
+send/recv/alltoall/scatter/barrier + async Task handles).
+
+TPU-native semantics: a collective is an XLA program over a mesh axis. Eager
+tensors here are *global* jax Arrays — sharded over the group's mesh axis
+(leading dim) or replicated. ``shard_map`` + ``lax.p*`` expresses the
+collective; XLA compiles it to ICI/DCN transfers. Inside jitted train steps
+you normally never call these — GSPMD inserts collectives from shardings;
+this API serves eager parity, tests, and the Fleet wrappers' host-side sync
+(param broadcast etc.).
+
+Async ``Task`` parity: jax dispatch is already asynchronous; ``wait()`` maps
+to ``block_until_ready``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+from .mesh import Group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Task:
+    def __init__(self, value):
+        self._value = value
+
+    def wait(self):
+        jax.block_until_ready(self._value)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _group(group) -> Group:
+    if group is None:
+        return mesh_mod.world_group()
+    return group
+
+
+def _axes(group: Group):
+    return group.axis_names if len(group.axis_names) > 1 else group.axis_names[0]
+
+
+@functools.lru_cache(maxsize=512)
+def _allreduce_prog(mesh, axes, op, shape, dtype, sharded_in):
+    in_spec = P(axes) if sharded_in else P()
+    red = {"sum": jax.lax.psum, "avg": jax.lax.pmean,
+           "max": jax.lax.pmax, "min": jax.lax.pmin}[op]
+
+    def f(x):
+        return red(x, axes)
+
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_spec,
+                                 out_specs=P() if not sharded_in else P()))
+
+
+def _is_sharded_over(value, group):
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        flat = [n for p in sh.spec if p is not None
+                for n in ((p,) if isinstance(p, str) else p)]
+        return any(a in flat for a in group.axis_names)
+    return False
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """Reduce a *sharded* tensor across the group axis; each shard is one
+    rank's contribution (leading-dim concat layout). Replicated input with
+    group world: identity-sum semantics (already equal on all ranks)."""
+    g = _group(group)
+    v = tensor.value
+    if g.nranks == 1:
+        return _Task(v)
+    axes = _axes(g)
+    if _is_sharded_over(v, g):
+        # per-rank shards along leading dim: psum over the axis
+        prog = jax.jit(
+            jax.shard_map(
+                lambda x: {"sum": jax.lax.psum, "avg": jax.lax.pmean,
+                           "max": jax.lax.pmax, "min": jax.lax.pmin}[op](x, axes),
+                mesh=g.mesh,
+                in_specs=P(axes),
+                out_specs=P()))
+        out = prog(v)
+    else:
+        # replicated across the group — allreduce(sum) of identical copies
+        # multiplies by nranks (matches running N identical processes)
+        if op == ReduceOp.SUM:
+            out = v * g.nranks
+        elif op == ReduceOp.AVG:
+            out = v
+        else:
+            out = v
+    tensor._rebind(out)
+    return _Task(out)
+
+
+def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
+               group: Optional[Group] = None, sync_op=True):
+    """Gather per-rank shards. Input: sharded over group axis (leading dim) ->
+    output list of per-rank Tensors (replicated)."""
+    g = _group(group)
+    v = tensor.value
+    if g.nranks == 1:
+        if tensor_list is not None:
+            tensor_list.append(Tensor(v))
+            return _Task(v)
+    axes = _axes(g)
+    if _is_sharded_over(v, g):
+        prog = jax.jit(jax.shard_map(
+            lambda x: jax.lax.all_gather(x, axes, axis=0),
+            mesh=g.mesh, in_specs=P(axes), out_specs=P()))
+        gathered = prog(v)  # [nranks, *local_shape] replicated
+    else:
+        gathered = jnp.broadcast_to(v[None], (g.nranks,) + v.shape)
+    parts = [Tensor(gathered[i]) for i in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.extend(parts)
+        return _Task(gathered)
+    return parts
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
+                   op=ReduceOp.SUM, group: Optional[Group] = None,
+                   sync_op=True):
+    """Each rank contributes a full tensor (list entries or stacked leading
+    dim); output shard for this process is written into ``tensor``."""
+    g = _group(group)
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        stacked = jnp.stack([t.value for t in tensor_or_tensor_list])
+    else:
+        stacked = tensor_or_tensor_list.value
+    if g.nranks == 1:
+        tensor._rebind(stacked.reshape(tensor.value.shape))
+        return _Task(tensor.value)
+    axes = _axes(g)
+    prog = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum_scatter(x, axes, scatter_dimension=0,
+                                       tiled=True),
+        mesh=g.mesh, in_specs=P(None), out_specs=P(axes)))
+    flat = stacked.reshape((-1,) + stacked.shape[2:]) if stacked.ndim > 1 else stacked
+    out = prog(flat)
+    tensor._rebind(out)
+    return _Task(out)
+
+
+def broadcast(tensor: Tensor, src=0, group: Optional[Group] = None,
+              sync_op=True):
+    """With single-controller SPMD there is one logical value per group —
+    broadcast is replication (the value from src is already the value)."""
+    g = _group(group)
+    return _Task(tensor.value)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0,
+            group: Optional[Group] = None, sync_op=True):
+    g = _group(group)
+    if tensor_list:
+        rank = 0  # single-controller: local shard is rank 0's in eager mode
+        tensor._rebind(tensor_list[rank].value)
+    return _Task(tensor.value)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None,
+             group: Optional[Group] = None, sync_op=True):
+    """List-of-tensors all-to-all. Single-controller eager semantics:
+    transpose the [src][dst] matrix of chunks."""
+    g = _group(group)
+    if isinstance(in_tensor_list, Tensor):
+        # tensor form: split leading dim into nranks chunks and swap
+        x = in_tensor_list.value
+        n = g.nranks
+        if g.nranks == 1:
+            return _Task(x)
+        axes = _axes(g)
+        prog = jax.jit(jax.shard_map(
+            lambda v: jax.lax.all_to_all(v, axes, split_axis=0, concat_axis=0,
+                                         tiled=True),
+            mesh=g.mesh, in_specs=P(axes), out_specs=P(axes)))
+        out = prog(x)
+        if out_tensor_list is not None and isinstance(out_tensor_list, Tensor):
+            out_tensor_list._rebind(out)
+            return _Task(out)
+        return _Task(out)
+    chunks = [t.value for t in in_tensor_list]
+    if out_tensor_list is not None:
+        for o, c in zip(out_tensor_list, chunks):
+            o._rebind(c)
+    return _Task(chunks)
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point eager send/recv across processes is expressed via "
+        "ppermute inside jitted pipeline schedules on TPU (parallel.pp); "
+        "host-side p2p uses the launch coordinator store")
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "see send(): use pipeline schedules / coordinator store on TPU")
+
+
+def barrier(group: Optional[Group] = None):
+    g = _group(group)
+    x = jnp.zeros((), jnp.int32)
+    if g.nranks == 1:
+        jax.block_until_ready(x)
+        return
+    axes = _axes(g)
+    prog = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, axes),
+                                 mesh=g.mesh, in_specs=P(), out_specs=P()))
+    jax.block_until_ready(prog(x))
+
+
+def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=False,
+                      use_calc_stream=False):
+    return all_reduce(tensor, op, group, sync_op)

@@ -1,0 +1,114 @@
+"""Process/distributed environment (reference:
+``python/paddle/distributed/parallel.py`` init_parallel_env +
+``ProcessGroupNCCL`` rendezvous via TCPStore).
+
+TPU-native model: **one process per host** (SURVEY.md §3.3); rendezvous is
+``jax.distributed.initialize`` against a coordinator (rank-0 host), after
+which every process sees the global device set. Collectives are XLA programs
+over meshes (paddle_tpu.parallel.mesh), not socket-level rings — there is no
+NCCL communicator to manage.
+
+Env convention (paddle-compatible): ``PADDLE_TRAINER_ID`` = process (host)
+rank, ``PADDLE_TRAINERS_NUM`` = process count, ``PADDLE_MASTER`` =
+coordinator ``ip:port`` (falls back to first entry of
+``PADDLE_TRAINER_ENDPOINTS``).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_STATE = {"initialized": False}
+
+
+def init_parallel_env():
+    """Initialize multi-host jax.distributed from paddle-style env vars.
+
+    Single-host (no env set): no-op beyond marking initialized — all local
+    devices are already visible.
+    """
+    if _STATE["initialized"]:
+        return
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    master = os.environ.get("PADDLE_MASTER")
+    if master is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            master = eps.split(",")[0]
+    if nproc > 1:
+        if master is None:
+            raise RuntimeError(
+                "multi-process run needs PADDLE_MASTER or "
+                "PADDLE_TRAINER_ENDPOINTS")
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=nproc, process_id=pid)
+    _STATE["initialized"] = True
+    return None
+
+
+def is_initialized() -> bool:
+    return _STATE["initialized"]
+
+
+def get_rank(group=None) -> int:
+    """Logical rank. Per-process (host) rank in the multi-host model; inside a
+    group, the caller's rank in that group's mesh ordering."""
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    return jax.process_index() if _STATE["initialized"] else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None) -> int:
+    """SPMD world width = number of devices (chips). This matches the
+    reference's nranks (1 process per GPU) — on TPU the 'workers' are chips
+    driven by per-host processes."""
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def get_process_count() -> int:
+    return jax.process_count()
+
+
+def get_process_index() -> int:
+    return jax.process_index()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Reference's ParallelEnv view over the env vars."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

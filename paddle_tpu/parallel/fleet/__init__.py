@@ -1,0 +1,59 @@
+"""paddle_tpu.parallel.fleet (reference: ``python/paddle/distributed/fleet``).
+
+Usage parity with the reference::
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy
+from .fleet import Fleet, fleet as _fleet_singleton
+from .hybrid_optimizer import HybridParallelOptimizer
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group)
+from . import mp
+from . import sp
+from . import meta_parallel as _meta_mod
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .recompute import recompute, recompute_hybrid, recompute_sequential
+
+
+class _MetaParallelNS:
+    """fleet.meta_parallel namespace (reference module layout)."""
+    from .meta_parallel import (MetaParallelBase, PipelineParallel,
+                                SegmentParallel, ShardingParallel,
+                                TensorParallel)
+    from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+    from .mp import (ColumnParallelLinear, ParallelCrossEntropy,
+                     RowParallelLinear, VocabParallelEmbedding)
+    from .mp import get_rng_state_tracker
+
+
+meta_parallel = _MetaParallelNS
+
+
+class _UtilsNS:
+    from .recompute import recompute, recompute_hybrid, recompute_sequential
+    from .sp import (ColumnSequenceParallelLinear, GatherOp,
+                     RowSequenceParallelLinear, ScatterOp,
+                     mark_as_sequence_parallel_parameter,
+                     register_sequence_parallel_allreduce_hooks)
+
+
+utils = _UtilsNS
+
+# singleton facade functions (fleet.init etc.)
+init = _fleet_singleton.init
+distributed_model = _fleet_singleton.distributed_model
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+is_first_worker = _fleet_singleton.is_first_worker
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+barrier_worker = _fleet_singleton.barrier_worker
+get_hybrid_communicate_group = get_hybrid_communicate_group
+fleet = _fleet_singleton

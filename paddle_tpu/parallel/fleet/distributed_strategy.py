@@ -1,0 +1,97 @@
+"""DistributedStrategy (reference:
+``python/paddle/distributed/fleet/base/distributed_strategy.py``, protobuf
+``distributed_strategy.proto``).
+
+One plain-python config object covering the proto's surface: hybrid degrees,
+amp/recompute/sharding sub-configs, serializable to/from JSON (the proto's
+role)."""
+from __future__ import annotations
+
+import copy
+import json
+
+
+_DEFAULTS = {
+    "hybrid_configs": {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sep_degree": 1,
+        "order": ["dp", "pp", "sharding", "sep", "mp"],
+        "mp_configs": {"sync_param": False, "sync_grad": False},
+        "pp_configs": {"micro_batch_size": 1, "accumulate_steps": 1,
+                       "schedule_mode": "1F1B", "virtual_pp_degree": 1,
+                       "delay_scale_loss": False},
+    },
+    "amp": False,
+    "amp_configs": {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                    "use_pure_bf16": False, "custom_white_list": [],
+                    "custom_black_list": [], "use_fp16_guard": False},
+    "recompute": False,
+    "recompute_configs": {"checkpoints": [], "enable_offload": False},
+    "sharding": False,
+    "sharding_configs": {"sharding_degree": 1, "stage": 1,
+                         "offload": False, "comm_overlap": True},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lamb": False,
+    "lars": False,
+    "dgc": False,
+    "find_unused_parameters": False,
+    "fuse_grad_size_in_MB": 32,
+    "fuse_all_reduce_ops": True,
+    "nccl_comm_num": 1,
+    "gradient_scale_configs": {"scale_strategy": "avg"},
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "tensor_init_seed": -1},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._conf = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        conf = object.__getattribute__(self, "_conf")
+        if name in conf:
+            return conf[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name == "_conf":
+            object.__setattr__(self, name, value)
+            return
+        if name in self._conf:
+            cur = self._conf[name]
+            if isinstance(cur, dict) and isinstance(value, dict):
+                merged = copy.deepcopy(cur)
+                _deep_update(merged, value)
+                self._conf[name] = merged
+            else:
+                self._conf[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    # proto-parity serialization
+    def save_to_prototxt(self, output):
+        with open(output, "w") as f:
+            json.dump(self._conf, f, indent=2)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            _deep_update(self._conf, json.load(f))
+
+    def to_json(self):
+        return json.dumps(self._conf, indent=2)
+
+    def __repr__(self):
+        return "DistributedStrategy:\n" + self.to_json()
+
+
+def _deep_update(dst, src):
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_update(dst[k], v)
+        else:
+            dst[k] = v

@@ -1,0 +1,101 @@
+"""meta_parallel wrappers (reference:
+``python/paddle/distributed/fleet/meta_parallel/{tensor_parallel,
+pipeline_parallel}.py``).
+
+``fleet.distributed_model`` routes here. On TPU the wrappers are thin: TP
+needs no param broadcast (single logical parameter store), DP grad sync is a
+sharding, and PP execution is owned by the compiled schedule — so the
+wrappers mainly carry topology metadata and the ``train_batch`` entrypoint.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+class TensorParallel(MetaParallelBase):
+    """Reference broadcasts non-sliced params across the mp group at wrap
+    time; with a single logical store all replicas are identical by
+    construction, so this wrapper is metadata-only."""
+
+
+class SegmentParallel(MetaParallelBase):
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class PipelineParallel(MetaParallelBase):
+    """train_batch: microbatched fwd/bwd over stages + optimizer step.
+
+    Execution: compiled microbatch loop (parallel.pp.schedule) with 1F1B
+    semantics when the pp mesh axis is real; numerically it matches the
+    reference's 1F1B (same per-microbatch grads, summed).
+    """
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "pipeline parallel requires the model be built with "
+                "fleet.meta_parallel.PipelineLayer")
+        pp_cfg = strategy.hybrid_configs.get("pp_configs", {})
+        self.micro_batch_size = int(pp_cfg.get("micro_batch_size", 1))
+        self.accumulate_steps = int(pp_cfg.get("accumulate_steps", 1))
+        self._train_step = None
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from .pp_runtime import pipeline_train_batch
+        loss = pipeline_train_batch(self, data, optimizer, lr_scheduler,
+                                    scaler)
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x if isinstance(x, Tensor) else Tensor(x))
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(
+                out, y if isinstance(y, Tensor) else Tensor(y))
+        return out
+
+
+def wrap_distributed_model(model, hcg, strategy):
+    if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strategy)
+    from ...nn.parallel import DataParallel
+    return DataParallel(model)

@@ -1,0 +1,230 @@
+"""Tensor (model) parallel layers (reference:
+``python/paddle/distributed/fleet/layers/mpu/{mp_layers,mp_ops}.py``).
+
+Megatron-style Column/Row parallel linears and vocab-parallel embedding,
+TPU-native: parameters carry PartitionSpecs over the 'mp' mesh axis and
+activations are annotated with ``with_sharding_constraint``. GSPMD then
+*derives* the collectives the reference hand-writes as CUDA ops:
+
+- ``_c_identity`` (copy fwd / allreduce bwd)  -> automatic from specs
+- ``_mp_allreduce`` after RowParallelLinear   -> forced by a replicated
+  output annotation
+- vocab-parallel CE without materializing full logits -> partitioned
+  softmax from a vocab-sharded logits annotation
+
+Compile-only tests (tests/parallel) assert the expected collectives appear
+in the HLO — the analog of the reference's op-level unit tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...ops._op import tensor_op
+from .. import mesh as mesh_mod
+
+MP_AXIS = "mp"
+SEQ_AXIS = "sep"
+
+
+def _mesh():
+    return mesh_mod.get_mesh()
+
+
+@tensor_op
+def _constrain(x, spec_tuple):
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec_tuple)))
+    except (ValueError, TypeError):
+        # axis not in mesh (e.g. mp degree 1 mesh without 'mp') — no-op
+        return x
+
+
+def shard_annotate(x, *spec):
+    """Annotate a Tensor's sharding (identity op; a hint to GSPMD)."""
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+    clean = tuple(s if (s is None or (isinstance(s, str) and s in names) or
+                        (isinstance(s, tuple) and all(n in names for n in s)))
+                  else None for s in spec)
+    return _constrain(x, clean)
+
+
+def mark_sharding(param, *spec):
+    """Attach a PartitionSpec to a Parameter; consumed by jit.TrainStep to
+    place params/grads/opt-state on the mesh."""
+    param.dist_spec = P(*spec)
+    param.is_distributed = True
+    return param
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Weight [in, out] sharded on out ('mp' columns). fwd: local matmul;
+    output stays mp-sharded unless gather_output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        mark_sharding(self.weight, None, MP_AXIS)
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            mark_sharding(self.bias, MP_AXIS)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # input replicated across mp (the reference's _c_identity)
+        x = shard_annotate(x, *([None] * (len(x.shape) - 1)), None)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = shard_annotate(out, *([None] * len(out.shape)))
+        else:
+            out = shard_annotate(out, *([None] * (len(out.shape) - 1)), MP_AXIS)
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Weight [in, out] sharded on in ('mp' rows). fwd: partial matmuls +
+    allreduce (forced by replicated output annotation)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        mark_sharding(self.weight, MP_AXIS, None)
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            # bias added after the reduce — replicated
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_annotate(x, *([None] * (len(x.shape) - 1)), MP_AXIS)
+        out = F.linear(x, self.weight, None)
+        # replicated output == allreduce of partial sums (reference
+        # _mp_allreduce in fwd, identity in bwd)
+        out = shard_annotate(out, *([None] * len(out.shape)))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding [vocab, hidden] sharded on vocab; GSPMD partitions the
+    gather + combines (reference c_embedding kernel + allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        mark_sharding(self.weight, MP_AXIS, None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return shard_annotate(out, *([None] * len(out.shape)))
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel softmax CE (reference
+    ``c_softmax_with_cross_entropy``): annotate logits vocab-sharded and let
+    the partitioner keep the reduction distributed."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        input = shard_annotate(input, *([None] * (len(input.shape) - 1)),
+                               MP_AXIS)
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def parallel_matmul(x, weight, transpose_y=False, tensor_parallel_output=True):
+    """lm-head style matmul against a vocab-sharded weight."""
+    from ...ops import matmul
+    out = matmul(x, weight, transpose_y=transpose_y)
+    if tensor_parallel_output:
+        return shard_annotate(out, *([None] * (len(out.shape) - 1)), MP_AXIS)
+    return shard_annotate(out, *([None] * len(out.shape)))
+
+
+# ---------------------------------------------------------------- mp_ops
+def _c_identity(x, group=None):
+    """Copy in fwd; allreduce grads in bwd — in GSPMD this is exactly what a
+    'replicated' annotation produces for an input consumed by sharded ops."""
+    return shard_annotate(x, *([None] * len(x.shape)))
+
+
+def _mp_allreduce(x, group=None, use_calc_stream=True, use_model_parallel=True):
+    return shard_annotate(x, *([None] * len(x.shape)))
+
+
+def _c_split(x, group=None):
+    """Split last dim across mp (fwd) / allgather (bwd)."""
+    return shard_annotate(x, *([None] * (len(x.shape) - 1)), MP_AXIS)
+
+
+def _c_concat(x, group=None):
+    """Allgather last dim across mp."""
+    return shard_annotate(x, *([None] * len(x.shape)))
+
+
+def split_model_parallel(x, axis=-1):
+    nd = len(x.shape)
+    axis = axis % nd
+    spec = [None] * nd
+    spec[axis] = MP_AXIS
+    return shard_annotate(x, *spec)
+
+
+# ---------------------------------------------------------------- RNG
+def model_parallel_random_seed(seed=None):
+    """Reference ``tensor_parallel.random.model_parallel_random_seed``:
+    registers 'global_seed' and (rank-salted) 'local_seed' streams."""
+    from ...core.random import get_rng_state_tracker
+    import numpy as np
+    seed = seed if seed is not None else np.random.randint(0, 2 ** 31)
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", seed)
+    tracker.add("local_seed", seed + 1024)
+    return tracker
+
+
+get_rng_state_tracker = None  # set below
+
+
+def _install():
+    global get_rng_state_tracker
+    from ...core.random import get_rng_state_tracker as _g
+    get_rng_state_tracker = _g
+
+
+_install()

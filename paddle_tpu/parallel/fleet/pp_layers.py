@@ -1,0 +1,153 @@
+"""Pipeline layer declaration (reference:
+``python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py``).
+
+``PipelineLayer`` takes a declarative LayerDesc list and segments it into
+``num_stages`` stages. On TPU the execution strategy differs by shape:
+
+- Homogeneous middle stages (the transformer case): the hybrid train step
+  stacks per-layer params and runs the 1F1B-equivalent schedule as a
+  shard_map microbatch loop with ``ppermute`` stage handoffs over the 'pp'
+  mesh axis (see parallel.pp.schedule).
+- General case / pp degree 1: stages execute sequentially in one program
+  (microbatched for memory) — numerically identical, used by parity tests.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Union
+
+from ... import nn
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, nn.Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing on multiple stages (embedding/head tying).
+    With a single logical parameter store (SPMD), sharing is identity — the
+    reference's cross-stage grad allreduce for shared weights is unnecessary."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedProxy(nn.Layer):
+    def __init__(self, key, shared_layer, forward_func):
+        super().__init__()
+        self._key = key
+        self.shared = shared_layer  # same object: true weight sharing
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self.shared, *args, **kwargs)
+        return self.shared(*args, **kwargs)
+
+
+class PipelineLayer(nn.Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pp")
+        self._num_stages = num_stages or 1
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._virtual_pp = num_virtual_pipeline_stages or 1
+        self._shared = {}
+        self._build()
+
+    # ---------------------------------------------------------------- build
+    def _build(self):
+        built = []
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append(_SharedProxy(d.layer_name,
+                                          self._shared[d.layer_name],
+                                          d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, nn.Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FuncLayer(d))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = nn.LayerList(built)
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        stages = self._num_stages
+        if self._seg_method.startswith("layer:"):
+            cls_name = self._seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(self.run_function)
+                     if type(l).__name__ == cls_name or
+                     (isinstance(l, _SharedProxy) and
+                      type(l.shared).__name__ == cls_name)]
+            # distribute marked layers evenly; boundary layers go with marks
+            per = max(math.ceil(len(marks) / stages), 1)
+            bounds = [0]
+            for s in range(1, stages):
+                idx = s * per
+                bounds.append(marks[idx] if idx < len(marks) else n)
+            bounds.append(n)
+        else:  # uniform
+            per = math.ceil(n / stages)
+            bounds = [min(i * per, n) for i in range(stages)] + [n]
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return [self.run_function[i] for i in range(lo, hi)]
+
+    # ---------------------------------------------------------------- run
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+    def forward_stage(self, x, stage_id):
+        for layer in self.get_stage_layers(stage_id):
+            x = layer(x)
+        return x
+
+    @property
+    def parameters_by_stage(self):
+        out = []
+        for s in range(self._num_stages):
+            params = []
+            for l in self.get_stage_layers(s):
+                params.extend(l.parameters())
+            out.append(params)
+        return out
+
+
+class _FuncLayer(nn.Layer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

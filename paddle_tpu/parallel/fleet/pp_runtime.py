@@ -1,0 +1,53 @@
+"""Pipeline train_batch runtime (reference:
+``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+forward_backward_pipeline — 1F1B).
+
+Semantics contract (what parity tests check): per-microbatch losses averaged,
+gradients accumulated across microbatches, single optimizer step at the end.
+The reference's 1F1B ordering exists to bound *per-device* activation memory
+across stages; in the compiled TPU schedule the same effect comes from the
+shard_map stage loop (parallel.pp.schedule) for homogeneous stacks. This
+runtime is the general-topology fallback: microbatch loop over the full
+model — identical numerics, used for pp parity tests and pp_degree=1.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...jit import TrainStep
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def pipeline_train_batch(pp_model, data, optimizer, lr_scheduler=None,
+                         scaler=None):
+    layers = pp_model._layers
+    loss_fn = layers._loss_fn
+    if loss_fn is None:
+        raise ValueError("PipelineLayer needs loss_fn for train_batch")
+    x, y = data
+    x, y = _to_tensor(x), _to_tensor(y)
+    accum = pp_model.accumulate_steps
+    bsz = x.shape[0]
+    micro = max(bsz // accum, 1)
+
+    if pp_model._train_step is None:
+        inner_opt = getattr(optimizer, "_inner_opt", optimizer)
+
+        def scaled_loss(out, label):
+            return loss_fn(out, label)
+
+        pp_model._train_step = TrainStep(layers, scaled_loss, inner_opt,
+                                         grad_accum_steps=accum)
+
+    step = pp_model._train_step
+    if accum > 1 and bsz % accum == 0:
+        loss = step.accum_step((x,), (y,), accum)
+    else:
+        loss = step.step((x,), (y,))
+    if lr_scheduler is not None:
+        lr_scheduler.step()
+    return loss

@@ -1,0 +1,86 @@
+"""Recompute / activation checkpointing (reference:
+``python/paddle/distributed/fleet/utils/recompute/``).
+
+TPU-native: ``jax.checkpoint`` (rematerialization) IS activation
+checkpointing, and it composes with jit/grad/scan. The reference's RNG-state
+replay is automatic here because dropout keys are functional (the same fold_in
+keys are regenerated on the recompute pass).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...autograd.engine import no_grad
+from ...core.tensor import Tensor
+from ...nn.layer import Layer, Sequential
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute(fn_or_layer, *args).
+
+    Inside a jitted step this wraps the callable in jax.checkpoint; the eager
+    tape path recomputes through jax.checkpoint's VJP as well (one op-level
+    application).
+    """
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    fn = function if callable(function) and not isinstance(function, Layer) \
+        else function
+
+    def pure(*vals):
+        with no_grad():
+            t_args = [Tensor(v) for v in vals]
+            out = fn(*t_args)
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o for o in out)
+        return out.value if isinstance(out, Tensor) else out
+
+    ck = jax.checkpoint(pure)
+    from ...ops._op import apply
+    return apply(ck, tuple(a.value if isinstance(a, Tensor) else a
+                           for a in args), {}, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute over a Sequential, segment by segment (reference
+    recompute_sequential)."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    if isinstance(functions, Sequential):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    x = args[0]
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+
+        def seg_forward(inp, _chunk=chunk):
+            out = inp
+            for l in _chunk:
+                out = l(out)
+            return out
+
+        x = recompute(seg_forward, x, **kwargs)
+        i += per
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """pp-aware recompute (offload handled by XLA remat + host offload flags)."""
+    return recompute(function, *args, **kwargs)
+
+
+class RecomputeLayer(Layer):
+    """Wrap any Layer so its forward is rematerialized in the backward pass."""
+
+    def __init__(self, layer):
+        super().__init__()
+        self.inner = layer
+
+    def forward(self, *args):
+        return recompute(self.inner, *args)

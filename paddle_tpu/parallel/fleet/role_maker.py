@@ -1,0 +1,45 @@
+"""RoleMaker (reference:
+``python/paddle/distributed/fleet/base/role_maker.py``) — env discovery for
+collective mode (PS mode is out of north-star scope; see README)."""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+        self._nranks = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", str(max(len(self._endpoints), 1))))
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._nranks
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+    def role(self):
+        return Role.WORKER
+
+
+UserDefinedRoleMaker = PaddleCloudRoleMaker

@@ -1,0 +1,97 @@
+"""Sequence parallelism utilities (reference:
+``python/paddle/distributed/fleet/utils/sequence_parallel_utils.py`` —
+Megatron-SP: activations sharded on the sequence dim in LN/dropout regions,
+allgather/reduce-scatter fused into the parallel linears).
+
+TPU-native: sequence sharding is an annotation over the 'mp' axis (Megatron-SP
+reuses the tensor-parallel group) and GSPMD fuses the allgather/
+reduce-scatter conversions into the matmul partitioning — the exact
+optimization the reference hand-writes. Ulysses/ring attention (context
+parallelism over the 'sep' axis) live in paddle_tpu.parallel.sp_attention.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from .mp import MP_AXIS, mark_sharding, shard_annotate
+
+SEQ_DIM = 1  # [batch, seq, hidden]
+
+
+def scatter(x, axis=SEQ_DIM):
+    """ScatterOp: split seq dim across mp (fwd) / allgather (bwd)."""
+    spec = [None] * len(x.shape)
+    spec[axis] = MP_AXIS
+    return shard_annotate(x, *spec)
+
+
+def all_gather(x, axis=SEQ_DIM):
+    """GatherOp: allgather seq dim (fwd) / split (bwd)."""
+    return shard_annotate(x, *([None] * len(x.shape)))
+
+
+ScatterOp = scatter
+GatherOp = all_gather
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """LN params inside SP regions need grad allreduce over mp in the
+    reference; with a single logical store + GSPMD grads reduce automatically.
+    Kept for API parity; tags the param."""
+    param.sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """Input seq-sharded -> (implicit allgather) -> column-parallel matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        mark_sharding(self.weight, None, MP_AXIS)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            mark_sharding(self.bias, MP_AXIS)
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        # x arrives seq-sharded; GSPMD inserts the allgather fused with matmul
+        out = F.linear(x, self.weight, self.bias)
+        nd = len(out.shape)
+        if self.gather_output:
+            return shard_annotate(out, *([None] * nd))
+        return shard_annotate(out, *([None] * (nd - 1)), MP_AXIS)
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Row-parallel matmul -> reduce-scatter to seq-sharded output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        mark_sharding(self.weight, MP_AXIS, None)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        # reduce-scatter: partial sums combined AND seq dim sharded
+        spec = [None] * len(out.shape)
+        spec[SEQ_DIM] = MP_AXIS
+        out = shard_annotate(out, *spec)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op on TPU (grads of SP-region params reduce via GSPMD); kept for
+    API parity with the reference trainer integrations."""
+    return model

@@ -1,0 +1,166 @@
+"""Hybrid topology (reference:
+``python/paddle/distributed/fleet/base/topology.py`` CommunicateTopology +
+HybridCommunicateGroup).
+
+The reference builds a Cartesian process grid in order
+``[dp, pp, sharding, sep, mp]`` and creates one NCCL group per axis-slice.
+Here the grid is realized once as a ``jax.sharding.Mesh`` with those axis
+names; "creating a comm group" is just naming an axis — the Group objects
+returned are facades used by the parallel layers to pick their collective
+axis and by user code for rank arithmetic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .. import mesh as mesh_mod
+from ..mesh import Group
+
+ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._world_size = int(np.prod(self._dims))
+        shape = self._dims
+        self._rank_grid = np.arange(self._world_size).reshape(shape)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        idx = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._rank_grid[idx])
+
+    def get_coord(self, rank):
+        coords = np.unravel_index(rank, self._rank_grid.shape)
+        import collections
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*[int(c) for c in coords])
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        grid = np.moveaxis(self._rank_grid, axis, 0)
+        return [int(r) for r in grid[index].ravel()]
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along axis_name (reference semantics)."""
+        axis = self._parallel_names.index(axis_name)
+        grid = np.moveaxis(self._rank_grid, axis, -1)
+        flat = grid.reshape(-1, grid.shape[-1])
+        return [[int(r) for r in row] for row in flat]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology, mesh=None):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        degrees = {n: topology.get_dim(n) for n in names}
+        self._dp_degree = degrees.get("dp", 1)
+        self._mp_degree = degrees.get("mp", 1)
+        self._pp_degree = degrees.get("pp", 1)
+        self._sharding_degree = degrees.get("sharding", 1)
+        self._sep_degree = degrees.get("sep", 1)
+        if mesh is None:
+            mesh = mesh_mod.build_mesh(degrees)
+        self.mesh = mesh_mod.set_mesh(mesh)
+        self.global_rank = 0  # single-controller SPMD: rank arithmetic is per-axis
+
+    # ---------------------------------------------------------------- degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---------------------------------------------------------------- groups
+    def get_data_parallel_group(self) -> Group:
+        return Group(self.mesh, ("dp",), pg_name="dp")
+
+    def get_model_parallel_group(self) -> Group:
+        return Group(self.mesh, ("mp",), pg_name="mp")
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group(self.mesh, ("pp",), pg_name="pp")
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group(self.mesh, ("sharding",), pg_name="sharding")
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group(self.mesh, ("sep",), pg_name="sep")
+
+    def get_check_parallel_group(self, sharding_new_group=False) -> Group:
+        # dp+sharding fused check group (reference semantics)
+        return Group(self.mesh, ("dp", "sharding"), pg_name="check")
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return Group(self.mesh, ("dp", "sep"), pg_name="dp_sep")
+
+    # ---------------------------------------------------------------- ranks
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return self._pp_degree == 1
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0, mp=0)
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+
+_HCG = {"hcg": None}
+
+
+def set_hybrid_communicate_group(hcg):
+    _HCG["hcg"] = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _HCG["hcg"] is None:
+        raise RuntimeError("call fleet.init(is_collective=True) first")
+    return _HCG["hcg"]
+
+
+def has_hybrid_communicate_group():
+    return _HCG["hcg"] is not None

@@ -1,0 +1,121 @@
+"""Launcher CLI (reference: ``python/paddle/distributed/launch/main.py`` +
+controllers/master/job).
+
+``python -m paddle_tpu.distributed.launch [--nnodes N] [--master ip:port]
+[--rank R] train.py args...``
+
+TPU model (SURVEY.md §3.3): ONE process per host — per-chip fan-out is XLA's
+job, so there is no per-device Pod/Container spawn. The launcher:
+
+1. resolves the coordinator (rank-0 host) address,
+2. exports paddle-compatible env (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+   PADDLE_MASTER, PADDLE_CURRENT_ENDPOINT),
+3. execs the training script (optionally respawning on failure — elastic
+   restart loop; preemption-aware resume comes from checkpoints).
+
+Single-host multi-process simulation (tests): ``--procs K`` forks K local
+processes against a CPU device mesh.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ...utils.log import get_logger
+
+logger = get_logger("launch")
+
+
+def build_parser():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (rank-0 host)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of hosts, or min:max for elastic")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--nproc_per_node", "--procs", dest="procs", type=int,
+                   default=1, help="local processes (testing only; TPU = 1)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="accepted for CLI parity; devices come from the TPU "
+                        "runtime")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _child_env(args, local_rank, nnodes_min):
+    env = dict(os.environ)
+    world = nnodes_min * max(args.procs, 1)
+    rank = args.rank * max(args.procs, 1) + local_rank
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        host = args.master.split(":")[0]
+        env["PADDLE_CURRENT_ENDPOINT"] = f"{host}:{35000 + rank}"
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    env["FLAGS_selected_tpus"] = str(local_rank)
+    return env
+
+
+def launch():
+    args = build_parser().parse_args()
+    nnodes = args.nnodes.split(":")
+    nmin = int(nnodes[0])
+    os.makedirs(args.log_dir, exist_ok=True)
+    cmd_base = [sys.executable, args.script] + args.script_args
+
+    restarts = 0
+    while True:
+        procs = []
+        for lr in range(max(args.procs, 1)):
+            env = _child_env(args, lr, nmin)
+            logfile = os.path.join(args.log_dir, f"workerlog.{lr}")
+            out = open(logfile, "ab")
+            logger.info(f"spawn rank {env['PADDLE_TRAINER_ID']}: "
+                        f"{' '.join(cmd_base)} (log: {logfile})")
+            p = subprocess.Popen(cmd_base, env=env,
+                                 stdout=out if lr != 0 else None,
+                                 stderr=subprocess.STDOUT if lr != 0 else None)
+            procs.append((p, out))
+
+        def terminate_all(signum=None, frame=None):
+            for p, _ in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        signal.signal(signal.SIGTERM, terminate_all)
+        codes = []
+        try:
+            for p, out in procs:
+                codes.append(p.wait())
+                if out is not None:
+                    out.close()
+        except KeyboardInterrupt:
+            terminate_all()
+            raise
+        if all(c == 0 for c in codes):
+            logger.info("job finished successfully")
+            return 0
+        restarts += 1
+        if restarts > args.max_restart or args.elastic_level < 0:
+            logger.error(f"job failed with exit codes {codes}")
+            return 1
+        logger.warning(f"restart {restarts}/{args.max_restart} after failure "
+                       f"{codes} (elastic mode)")
+        terminate_all()
+        time.sleep(3)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,140 @@
+"""Mesh manager — the TPU substrate for every parallelism axis.
+
+The reference builds a 4-D(+sep) process grid in Python and materializes NCCL
+communicators per axis (``python/paddle/distributed/fleet/base/topology.py`` +
+``ProcessGroupNCCL``). Here the grid IS a ``jax.sharding.Mesh``; a "process
+group" is a mesh axis (or axis subset), and collectives are XLA ops — so
+group creation is free and there is no communicator state to manage.
+
+Axis order convention follows the reference's HybridCommunicateGroup:
+``[dp, pp, sharding, sep, mp]`` — outer axes get the slower links (DCN/
+cross-slice), mp innermost rides the fastest ICI neighbors, which is exactly
+the layout `jax.make_mesh` produces on TPU topologies.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_STATE: Dict[str, object] = {"mesh": None}
+
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def build_mesh(axis_degrees: Dict[str, int], devices=None) -> Mesh:
+    """Create the global hybrid mesh. Degrees of 1 are kept as real axes so
+    sharding specs can always name them."""
+    devices = devices if devices is not None else jax.devices()
+    names = [a for a in HYBRID_AXES if a in axis_degrees]
+    extra = [a for a in axis_degrees if a not in HYBRID_AXES]
+    names += extra
+    degrees = [int(axis_degrees[a]) for a in names]
+    total = int(np.prod(degrees)) if degrees else 1
+    if total != len(devices):
+        raise ValueError(
+            f"mesh degrees {dict(zip(names, degrees))} product {total} != "
+            f"device count {len(devices)}")
+    try:
+        mesh = jax.make_mesh(tuple(degrees), tuple(names), devices=devices)
+    except TypeError:
+        arr = np.asarray(devices).reshape(degrees)
+        mesh = Mesh(arr, tuple(names))
+    return mesh
+
+
+def set_mesh(mesh: Mesh):
+    _STATE["mesh"] = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _STATE["mesh"]
+
+
+def ensure_mesh(axis_degrees: Optional[Dict[str, int]] = None) -> Mesh:
+    mesh = get_mesh()
+    if mesh is None:
+        if axis_degrees is None:
+            axis_degrees = {"dp": jax.device_count()}
+        mesh = set_mesh(build_mesh(axis_degrees))
+    return mesh
+
+
+def default_data_mesh() -> Mesh:
+    """1-D all-devices mesh for plain data parallelism."""
+    mesh = get_mesh()
+    if mesh is not None and "dp" in mesh.axis_names:
+        return mesh
+    return ensure_mesh({"dp": jax.device_count()})
+
+
+class Group:
+    """ProcessGroup-shaped facade over one or more mesh axes.
+
+    ``group.axis_names`` identifies the collective dimension(s); rank lists
+    exist for API parity with the reference's ``Group``.
+    """
+
+    _next_gid = [0]
+
+    def __init__(self, mesh: Mesh, axis_names: Tuple[str, ...],
+                 ranks: Optional[List[int]] = None, pg_name: str = ""):
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        self.nranks = int(np.prod([mesh.shape[a] for a in self.axis_names])) \
+            if self.axis_names else 1
+        self.ranks = ranks if ranks is not None else list(range(self.nranks))
+        self.id = Group._next_gid[0]
+        Group._next_gid[0] += 1
+        self.pg_name = pg_name or f"group_{self.id}"
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        if global_rank in self.ranks:
+            return self.ranks.index(global_rank)
+        return -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return (f"Group(axes={self.axis_names}, nranks={self.nranks}, "
+                f"id={self.id})")
+
+
+def world_group() -> Group:
+    mesh = ensure_mesh()
+    return Group(mesh, tuple(mesh.axis_names), pg_name="world")
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """paddle.distributed.new_group parity. On TPU, arbitrary rank subsets
+    would need a sub-mesh; the supported cases are 'all ranks' (world) and
+    axis-aligned subsets created via the fleet topology."""
+    mesh = ensure_mesh()
+    if ranks is None or len(ranks) == jax.device_count():
+        return Group(mesh, tuple(mesh.axis_names), ranks=ranks, pg_name="world")
+    # axis-aligned subgroup: find an axis whose size matches and assume
+    # alignment (fleet topology always produces aligned groups)
+    for a in mesh.axis_names:
+        if mesh.shape[a] == len(ranks):
+            return Group(mesh, (a,), ranks=list(ranks))
+    raise ValueError(
+        f"new_group: rank set {ranks} is not axis-aligned with mesh "
+        f"{dict(mesh.shape)}; build the hybrid mesh via fleet.init with "
+        f"matching degrees")
+
+
+def spec(*names) -> PartitionSpec:
+    return PartitionSpec(*names)
+
+
+def named_sharding(mesh, *names) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*names))

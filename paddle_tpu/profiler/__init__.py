@@ -1,0 +1,172 @@
+"""Profiler (reference: ``python/paddle/profiler/profiler.py`` state-machine
+scheduler + ``paddle/fluid/platform/profiler/`` CUPTI tracing).
+
+TPU-native: wraps ``jax.profiler`` (XPlane traces viewable in
+TensorBoard/Perfetto/xprof). The paddle-shaped surface is kept: a Profiler
+with a step-window scheduler, ``RecordEvent`` ranges (jax.named_scope /
+TraceAnnotation), chrome-trace-compatible export directory, and summary
+hooks. MFU/throughput accounting lives in :mod:`paddle_tpu.profiler.metrics`.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(closed=0, ready=0, record=0, repeat=0, skip_first=0):
+    """Step-window scheduler, reference semantics."""
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        return dir_name
+
+    handler.dir_name = dir_name
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._dir = getattr(on_trace_ready, "dir_name", None) or "./profiler_log"
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        self._last = time.perf_counter()
+        self._transition()
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+        self._transition()
+
+    def _transition(self):
+        state = (self._scheduler(self._step) if self._scheduler
+                 else ProfilerState.RECORD)
+        if self._timer_only:
+            self._state = state
+            return
+        recording = state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._active:
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        elif not recording and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = state
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            print("no recorded steps")
+            return
+        import numpy as np
+        ts = np.asarray(self._step_times[1:] or self._step_times)
+        print(f"steps: {len(self._step_times)}  "
+              f"avg: {ts.mean()*1e3:.2f}ms  p50: {np.median(ts)*1e3:.2f}ms  "
+              f"min: {ts.min()*1e3:.2f}ms  max: {ts.max()*1e3:.2f}ms")
+        print(f"traces (if recorded) under: {self._dir} — open with "
+              f"TensorBoard or Perfetto")
+
+
+class RecordEvent:
+    """User range annotation -> jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("open XPlane traces with TensorBoard/xprof")
+
+
+from . import metrics  # noqa: E402
+from .metrics import MFUMeter  # noqa: E402

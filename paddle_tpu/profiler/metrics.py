@@ -1,0 +1,90 @@
+"""First-class training metrics: tokens/sec/chip and MFU (SURVEY.md §5.5 —
+the north-star metric must be a training-loop output).
+
+MFU = achieved model FLOP/s / peak chip FLOP/s. The FLOP formula is stated
+explicitly (BASELINE.md requirement): ``6 * n_params * tokens`` for
+transformer training (fwd+bwd), optionally + attention term
+``12 * n_layers * hidden * seq`` per token when ``include_attention``.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+# bf16 peak FLOP/s per chip by TPU generation
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = device.device_kind.lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 197e12  # conservative default
+
+
+def transformer_flops_per_token(n_params, n_layers=0, hidden=0, seq_len=0,
+                                include_attention=False) -> float:
+    f = 6.0 * n_params
+    if include_attention and n_layers and hidden and seq_len:
+        f += 12.0 * n_layers * hidden * seq_len
+    return f
+
+
+class MFUMeter:
+    """Accumulates step timings and reports tokens/s/chip + MFU."""
+
+    def __init__(self, flops_per_token=None, n_params=None, n_chips=None,
+                 include_attention=False, n_layers=0, hidden=0, seq_len=0):
+        if flops_per_token is None:
+            flops_per_token = transformer_flops_per_token(
+                n_params, n_layers, hidden, seq_len, include_attention)
+        self.flops_per_token = flops_per_token
+        self.n_chips = n_chips or jax.device_count()
+        self.peak = peak_flops_per_chip()
+        self.reset()
+
+    def reset(self):
+        self._tokens = 0
+        self._time = 0.0
+        self._t0 = None
+
+    def start_step(self):
+        self._t0 = time.perf_counter()
+
+    def end_step(self, tokens):
+        self._time += time.perf_counter() - self._t0
+        self._tokens += tokens
+
+    @property
+    def tokens_per_sec(self):
+        return self._tokens / self._time if self._time else 0.0
+
+    @property
+    def tokens_per_sec_per_chip(self):
+        return self.tokens_per_sec / self.n_chips
+
+    @property
+    def mfu(self):
+        return (self.tokens_per_sec * self.flops_per_token /
+                (self.n_chips * self.peak))
+
+    def report(self):
+        return {
+            "tokens_per_sec": self.tokens_per_sec,
+            "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip,
+            "mfu": self.mfu,
+            "flop_formula": f"{self.flops_per_token:.3e} FLOP/token",
+            "peak_flops_per_chip": self.peak,
+            "n_chips": self.n_chips,
+        }

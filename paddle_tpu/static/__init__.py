@@ -1,0 +1,97 @@
+"""Static-graph introspection surface (reference: ``python/paddle/static/``).
+
+XLA is the static engine: a "Program" here is a traced jaxpr + lowered/
+compiled HLO. This module provides the introspection half of the reference's
+static API — tracing a callable to a Program you can print, inspect for ops,
+and compile — not a separate execution engine (jit IS the executor).
+Compile-only tests (SURVEY.md §4) use ``Program.hlo_text`` to assert
+collective/fusion properties.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        from ..core import dtype as dtype_mod
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype_mod.to_jax_dtype(dtype)
+        self.name = name
+
+    def to_struct(self, batch_size=1):
+        shape = tuple(batch_size if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+class Program:
+    """A traced computation: jaxpr + (lazily) lowered HLO."""
+
+    def __init__(self, fn: Callable, example_args: Sequence[Any]):
+        self._fn = fn
+        self._args = example_args
+        self._jaxpr = None
+        self._lowered = None
+
+    @property
+    def jaxpr(self):
+        if self._jaxpr is None:
+            self._jaxpr = jax.make_jaxpr(self._fn)(*self._args)
+        return self._jaxpr
+
+    @property
+    def lowered(self):
+        if self._lowered is None:
+            self._lowered = jax.jit(self._fn).lower(*self._args)
+        return self._lowered
+
+    @property
+    def hlo_text(self) -> str:
+        return self.lowered.as_text()
+
+    def compile(self):
+        return self.lowered.compile()
+
+    def ops(self):
+        """List of primitive op names (the reference's program op list)."""
+        return [str(eqn.primitive) for eqn in self.jaxpr.eqns]
+
+    def count_op(self, name: str) -> int:
+        import re
+        return len(re.findall(rf"\b{re.escape(name)}\b", self.hlo_text))
+
+    def flops(self):
+        try:
+            return self.compile().cost_analysis()["flops"]
+        except Exception:
+            return None
+
+    def __str__(self):
+        return str(self.jaxpr)
+
+
+def trace_layer(layer, example_inputs) -> Program:
+    """Trace a Layer's forward into a Program (dy2static's role, done by
+    jax tracing)."""
+    from ..jit.functional import call_functional, split_state
+    params, buffers = split_state(layer)
+    vals = [x.value if isinstance(x, Tensor) else x for x in example_inputs]
+
+    def fn(p, b, *a):
+        out, new_b = call_functional(layer, p, b, tuple(a))
+        return out
+
+    return Program(fn, (params, buffers, *vals))
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "no global Program in the TPU build — trace with static.trace_layer")
+
+
+def name_scope(name):
+    return jax.named_scope(name)

@@ -1,0 +1,31 @@
+from . import flags
+from .flags import get_flags, set_flags
+from . import log as logger  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def run_check():
+    """paddle.utils.run_check analog: verify the device works end-to-end."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed and working on {dev.platform} ({dev.device_kind}).")
+    return True
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason=""):
+        self.update_to = update_to
+
+    def __call__(self, fn):
+        return fn

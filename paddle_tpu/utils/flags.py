@@ -1,0 +1,65 @@
+"""Unified flag/config system (reference: gflags-style ``FLAGS_*`` in
+``paddle/phi/core/flags.cc`` + ``paddle.set_flags``).
+
+One dataclass-free registry serving the reference's three config planes:
+C++-style FLAGS (env-overridable), runtime set_flags, and introspection.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+    return value
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_REGISTRY)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _REGISTRY[f] for f in flags}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        _REGISTRY[k] = v
+
+
+def get_flag(name, default=None):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name not in _REGISTRY and default is not None:
+        return define_flag(name, default)
+    return _REGISTRY.get(name, default)
+
+
+# Core flags mirroring the reference's most-used ones
+define_flag("FLAGS_check_nan_inf", False,
+            "instrument jitted steps with NaN/Inf checks (debug_nans)")
+define_flag("FLAGS_embedding_deterministic", True, "always true on TPU/XLA")
+define_flag("FLAGS_cudnn_deterministic", True, "always true on TPU/XLA")
+define_flag("FLAGS_allocator_strategy", "xla",
+            "allocator is XLA's (BFC on host, HBM arena on device)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 1.0, "XLA-managed")
+define_flag("FLAGS_use_pallas_kernels", True,
+            "use Pallas fused kernels (flash attention etc.) when on TPU")

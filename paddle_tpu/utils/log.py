@@ -1,0 +1,31 @@
+"""Rank-aware logging (reference: fleet ``log_util.py`` + launcher logs)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGERS = {}
+
+
+def get_logger(name="paddle_tpu", level=None):
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        f"[%(asctime)s] [rank {rank}] %(levelname)s %(name)s: %(message)s"))
+    logger.addHandler(handler)
+    logger.setLevel(level or os.environ.get("PADDLE_LOG_LEVEL", "INFO").upper())
+    logger.propagate = False
+    _LOGGERS[name] = logger
+    return logger
+
+
+logger = get_logger()
+
+
+def log_rank0(msg):
+    if os.environ.get("PADDLE_TRAINER_ID", "0") == "0":
+        logger.info(msg)

@@ -1,0 +1,9 @@
+full_version = "0.1.0"
+major = 0
+minor = 1
+patch = 0
+commit = "unknown"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU-native, JAX/XLA/Pallas core)")

@@ -1,0 +1,199 @@
+"""Vision transforms (reference: ``python/paddle/vision/transforms/``).
+
+Numpy/host-side preprocessing (HWC uint8/float images), composed in the
+DataLoader workers; device-side augmentation belongs in the jitted step.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] numpy (Tensor conversion happens
+    at collate)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        return (img - self.mean) / self.std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = self.size
+        # nearest/bilinear resize without PIL: use jax.image on host numpy
+        import jax.image
+        chw = img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[2] not in (1, 3)
+        if chw:
+            out_shape = (img.shape[0], h, w)
+        elif img.ndim == 3:
+            out_shape = (h, w, img.shape[2])
+        else:
+            out_shape = (h, w)
+        out = jax.image.resize(img.astype(np.float32), out_shape, "linear")
+        return np.asarray(out).astype(img.dtype)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        hwc = not (img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[2] not in (1, 3))
+        H, W = (img.shape[0], img.shape[1]) if hwc else (img.shape[1], img.shape[2])
+        th, tw = self.size
+        i = max((H - th) // 2, 0)
+        j = max((W - tw) // 2, 0)
+        if hwc:
+            return img[i:i + th, j:j + tw]
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, int) else self.padding[0]
+            pads = [(p, p), (p, p)] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads)
+        H, W = img.shape[0], img.shape[1]
+        th, tw = self.size
+        i = random.randint(0, max(H - th, 0))
+        j = random.randint(0, max(W - tw, 0))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            img = np.asarray(img)
+            return img[:, ::-1].copy() if img.ndim >= 2 else img
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            img = np.asarray(img)
+            return img[::-1].copy()
+        return np.asarray(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        H, W = img.shape[0], img.shape[1]
+        area = H * W
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                i = random.randint(0, H - h)
+                j = random.randint(0, W - w)
+                return self._resize(img[i:i + h, j:j + w])
+        return self._resize(CenterCrop(min(H, W))(img))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1.0 + random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * factor, 0,
+                       255 if np.asarray(img).dtype == np.uint8 else None)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)

@@ -1,0 +1,94 @@
+"""OpTest harness (reference: ``test/legacy_test/op_test.py``).
+
+Pattern: each op is checked against a NumPy oracle (``check_output``) and its
+analytic gradient against numeric differentiation (``check_grad``) — run
+through the eager tape AND the jitted path, the two execution engines of this
+framework (the reference runs eager + static graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    rtol = 1e-5
+    atol = 1e-6
+
+    def check_output(self, op_fn, np_fn, inputs, rtol=None, atol=None, **kwargs):
+        """Run op eagerly and jitted; compare both against the numpy oracle."""
+        rtol = rtol or self.rtol
+        atol = atol or self.atol
+        tensors = [paddle.to_tensor(x) for x in inputs]
+        expected = np_fn(*[np.asarray(x) for x in inputs])
+        # eager
+        out = op_fn(*tensors, **kwargs)
+        self._compare(out, expected, rtol, atol, "eager")
+        # jitted
+        import jax
+
+        def pure(vals):
+            ts = [Tensor(v) for v in vals]
+            r = op_fn(*ts, **kwargs)
+            import jax as _j
+            return _j.tree.map(lambda t: t.value, r,
+                               is_leaf=lambda t: isinstance(t, Tensor))
+
+        out_j = jax.jit(pure)([t.value for t in tensors])
+        self._compare_raw(out_j, expected, rtol, atol, "jit")
+        return out
+
+    def _compare(self, out, expected, rtol, atol, tag):
+        if isinstance(expected, (tuple, list)):
+            for o, e in zip(out, expected):
+                np.testing.assert_allclose(np.asarray(o.value), e, rtol=rtol,
+                                           atol=atol, err_msg=tag)
+        else:
+            np.testing.assert_allclose(np.asarray(out.value), expected,
+                                       rtol=rtol, atol=atol, err_msg=tag)
+
+    def _compare_raw(self, out, expected, rtol, atol, tag):
+        import jax
+        flat = jax.tree.leaves(out)
+        eflat = expected if isinstance(expected, (tuple, list)) else [expected]
+        for o, e in zip(flat, eflat):
+            np.testing.assert_allclose(np.asarray(o), e, rtol=rtol, atol=atol,
+                                       err_msg=tag)
+
+    def check_grad(self, op_fn, inputs, output_idx=0, eps=1e-3, rtol=2e-2,
+                   atol=1e-3, **kwargs):
+        """Numeric vs analytic gradient (sum-of-outputs loss)."""
+        tensors = [paddle.to_tensor(np.asarray(x, np.float64).astype(np.float32),
+                                    stop_gradient=False) for x in inputs]
+
+        def loss_of(ts):
+            out = op_fn(*ts, **kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[output_idx]
+            return out.sum() if out.ndim > 0 else out
+
+        loss = loss_of(tensors)
+        loss.backward()
+        for i, t in enumerate(tensors):
+            analytic = np.asarray(t.grad.value)
+            numeric = np.zeros_like(np.asarray(t.value))
+            flatv = np.asarray(t.value).ravel()
+            for j in range(flatv.size):
+                for sign, acc in ((1, None), ):
+                    pass
+                plus = flatv.copy()
+                plus[j] += eps
+                minus = flatv.copy()
+                minus[j] -= eps
+                tp = [paddle.to_tensor(np.asarray(x.value)) for x in tensors]
+                tp[i] = paddle.to_tensor(plus.reshape(t.shape))
+                tm = [paddle.to_tensor(np.asarray(x.value)) for x in tensors]
+                tm[i] = paddle.to_tensor(minus.reshape(t.shape))
+                with paddle.no_grad():
+                    lp = float(loss_of(tp).value)
+                    lm = float(loss_of(tm).value)
+                numeric.ravel()[j] = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                       err_msg=f"grad of input {i}")

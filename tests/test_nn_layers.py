@@ -1,0 +1,295 @@
+"""Layer/functional tests vs oracles (reference pattern: api unit tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(7)
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(x, stop_gradient=sg)
+
+
+class TestLinearEmbedding:
+    def test_linear(self):
+        layer = nn.Linear(4, 3)
+        x = rng.randn(2, 4).astype(np.float32)
+        out = layer(t(x))
+        ref = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_linear_backward(self):
+        layer = nn.Linear(4, 3)
+        x = t(rng.randn(2, 4).astype(np.float32), sg=False)
+        loss = layer(x).sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert layer.bias.grad is not None
+        np.testing.assert_allclose(layer.bias.grad.numpy(), [2, 2, 2])
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = np.array([[1, 2], [3, 4]])
+        out = emb(t(idx))
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[idx])
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        np.testing.assert_allclose(emb.weight.numpy()[0], np.zeros(4))
+
+
+class TestConvPool:
+    def test_conv2d_shape_oracle(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = rng.randn(2, 3, 16, 16).astype(np.float32)
+        out = conv(t(x))
+        assert out.shape == [2, 8, 8, 8]
+        # oracle vs scipy-style direct computation on one output pixel
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        patch = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))[0, :, 0:3, 0:3]
+        ref00 = (patch * w).sum(axis=(1, 2, 3)) + b
+        np.testing.assert_allclose(out.numpy()[0, :, 0, 0], ref00, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_conv_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        out = conv(t(rng.randn(1, 4, 8, 8).astype(np.float32)))
+        assert out.shape == [1, 8, 8, 8]
+
+    def test_maxpool_avgpool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = nn.MaxPool2D(2, 2)(t(x))
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(t(x))
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_pool(self):
+        x = rng.randn(2, 3, 7, 9).astype(np.float32)
+        out = nn.AdaptiveAvgPool2D((1, 1))(t(x))
+        np.testing.assert_allclose(out.numpy()[:, :, 0, 0],
+                                   x.mean(axis=(2, 3)), rtol=1e-5)
+        out2 = nn.AdaptiveAvgPool2D((3, 3))(t(x))
+        assert out2.shape == [2, 3, 3, 3]
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1
+        bn.train()
+        out = bn(t(x))
+        mean = x.mean(axis=(0, 2, 3))
+        var = x.var(axis=(0, 2, 3))
+        ref = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+        # running stats updated
+        np.testing.assert_allclose(bn._mean.numpy(), 0.1 * mean, rtol=1e-4,
+                                   atol=1e-4)
+        bn.eval()
+        out_e = bn(t(x))
+        ref_e = ((x - bn._mean.numpy()[None, :, None, None]) /
+                 np.sqrt(bn._variance.numpy()[None, :, None, None] + 1e-5))
+        np.testing.assert_allclose(out_e.numpy(), ref_e, rtol=1e-4, atol=1e-4)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(6)
+        x = rng.randn(2, 3, 6).astype(np.float32)
+        out = ln(t(x))
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), (x - mean) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(6)
+        x = rng.randn(2, 6).astype(np.float32)
+        out = rn(t(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = rng.randn(2, 4, 3, 3).astype(np.float32)
+        out = gn(t(x))
+        xg = x.reshape(2, 2, 2, 3, 3)
+        ref = ((xg - xg.mean(axis=(2, 3, 4), keepdims=True)) /
+               np.sqrt(xg.var(axis=(2, 3, 4), keepdims=True) + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestActivationsLosses:
+    def test_softmax_ce(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(t(logits), t(labels))
+        # numpy oracle
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.value), ref, rtol=1e-5)
+
+    def test_ce_ignore_index(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(t(logits), t(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(float(loss.value), ref, rtol=1e-5)
+
+    def test_ce_soft_label(self):
+        logits = rng.randn(3, 4).astype(np.float32)
+        soft = np.abs(rng.rand(3, 4)).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        loss = F.cross_entropy(t(logits), t(soft), soft_label=True)
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        ref = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(float(loss.value), ref, rtol=1e-5)
+
+    def test_mse_bce(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(float(F.mse_loss(t(a), t(b)).value),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)
+        lg = rng.randn(3, 4).astype(np.float32)
+        lab = (rng.rand(3, 4) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(t(lg), t(lab))
+        ref = np.maximum(lg, 0) - lg * lab + np.log1p(np.exp(-np.abs(lg)))
+        np.testing.assert_allclose(float(out.value), ref.mean(), rtol=1e-5)
+
+    def test_activations(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t(x)).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(F.silu(t(x)).numpy(),
+                                   x / (1 + np.exp(-x)), rtol=1e-5)
+        sm = F.softmax(t(x), axis=-1).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+
+
+class TestDropoutRng:
+    def test_dropout_train_eval(self):
+        x = np.ones((100, 100), np.float32)
+        d = nn.Dropout(0.5)
+        d.train()
+        out = d(t(x))
+        frac = (out.numpy() == 0).mean()
+        assert 0.4 < frac < 0.6
+        # upscale keeps expectation
+        assert abs(out.numpy().mean() - 1.0) < 0.1
+        d.eval()
+        np.testing.assert_allclose(d(t(x)).numpy(), x)
+
+    def test_dropout_deterministic_per_seed(self):
+        x = np.ones((10, 10), np.float32)
+        paddle.seed(5)
+        a = F.dropout(t(x), 0.5).numpy()
+        paddle.seed(5)
+        b = F.dropout(t(x), 0.5).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+class TestAttention:
+    def test_sdpa_vs_oracle(self):
+        B, S, H, D = 2, 5, 2, 4
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        # oracle
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(D)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = (p @ vt).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_causal(self):
+        B, S, H, D = 1, 4, 1, 2
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v), is_causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[0, 0], v[0, 0], rtol=1e-5)
+
+    def test_flash_matches_sdpa(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        B, S, H, D = 2, 8, 2, 4
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        fa, _ = IF.flash_attention(t(q), t(k), t(v), causal=True)
+        ref = F.scaled_dot_product_attention(t(q), t(k), t(v), is_causal=True)
+        np.testing.assert_allclose(fa.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_multihead_attention_layer(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = rng.randn(2, 5, 8).astype(np.float32)
+        out = mha(t(x))
+        assert out.shape == [2, 5, 8]
+
+
+class TestLayerSystem:
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        np.testing.assert_allclose(m2[0].weight.numpy(), m[0].weight.numpy())
+
+    def test_named_parameters_buffers(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+                self.bn = nn.BatchNorm1D(2)
+
+        net = Net()
+        names = dict(net.named_parameters())
+        assert "fc.weight" in names and "bn.weight" in names
+        bufs = dict(net.named_buffers())
+        assert "bn._mean" in bufs
+
+    def test_save_load(self, tmp_path):
+        m = nn.Linear(3, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(loaded)
+        np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+
+    def test_train_eval_propagation(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        m(t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+        h.remove()
+        m(t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+
+    def test_parameters_to(self):
+        import jax.numpy as jnp
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == jnp.bfloat16

@@ -1,0 +1,288 @@
+"""Op-vs-NumPy oracle tests (reference pattern: test/legacy_test/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestElementwise(OpTest):
+    def test_add(self):
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        self.check_output(paddle.add, np.add, [a, b])
+        self.check_grad(paddle.add, [a, b])
+
+    def test_broadcast_ops(self):
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        self.check_output(paddle.multiply, np.multiply, [a, b])
+        self.check_output(paddle.subtract, np.subtract, [a, b])
+        self.check_output(paddle.divide, np.divide, [a, b + 2.0])
+
+    def test_unary(self):
+        x = np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5
+        self.check_output(paddle.sqrt, np.sqrt, [x])
+        self.check_output(paddle.exp, np.exp, [x])
+        self.check_output(paddle.log, np.log, [x])
+        self.check_output(paddle.tanh, np.tanh, [x])
+        self.check_output(paddle.abs, np.abs, [x])
+        self.check_grad(paddle.sqrt, [x])
+        self.check_grad(paddle.tanh, [x])
+
+    def test_pow_clip(self):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        self.check_output(lambda t: paddle.pow(t, 2.0),
+                          lambda a: np.power(a, 2.0), [x])
+        self.check_output(lambda t: paddle.clip(t, 0.6, 1.0),
+                          lambda a: np.clip(a, 0.6, 1.0), [x])
+
+
+class TestMatmul(OpTest):
+    def test_matmul(self):
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5, 3).astype(np.float32)
+        self.check_output(paddle.matmul, np.matmul, [a, b])
+        self.check_grad(paddle.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a = rng.randn(5, 4).astype(np.float32)
+        b = rng.randn(5, 3).astype(np.float32)
+        self.check_output(
+            lambda x, y: paddle.matmul(x, y, transpose_x=True),
+            lambda x, y: np.matmul(x.T, y), [a, b])
+
+    def test_batched(self):
+        a = rng.randn(2, 4, 5).astype(np.float32)
+        b = rng.randn(2, 5, 3).astype(np.float32)
+        self.check_output(paddle.bmm, np.matmul, [a, b])
+
+    def test_einsum(self):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        self.check_output(
+            lambda x, y: paddle.einsum("bij,jk->bik", x, y),
+            lambda x, y: np.einsum("bij,jk->bik", x, y), [a, b])
+
+
+class TestReduce(OpTest):
+    def test_sum_mean(self):
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        self.check_output(paddle.sum, np.sum, [x])
+        self.check_output(lambda t: paddle.sum(t, axis=1),
+                          lambda a: np.sum(a, axis=1), [x])
+        self.check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                          lambda a: np.mean(a, axis=(0, 2), keepdims=True), [x])
+        self.check_grad(lambda t: paddle.mean(t), [x])
+
+    def test_max_min_prod(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        self.check_output(paddle.max, np.max, [x])
+        self.check_output(lambda t: paddle.min(t, axis=0),
+                          lambda a: np.min(a, axis=0), [x])
+        self.check_output(lambda t: paddle.prod(t, axis=1),
+                          lambda a: np.prod(a, axis=1), [x])
+
+    def test_std_var_logsumexp(self):
+        x = rng.randn(6, 4).astype(np.float32)
+        self.check_output(paddle.var, lambda a: np.var(a, ddof=1), [x],
+                          rtol=1e-4)
+        from scipy.special import logsumexp as _lse
+        self.check_output(paddle.logsumexp, lambda a: _lse(a), [x], rtol=1e-4)
+
+    def test_cumsum(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.cumsum(t, axis=1),
+                          lambda a: np.cumsum(a, axis=1), [x])
+
+
+class TestSearchSort(OpTest):
+    def test_argmax_sort(self):
+        x = rng.randn(4, 6).astype(np.float32)
+        self.check_output(lambda t: paddle.argmax(t, axis=1),
+                          lambda a: np.argmax(a, axis=1), [x])
+        self.check_output(lambda t: paddle.sort(t, axis=1),
+                          lambda a: np.sort(a, axis=1), [x])
+        self.check_output(lambda t: paddle.argsort(t, axis=1),
+                          lambda a: np.argsort(a, axis=1, kind="stable"), [x])
+
+    def test_topk(self):
+        x = rng.randn(3, 8).astype(np.float32)
+        v, i = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(v.numpy(), ref, rtol=1e-6)
+
+    def test_where_comparison(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        self.check_output(lambda x, y: paddle.where(x > y, x, y),
+                          lambda x, y: np.where(x > y, x, y), [a, b])
+
+
+class TestManipulation(OpTest):
+    def test_reshape_transpose(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.reshape(t, [4, 6]),
+                          lambda a: a.reshape(4, 6), [x])
+        self.check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                          lambda a: np.transpose(a, (2, 0, 1)), [x])
+        self.check_grad(lambda t: paddle.reshape(t, [-1]), [x])
+
+    def test_concat_split_stack(self):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.randn(2, 3).astype(np.float32)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        assert [p.shape for p in parts] == [[2, 1], [2, 2]]
+        st = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        assert st.shape == [2, 2, 3]
+
+    def test_squeeze_expand_tile(self):
+        x = rng.randn(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3]
+        assert paddle.unsqueeze(paddle.to_tensor(x), 0).shape == [1, 1, 3, 1]
+        e = paddle.expand(paddle.to_tensor(x), [4, 3, 5])
+        assert e.shape == [4, 3, 5]
+        t = paddle.tile(paddle.to_tensor(x), [2, 1, 2])
+        assert t.shape == [2, 3, 2]
+
+    def test_gather_scatter(self):
+        x = rng.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        self.check_output(lambda t, i: paddle.gather(t, i, axis=0),
+                          lambda a, i: a[i], [x, idx])
+        g = paddle.gather_nd(paddle.to_tensor(x),
+                             paddle.to_tensor(np.array([[0, 1], [2, 2]])))
+        np.testing.assert_allclose(g.numpy(), x[[0, 2], [1, 2]])
+
+    def test_pad(self):
+        x = rng.randn(2, 3, 4, 5).astype(np.float32)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 2, 3, 4])
+        assert out.shape == [2, 3, 4 + 3 + 4, 5 + 1 + 2]
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(x[1].numpy(), np.arange(4, 8))
+        np.testing.assert_allclose(x[:, 1:3].numpy(),
+                                   np.arange(12).reshape(3, 4)[:, 1:3])
+        x[0] = 0.0
+        assert float(x[0].sum().value) == 0.0
+
+
+class TestCreation(OpTest):
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int32").dtype == np.int32
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        f = paddle.full([2, 2], 7.0)
+        assert float(f.numpy()[0, 0]) == 7.0
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_random_deterministic(self):
+        paddle.seed(123)
+        a = paddle.randn([4, 4])
+        paddle.seed(123)
+        b = paddle.randn([4, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_tril_triu(self):
+        x = rng.randn(4, 4).astype(np.float32)
+        self.check_output(paddle.tril, np.tril, [x])
+        self.check_output(paddle.triu, np.triu, [x])
+
+
+class TestAutogradEngine:
+    def test_chain(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x + 2 * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 2)
+
+    def test_shared_node(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        y = x * x
+        z = y + y
+        z.backward()
+        np.testing.assert_allclose(float(x.grad.value), 8.0)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None and y.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = (x * 2).sum()
+        assert y._grad_node is None
+
+    def test_grad_api(self):
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(float(g.value), 6.0)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        d = (x * 2).detach()
+        (d * 3).sum().backward()
+        assert x.grad is None
+
+    def test_tensor_hook(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 1.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(float(x.grad.value), 8.0)
+
+
+class TestCodeReviewRegressions:
+    """Regression tests for review findings (grad-on-intermediate, masked_select
+    under grad, softplus overflow grad)."""
+
+    def test_grad_on_intermediate_tensor(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        y = x * 2
+        z = (y * y).sum()
+        (gy,) = paddle.grad(z, [y])
+        np.testing.assert_allclose(float(gy.value), 8.0)  # dz/dy = 2y = 8
+
+    def test_masked_select_with_grad_input(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        out = paddle.masked_select(x, paddle.to_tensor(np.array([True, False, True])))
+        np.testing.assert_allclose(out.numpy(), [1.0, 3.0])
+
+    def test_softplus_large_input_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.float32(100.0), stop_gradient=False)
+        y = F.softplus(x)
+        y.backward()
+        assert np.isfinite(float(x.grad.value))
+        np.testing.assert_allclose(float(x.grad.value), 1.0, rtol=1e-5)
+
+    def test_maxpool_return_mask_and_ceil(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+        np.testing.assert_array_equal(mask.numpy()[0, 0], [[5, 7], [13, 15]])
+        x5 = paddle.to_tensor(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+        out_c = F.max_pool2d(x5, 2, 2, ceil_mode=True)
+        assert out_c.shape == [1, 1, 3, 3]
+        out_f = F.max_pool2d(x5, 2, 2, ceil_mode=False)
+        assert out_f.shape == [1, 1, 2, 2]

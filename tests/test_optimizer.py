@@ -1,0 +1,137 @@
+"""Optimizer + LR scheduler tests vs numpy oracles."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Momentum, lr as lr_mod
+
+
+def make_param(val):
+    from paddle_tpu.core.tensor import Parameter
+    return Parameter(np.asarray(val, np.float32))
+
+
+class TestSGDMomentum:
+    def test_sgd_step(self):
+        p = make_param([1.0, 2.0])
+        opt = SGD(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([0.5, 1.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.95, 1.9], rtol=1e-6)
+
+    def test_momentum(self):
+        p = make_param([1.0])
+        opt = Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        g = np.array([1.0], np.float32)
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        # v = 0.9*1 + 1 = 1.9; p = 0.9 - 0.19
+        np.testing.assert_allclose(p.numpy(), [0.71], rtol=1e-5)
+
+    def test_weight_decay_l2(self):
+        p = make_param([1.0])
+        opt = SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+        p.grad = paddle.to_tensor(np.array([0.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+class TestAdam:
+    def test_adam_first_step(self):
+        p = make_param([1.0])
+        opt = Adam(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([0.5], np.float32))
+        opt.step()
+        # bias-corrected first step: delta ~= lr * g/|g| = lr
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1], rtol=1e-4)
+
+    def test_adamw_decoupled(self):
+        p = make_param([1.0])
+        opt = AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+        p.grad = paddle.to_tensor(np.array([0.0], np.float32))
+        opt.step()
+        # no grad: adam delta 0, only decay: p *= (1 - lr*coeff)
+        np.testing.assert_allclose(p.numpy(), [0.99], rtol=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        p1 = make_param([3.0])
+        p2 = make_param([4.0])
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+        p1.grad = paddle.to_tensor(np.array([3.0], np.float32))
+        p2.grad = paddle.to_tensor(np.array([4.0], np.float32))
+        opt.step()
+        # norm 5 -> scale 0.2
+        np.testing.assert_allclose(p1.numpy(), [3.0 - 0.6], rtol=1e-5)
+        np.testing.assert_allclose(p2.numpy(), [4.0 - 0.8], rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        p = make_param([1.0])
+        p.name = "w"
+        opt = Adam(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([0.5], np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        p2 = make_param([1.0])
+        p2.name = "w"
+        opt2 = Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(
+            opt2._slots[id(p2)]["moment1"], opt._slots[id(p)]["moment1"])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_linear_warmup(self):
+        s = lr_mod.LinearWarmup(learning_rate=1.0, warmup_steps=4,
+                                start_lr=0.0, end_lr=1.0)
+        vals = [s() for _ in range(1) ]
+        seq = []
+        for _ in range(6):
+            seq.append(s())
+            s.step()
+        np.testing.assert_allclose(seq[:4], [0.0, 0.25, 0.5, 0.75])
+        np.testing.assert_allclose(seq[4:], [1.0, 1.0])
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_noam(self):
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        v0 = s()
+        for _ in range(99):
+            s.step()
+        v_peak = s()
+        for _ in range(400):
+            s.step()
+        assert v_peak > v0 and v_peak > s()
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)  # 2 bad steps -> reduce
+        assert s() == 0.5
+
+    def test_optimizer_uses_scheduler(self):
+        p = make_param([1.0])
+        sched = lr_mod.StepDecay(learning_rate=1.0, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        assert opt.get_lr() == 1.0
+        sched.step()
+        assert abs(opt.get_lr() - 0.1) < 1e-9

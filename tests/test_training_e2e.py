@@ -1,0 +1,269 @@
+"""End-to-end training tests — the M0 milestone slice (SURVEY.md §7):
+eager loop, jitted TrainStep, AMP, hapi Model.fit, ResNet fwd/bwd
+(reference pattern: model-level smoke tests + convergence-direction checks).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import SGD, Adam
+
+
+def _toy_data(n=64, din=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(din, 1).astype(np.float32)
+    x = rng.randn(n, din).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+class TestEagerTraining:
+    def test_regression_converges(self):
+        paddle.seed(0)
+        x, y = _toy_data()
+        model = nn.Linear(8, 1)
+        opt = SGD(learning_rate=0.05, parameters=model.parameters())
+        first = None
+        for i in range(50):
+            pred = model(paddle.to_tensor(x))
+            loss = F.mse_loss(pred, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.value)
+        assert float(loss.value) < first * 0.1
+
+    def test_classification_eager(self):
+        paddle.seed(1)
+        rng = np.random.RandomState(1)
+        x = rng.randn(128, 4).astype(np.float32)
+        y = (x.sum(-1) > 0).astype(np.int32)
+        model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        opt = Adam(learning_rate=0.01, parameters=model.parameters())
+        for _ in range(30):
+            loss = F.cross_entropy(model(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        acc = (np.argmax(model(paddle.to_tensor(x)).numpy(), -1) == y).mean()
+        assert acc > 0.9
+
+
+class TestJitTrainStep:
+    def test_jit_matches_eager(self):
+        paddle.seed(3)
+        x, y = _toy_data()
+        m1 = nn.Linear(8, 1)
+        m2 = nn.Linear(8, 1)
+        m2.set_state_dict(m1.state_dict())
+        opt1 = SGD(learning_rate=0.1, parameters=m1.parameters())
+        opt2 = SGD(learning_rate=0.1, parameters=m2.parameters())
+        # eager steps
+        eager_losses = []
+        for i in range(5):
+            loss = F.mse_loss(m1(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            opt1.step()
+            opt1.clear_grad()
+            eager_losses.append(float(loss.value))
+        # jitted steps
+        step = TrainStep(m2, lambda out, lab: F.mse_loss(out, lab), opt2)
+        jit_losses = [float(step.step((paddle.to_tensor(x),),
+                                      (paddle.to_tensor(y),)).value)
+                      for _ in range(5)]
+        np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-4)
+        step.sync_to_model()
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-4)
+
+    def test_batchnorm_buffers_update_in_jit(self):
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8),
+                              nn.Linear(8, 1))
+        opt = SGD(learning_rate=0.01, parameters=model.parameters())
+        step = TrainStep(model, lambda o, l: F.mse_loss(o, l), opt)
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.zeros((16, 1), np.float32)
+        before = model[1]._mean.numpy().copy()
+        step.step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        step.sync_to_model()
+        after = model[1]._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_accum_step_equivalence(self):
+        paddle.seed(5)
+        x, y = _toy_data(n=32)
+        m1 = nn.Linear(8, 1)
+        m2 = nn.Linear(8, 1)
+        m2.set_state_dict(m1.state_dict())
+        o1 = SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = SGD(learning_rate=0.1, parameters=m2.parameters())
+        s1 = TrainStep(m1, lambda o, l: F.mse_loss(o, l), o1)
+        s2 = TrainStep(m2, lambda o, l: F.mse_loss(o, l), o2)
+        l1 = s1.step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        l2 = s2.accum_step((paddle.to_tensor(x),), (paddle.to_tensor(y),), 4)
+        s1.sync_to_model()
+        s2.sync_to_model()
+        # microbatched grads averaged == full-batch grads (linear + mse mean)
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dropout_varies_across_steps_in_jit(self):
+        paddle.seed(6)
+        model = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5),
+                              nn.Linear(32, 1))
+        opt = SGD(learning_rate=0.0, parameters=model.parameters())
+        step = TrainStep(model, lambda o, l: F.mse_loss(o, l), opt)
+        x = np.ones((4, 8), np.float32)
+        y = np.zeros((4, 1), np.float32)
+        l1 = float(step.step((paddle.to_tensor(x),), (paddle.to_tensor(y),)).value)
+        l2 = float(step.step((paddle.to_tensor(x),), (paddle.to_tensor(y),)).value)
+        assert l1 != l2  # different dropout masks per step under jit
+
+
+class TestAMP:
+    def test_autocast_bf16_matmul(self):
+        import jax.numpy as jnp
+        a = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+        # blacklisted op stays fp32
+        with paddle.amp.auto_cast(level="O1"):
+            s = F.softmax(a)
+        assert s.dtype == jnp.float32
+
+    def test_amp_training_converges(self):
+        paddle.seed(7)
+        x, y = _toy_data()
+        model = nn.Linear(8, 1)
+        opt = SGD(learning_rate=0.05, parameters=model.parameters())
+        first = None
+        for _ in range(30):
+            with paddle.amp.auto_cast(level="O1"):
+                loss = F.mse_loss(model(paddle.to_tensor(x)),
+                                  paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first or float(loss.value)
+        assert float(loss.value) < first * 0.3
+
+    def test_grad_scaler_fp16_flow(self):
+        paddle.seed(8)
+        model = nn.Linear(4, 1)
+        opt = SGD(learning_rate=0.01, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 1), np.float32))
+        loss = F.mse_loss(model(x), y)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        assert scaler.state_dict()["scale"] == 128.0
+
+
+class TestHapiModel:
+    def test_fit_evaluate(self):
+        paddle.seed(9)
+        from paddle_tpu.io import TensorDataset
+        x, y = _toy_data(n=32)
+        ds = TensorDataset([x, y])
+        model = paddle.Model(nn.Linear(8, 1))
+        model.prepare(SGD(learning_rate=0.05,
+                          parameters=model.parameters()),
+                      nn.MSELoss())
+        model.fit(ds, batch_size=8, epochs=15, verbose=0)
+        logs = model.evaluate(ds, batch_size=8, verbose=0)
+        assert logs["loss"] < 1.0
+
+    def test_save_load(self, tmp_path):
+        model = paddle.Model(nn.Linear(4, 2))
+        model.prepare(SGD(learning_rate=0.1, parameters=model.parameters()),
+                      nn.MSELoss())
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+        m2 = paddle.Model(nn.Linear(4, 2))
+        m2.prepare(SGD(learning_rate=0.1, parameters=m2.parameters()),
+                   nn.MSELoss())
+        m2.load(p)
+        np.testing.assert_allclose(m2.network.weight.numpy(),
+                                   model.network.weight.numpy())
+
+
+class TestResNet:
+    def test_resnet18_fwd_bwd(self):
+        paddle.seed(10)
+        from paddle_tpu.vision.models import resnet18
+        model = resnet18(num_classes=10)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 3]))
+        out = model(x)
+        assert out.shape == [2, 10]
+        loss = F.cross_entropy(out, y)
+        loss.backward()
+        grads = [p.grad for p in model.parameters() if not p.stop_gradient]
+        assert all(g is not None for g in grads)
+
+    def test_resnet18_jit_train_smoke(self):
+        paddle.seed(11)
+        from paddle_tpu.vision.models import resnet18
+        model = resnet18(num_classes=4)
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=model.parameters())
+        step = TrainStep(model, lambda o, l: F.cross_entropy(o, l), opt)
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 4, 4)
+        l1 = float(step.step((paddle.to_tensor(x),),
+                             (paddle.to_tensor(y),)).value)
+        for _ in range(5):
+            l2 = float(step.step((paddle.to_tensor(x),),
+                                 (paddle.to_tensor(y),)).value)
+        assert l2 < l1  # memorizes the fixed batch
+
+
+class TestDataLoader:
+    def test_dataloader_batching(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        y = np.arange(10, dtype=np.int32)
+        dl = DataLoader(TensorDataset([x, y]), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [4, 2]
+        assert batches[2][0].shape == [2, 2]
+
+    def test_dataloader_workers_order(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        class Sq(Dataset):
+            def __len__(self):
+                return 17
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.int32)
+
+        dl = DataLoader(Sq(), batch_size=4, num_workers=2)
+        got = np.concatenate([b.numpy().ravel() for b in dl])
+        np.testing.assert_array_equal(got, np.arange(17))
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DistributedBatchSampler
+        from paddle_tpu.io.dataset import TensorDataset
+        ds = TensorDataset([np.arange(10, dtype=np.float32)])
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(set(i0) & set(i1)) == 0
+        assert len(i0) == len(i1) == 5
