@@ -3,12 +3,13 @@
 lives in ``paddle_tpu/parallel`` per this repo's layout)."""
 from ..parallel import *  # noqa: F401,F403
 from ..parallel import (DataParallel, Group, ParallelEnv, ReduceOp, all_gather,
-                        all_reduce, alltoall, barrier, broadcast, fleet,
+                        all_reduce, alltoall, barrier, broadcast,
                         get_rank, get_world_size, init_parallel_env,
                         is_initialized, new_group, recv, reduce,
                         reduce_scatter, scatter, send, spawn,
                         load_state_dict, save_state_dict,
                         group_sharded_parallel, save_group_sharded_model)
+from . import fleet
 from ..parallel import checkpoint, moe
 from ..parallel.fleet.recompute import recompute
 from ..parallel import launch  # noqa: F401

@@ -1,0 +1,9 @@
+"""paddle.distributed.fleet module path — re-export of paddle_tpu.parallel.fleet."""
+from ..parallel.fleet import *  # noqa: F401,F403
+from ..parallel.fleet import (DistributedStrategy, Fleet, HybridParallelOptimizer,
+                              LayerDesc, PipelineLayer, SharedLayerDesc,
+                              barrier_worker, distributed_model,
+                              distributed_optimizer, fleet,
+                              get_hybrid_communicate_group, init,
+                              is_first_worker, meta_parallel, mp, recompute,
+                              sp, utils, worker_index, worker_num)

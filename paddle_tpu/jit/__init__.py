@@ -31,6 +31,25 @@ def _norm_batch(inputs):
     return _unwrap(inputs if isinstance(inputs, tuple) else (inputs,))
 
 
+def _clean_spec(spec, value, axis_names):
+    """Drop axis names not present in the mesh; pad/truncate to value rank."""
+    from jax.sharding import PartitionSpec as P
+    if spec is None:
+        return None
+    parts = list(spec)
+    parts = parts[:value.ndim] + [None] * (value.ndim - len(parts))
+    out = []
+    for s in parts:
+        if isinstance(s, str) and s not in axis_names:
+            out.append(None)
+        elif isinstance(s, tuple):
+            kept = tuple(n for n in s if n in axis_names)
+            out.append(kept if kept else None)
+        else:
+            out.append(s)
+    return P(*out)
+
+
 def _norm_labels(labels):
     labels = _unwrap(labels if isinstance(labels, tuple) else (labels,))
     return labels if len(labels) > 1 else labels[0]
@@ -125,9 +144,11 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer, *,
-                 mesh=None, param_spec_fn=None, batch_spec=None,
-                 grad_accum_steps: int = 1, donate: bool = True,
-                 loss_scale=None):
+                 mesh=None, batch_axes=None, sharding_stage: int = 0,
+                 param_spec_fn=None, grad_accum_steps: int = 1,
+                 donate: bool = True, loss_scale=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -142,9 +163,55 @@ class TrainStep:
             # so steady-state memory is 1x.
             params = jax.tree.map(jnp.copy, params)
             buffers = jax.tree.map(jnp.copy, buffers)
+
+        # ------------------------------------------------------ mesh placement
+        # Parameters carry PartitionSpecs (mp layers set .dist_spec); ZeRO
+        # stages add 'sharding'-axis specs (params stage>=3, opt slots
+        # stage>=1). Placement = committed shardings on the input arrays; XLA
+        # GSPMD propagates them through the step (completion+partitioner+
+        # reshard of the reference's auto-parallel engine, SURVEY.md §3.4).
+        self._batch_spec = None
+        if mesh is not None:
+            from ..parallel import sharding_api as zsh
+            axis_names = set(mesh.axis_names)
+            if batch_axes is None:
+                batch_axes = tuple(a for a in ("dp", "sharding")
+                                   if a in axis_names and mesh.shape[a] > 1) \
+                    or tuple(a for a in ("dp",) if a in axis_names)
+            self._batch_spec = (tuple(batch_axes) if len(batch_axes) > 1
+                                else (batch_axes[0] if batch_axes else None))
+            shard_deg = mesh.shape.get("sharding", 1)
+            param_objs = {n: p for n, p in model.named_parameters()
+                          if not p.stop_gradient}
+
+            def pspec(name, value):
+                base = getattr(param_objs.get(name), "dist_spec", None)
+                if param_spec_fn is not None:
+                    base = param_spec_fn(name, value) or base
+                base = _clean_spec(base, value, axis_names)
+                return zsh.param_spec_for_stage(value.shape, base,
+                                                sharding_stage, shard_deg)
+
+            self._param_specs = {n: pspec(n, v) for n, v in params.items()}
+            params = {n: jax.device_put(v, NamedSharding(
+                mesh, self._param_specs[n] or P())) for n, v in params.items()}
+            repl = NamedSharding(mesh, P())
+            buffers = {n: jax.device_put(v, repl) for n, v in buffers.items()}
         self._params = params
         self._buffers = buffers
         self._opt_state = optimizer.init_state(params)
+        if mesh is not None:
+            from ..parallel import sharding_api as zsh
+            shard_deg = mesh.shape.get("sharding", 1)
+            slots = {}
+            for n, slotd in self._opt_state["slots"].items():
+                spec = zsh.opt_state_spec(params[n].shape,
+                                          self._param_specs.get(n),
+                                          max(sharding_stage, 1) if shard_deg > 1
+                                          else 0, shard_deg)
+                sh = NamedSharding(mesh, spec or P())
+                slots[n] = {k: jax.device_put(v, sh) for k, v in slotd.items()}
+            self._opt_state = {"slots": slots, "step": self._opt_state["step"]}
         self._grad_accum = grad_accum_steps
         self.loss_scale = loss_scale  # amp.GradScaler for fp16 (bf16 needs none)
 
@@ -214,6 +281,20 @@ class TrainStep:
         self._compiled_eval = jax.jit(eval_fn)
 
     # -------------------------------------------------------------- stepping
+    def _place_batch(self, tree):
+        """Commit batch arrays to the mesh with the dp(+sharding) sharding."""
+        if self.mesh is None or self._batch_spec is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(a):
+            if getattr(a, "ndim", 0) >= 1:
+                spec = P(self._batch_spec, *([None] * (a.ndim - 1)))
+                return jax.device_put(a, NamedSharding(self.mesh, spec))
+            return a
+
+        return jax.tree.map(put, tree)
+
     def __call__(self, inputs, labels):
         return self.step(inputs, labels)
 
@@ -221,6 +302,7 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.fold_in(self._base_key, self._step_count)
         inputs, labels = _norm_batch(inputs), _norm_labels(labels)
+        inputs, labels = self._place_batch(inputs), self._place_batch(labels)
         loss, self._params, self._buffers, self._opt_state = self._compiled(
             self._params, self._buffers, self._opt_state, inputs, labels,
             lr, key)
@@ -234,6 +316,7 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.fold_in(self._base_key, self._step_count)
         inputs, labels = _norm_batch(inputs), _norm_labels(labels)
+        inputs, labels = self._place_batch(inputs), self._place_batch(labels)
         loss, self._params, self._buffers, self._opt_state = \
             self._accum_compiled(
                 self._params, self._buffers, self._opt_state, inputs, labels,
@@ -249,6 +332,17 @@ class TrainStep:
         loss = self._compiled_eval(self._params, self._buffers, inputs,
                                    labels, key)
         return Tensor(loss)
+
+    def lower_text(self, inputs, labels) -> str:
+        """Lowered (post-SPMD-able) HLO of the train step — for compile-only
+        tests asserting collective placement (SURVEY.md §4 pattern 3)."""
+        lr = jnp.zeros((), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        inputs, labels = _norm_batch(inputs), _norm_labels(labels)
+        inputs, labels = self._place_batch(inputs), self._place_batch(labels)
+        return self._compiled.lower(self._params, self._buffers,
+                                    self._opt_state, inputs, labels, lr,
+                                    key).compile().as_text()
 
     def sync_to_model(self):
         """Write the device-side params/buffers back into the Layer tree

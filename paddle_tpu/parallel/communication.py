@@ -127,7 +127,7 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
     if _is_sharded_over(v, g):
         prog = jax.jit(jax.shard_map(
             lambda x: jax.lax.all_gather(x, axes, axis=0),
-            mesh=g.mesh, in_specs=P(axes), out_specs=P()))
+            mesh=g.mesh, in_specs=P(axes), out_specs=P(), check_vma=False))
         gathered = prog(v)  # [nranks, *local_shape] replicated
     else:
         gathered = jnp.broadcast_to(v[None], (g.nranks,) + v.shape)

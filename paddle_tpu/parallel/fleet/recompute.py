@@ -20,28 +20,46 @@ from ...nn.layer import Layer, Sequential
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.utils.recompute(fn_or_layer, *args).
 
-    Inside a jitted step this wraps the callable in jax.checkpoint; the eager
-    tape path recomputes through jax.checkpoint's VJP as well (one op-level
-    application).
+    Wraps the callable in ``jax.checkpoint`` so activations are
+    rematerialized in the backward pass. If ``function`` is a Layer (or a
+    bound Layer method), its parameters are threaded through as
+    differentiable inputs so parameter grads flow on the eager tape too.
     """
-    use_reentrant = kwargs.pop("use_reentrant", True)
-    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
 
-    fn = function if callable(function) and not isinstance(function, Layer) \
-        else function
-
-    def pure(*vals):
-        with no_grad():
-            t_args = [Tensor(v) for v in vals]
-            out = fn(*t_args)
-        if isinstance(out, (tuple, list)):
-            return tuple(o.value if isinstance(o, Tensor) else o for o in out)
-        return out.value if isinstance(out, Tensor) else out
-
-    ck = jax.checkpoint(pure)
+    target = function if isinstance(function, Layer) else \
+        getattr(function, "__self__", None)
     from ...ops._op import apply
-    return apply(ck, tuple(a.value if isinstance(a, Tensor) else a
-                           for a in args), {}, name="recompute")
+
+    if isinstance(target, Layer):
+        from ...jit.functional import bind
+        named = [(n, p) for n, p in target.named_parameters()
+                 if not p.stop_gradient]
+        names = [n for n, _ in named]
+        param_tensors = [p for _, p in named]
+
+        def pure(arg_vals, pvals):
+            with bind(target, dict(zip(names, pvals)), {}):
+                with no_grad():
+                    out = function(*[Tensor(v) for v in arg_vals])
+            return jax.tree.map(
+                lambda o: o.value if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+
+        ck = jax.checkpoint(pure)
+        return apply(ck, (tuple(args), list(param_tensors)), {},
+                     name="recompute")
+
+    def pure_fn(*vals):
+        with no_grad():
+            out = function(*[Tensor(v) for v in vals])
+        return jax.tree.map(
+            lambda o: o.value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    ck = jax.checkpoint(pure_fn)
+    return apply(ck, tuple(args), {}, name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

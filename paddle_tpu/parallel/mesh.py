@@ -37,8 +37,14 @@ def build_mesh(axis_degrees: Dict[str, int], devices=None) -> Mesh:
         raise ValueError(
             f"mesh degrees {dict(zip(names, degrees))} product {total} != "
             f"device count {len(devices)}")
+    # Auto axis types = GSPMD propagation from annotations (jax>=0.9 defaults
+    # make_mesh to Explicit sharding-in-types, which type-checks eager dots —
+    # not what the paddle-shaped annotate-and-let-XLA-partition model wants).
+    from jax.sharding import AxisType
+    auto = (AxisType.Auto,) * len(names)
     try:
-        mesh = jax.make_mesh(tuple(degrees), tuple(names), devices=devices)
+        mesh = jax.make_mesh(tuple(degrees), tuple(names), devices=devices,
+                             axis_types=auto)
     except TypeError:
         arr = np.asarray(devices).reshape(degrees)
         mesh = Mesh(arr, tuple(names))

@@ -93,7 +93,7 @@ def _gshard_dispatch(logits, key, capacity, num_expert, random_routing, second_p
     # second expert
     probs_wo1 = probs * (1 - mask1)
     g2_idx = jnp.argmax(probs_wo1, axis=-1)
-    g2 = jnp.sum(probs_wo1 * jax.nn.one_hot(g2_idx, E, jnp.float32), axis=-1)
+    g2 = jnp.sum(probs_wo1 * jax.nn.one_hot(g2_idx, E, dtype=jnp.float32), axis=-1)
     if random_routing:
         # GShard: route to 2nd expert with prob 2*g2 (else drop)
         u = jax.random.uniform(key, (S,))

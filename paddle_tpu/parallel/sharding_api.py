@@ -32,10 +32,19 @@ def _shardable_dim(shape, axis_size):
     return None
 
 
+def _has_axis(spec, axis):
+    if spec is None:
+        return False
+    for s in spec:
+        if s == axis or (isinstance(s, tuple) and axis in s):
+            return True
+    return False
+
+
 def param_spec_for_stage(param_shape, base_spec, stage, axis_size):
     """Spec for the parameter itself: stage 3 shards params; stages 1/2
     leave them as-is (replicated across 'sharding')."""
-    if stage < 3 or axis_size <= 1:
+    if stage < 3 or axis_size <= 1 or _has_axis(base_spec, SHARDING_AXIS):
         return base_spec
     spec = list(base_spec) if base_spec is not None else [None] * len(param_shape)
     while len(spec) < len(param_shape):
@@ -49,7 +58,7 @@ def param_spec_for_stage(param_shape, base_spec, stage, axis_size):
 
 def opt_state_spec(param_shape, base_spec, stage, axis_size):
     """Spec for optimizer slots: any stage >=1 shards them over 'sharding'."""
-    if stage < 1 or axis_size <= 1:
+    if stage < 1 or axis_size <= 1 or _has_axis(base_spec, SHARDING_AXIS):
         return base_spec
     spec = list(base_spec) if base_spec is not None else [None] * len(param_shape)
     while len(spec) < len(param_shape):

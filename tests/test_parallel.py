@@ -1,0 +1,403 @@
+"""Distributed tests on the 8-device virtual mesh.
+
+Reference patterns (SURVEY.md §4):
+- parallel-vs-serial loss parity (TestDistBase / hybrid_parallel_mp_model.py)
+- collective API correctness (test_collective_api_base.py)
+- compile-only assertions on program transforms (auto-parallel tests) —
+  here: collectives present/absent in the lowered HLO.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import SGD, AdamW
+
+
+def _reset_fleet(**degrees):
+    from paddle_tpu.parallel import mesh as mesh_mod
+    mesh_mod._STATE["mesh"] = None
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = degrees
+    fleet.init(is_collective=True, strategy=s)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _data(n=16, din=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, din).astype(np.float32), rng.randint(0, classes, n))
+
+
+class TestMeshTopology:
+    def test_hybrid_mesh_shape(self):
+        hcg = _reset_fleet(dp_degree=2, mp_degree=2, pp_degree=2)
+        assert dict(hcg.mesh.shape) == {"dp": 2, "pp": 2, "sharding": 1,
+                                        "sep": 1, "mp": 2}
+        assert hcg.get_model_parallel_group().nranks == 2
+        assert hcg.get_data_parallel_group().nranks == 2
+
+    def test_topology_rank_math(self):
+        from paddle_tpu.parallel.fleet.topology import CommunicateTopology
+        topo = CommunicateTopology(["dp", "pp", "mp"], [2, 2, 2])
+        assert topo.get_rank(dp=0, pp=0, mp=1) == 1
+        assert topo.get_rank(dp=1, pp=0, mp=0) == 4
+        coord = topo.get_coord(5)
+        assert (coord.dp, coord.pp, coord.mp) == (1, 0, 1)
+        comm = topo.get_comm_list("mp")
+        assert [0, 1] in comm and [4, 5] in comm
+
+
+class TestCollectiveAPI:
+    """Pattern B: known inputs -> exact collective results."""
+
+    def test_all_reduce_sharded(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        mesh = mesh_mod.ensure_mesh({"dp": 8})
+        # per-rank contributions 0..7 in the leading dim
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        arr = jax.device_put(x, NamedSharding(mesh, P("dp")))
+        t = paddle.Tensor(arr)
+        paddle.distributed.all_reduce(t)
+        np.testing.assert_allclose(np.asarray(t.value), [[28.0]])
+
+    def test_all_gather(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        mesh = mesh_mod.ensure_mesh({"dp": 8})
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        t = paddle.Tensor(jax.device_put(x, NamedSharding(mesh, P("dp"))))
+        parts = paddle.distributed.all_gather(None, t)
+        assert len(parts) == 8
+        np.testing.assert_allclose(parts[3].numpy(), x[3:4])
+
+    def test_barrier(self):
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        mesh_mod.ensure_mesh({"dp": 8})
+        paddle.distributed.barrier()
+
+
+class TestDataParallelParity:
+    """Pattern A: dp-parallel loss == serial loss, step by step."""
+
+    def test_dp8_matches_serial(self):
+        paddle.seed(100)
+        hcg = _reset_fleet(dp_degree=8)
+        x, y = _data(n=16)
+        m1 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m2.set_state_dict(m1.state_dict())
+        serial = TrainStep(m1, lambda o, l: F.cross_entropy(o, l),
+                           SGD(learning_rate=0.1, parameters=m1.parameters()))
+        par = TrainStep(m2, lambda o, l: F.cross_entropy(o, l),
+                        SGD(learning_rate=0.1, parameters=m2.parameters()),
+                        mesh=hcg.mesh)
+        ls, lp = [], []
+        for i in range(4):
+            ls.append(float(serial.step((paddle.to_tensor(x),),
+                                        (paddle.to_tensor(y),)).value))
+            lp.append(float(par.step((paddle.to_tensor(x),),
+                                     (paddle.to_tensor(y),)).value))
+        np.testing.assert_allclose(ls, lp, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(m1[0].weight.numpy(), m2[0].weight.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTensorParallelParity:
+    def _models(self, hcg):
+        paddle.seed(200)
+        serial = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        tp = nn.Sequential(
+            fleet.meta_parallel.ColumnParallelLinear(8, 16, gather_output=False),
+            nn.ReLU(),
+            fleet.meta_parallel.RowParallelLinear(16, 4, input_is_parallel=True),
+        )
+        tp.set_state_dict(serial.state_dict())
+        return serial, tp
+
+    def test_mp8_matches_serial(self):
+        hcg = _reset_fleet(mp_degree=8)
+        serial, tp = self._models(hcg)
+        x, y = _data(n=8)
+        s_step = TrainStep(serial, lambda o, l: F.cross_entropy(o, l),
+                           SGD(learning_rate=0.1,
+                               parameters=serial.parameters()))
+        t_step = TrainStep(tp, lambda o, l: F.cross_entropy(o, l),
+                           SGD(learning_rate=0.1, parameters=tp.parameters()),
+                           mesh=hcg.mesh)
+        for i in range(3):
+            ls = float(s_step.step((paddle.to_tensor(x),),
+                                   (paddle.to_tensor(y),)).value)
+            lt = float(t_step.step((paddle.to_tensor(x),),
+                                   (paddle.to_tensor(y),)).value)
+            np.testing.assert_allclose(ls, lt, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(serial[0].weight.numpy(),
+                                   tp[0].weight.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_mp_weights_actually_sharded(self):
+        hcg = _reset_fleet(mp_degree=8)
+        _, tp = self._models(hcg)
+        step = TrainStep(tp, lambda o, l: F.cross_entropy(o, l),
+                         SGD(learning_rate=0.1, parameters=tp.parameters()),
+                         mesh=hcg.mesh)
+        w = step.params["0.weight"]
+        # column-parallel weight [8,16] sharded over mp on dim 1 -> local 8x2
+        assert w.addressable_shards[0].data.shape == (8, 2)
+
+    def test_vocab_parallel_embedding_and_ce(self):
+        hcg = _reset_fleet(mp_degree=8)
+        paddle.seed(201)
+        V, H = 32, 16
+        emb = fleet.meta_parallel.VocabParallelEmbedding(V, H)
+        ref = nn.Embedding(V, H)
+        ref.set_state_dict(emb.state_dict())
+        idx = np.array([[1, 5, 31], [0, 2, 7]])
+        out = emb(paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), ref(paddle.to_tensor(idx)).numpy(),
+                                   rtol=1e-5)
+        # parallel CE == plain CE
+        logits = np.random.RandomState(0).randn(4, V).astype(np.float32)
+        labels = np.array([1, 2, 3, 4])
+        pce = fleet.meta_parallel.ParallelCrossEntropy()
+        a = pce(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        b = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                            reduction="none")
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4)
+
+
+class TestShardingZeRO:
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_zero_stage_matches_serial(self, stage):
+        paddle.seed(300 + stage)
+        hcg = _reset_fleet(sharding_degree=8)
+        x, y = _data(n=16)
+        m1 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m2.set_state_dict(m1.state_dict())
+        serial = TrainStep(m1, lambda o, l: F.cross_entropy(o, l),
+                           AdamW(learning_rate=0.01,
+                                 parameters=m1.parameters()))
+        zero = TrainStep(m2, lambda o, l: F.cross_entropy(o, l),
+                         AdamW(learning_rate=0.01, parameters=m2.parameters()),
+                         mesh=hcg.mesh, sharding_stage=stage)
+        for i in range(3):
+            ls = float(serial.step((paddle.to_tensor(x),),
+                                   (paddle.to_tensor(y),)).value)
+            lz = float(zero.step((paddle.to_tensor(x),),
+                                 (paddle.to_tensor(y),)).value)
+            np.testing.assert_allclose(ls, lz, rtol=1e-4, atol=1e-5)
+
+    def test_stage3_params_sharded(self):
+        hcg = _reset_fleet(sharding_degree=8)
+        m = nn.Linear(16, 16)
+        step = TrainStep(m, lambda o, l: F.mse_loss(o, l),
+                         SGD(learning_rate=0.1, parameters=m.parameters()),
+                         mesh=hcg.mesh, sharding_stage=3)
+        w = step.params["weight"]
+        assert w.addressable_shards[0].data.shape == (2, 16)
+
+    def test_stage1_opt_state_sharded_params_replicated(self):
+        hcg = _reset_fleet(sharding_degree=8)
+        m = nn.Linear(16, 16)
+        step = TrainStep(m, lambda o, l: F.mse_loss(o, l),
+                         AdamW(learning_rate=0.1, parameters=m.parameters()),
+                         mesh=hcg.mesh, sharding_stage=1)
+        assert step.params["weight"].addressable_shards[0].data.shape == (16, 16)
+        m1 = step.opt_state["slots"]["weight"]["moment1"]
+        assert m1.addressable_shards[0].data.shape == (2, 16)
+
+    def test_group_sharded_parallel_api(self):
+        hcg = _reset_fleet(sharding_degree=8)
+        m = nn.Linear(8, 8)
+        opt = AdamW(learning_rate=0.01, parameters=m.parameters())
+        from paddle_tpu.distributed import group_sharded_parallel
+        m2, opt2 = group_sharded_parallel(m, opt, "p_g_os")
+        assert m2._group_sharded_stage == 3
+
+
+class TestCompileOnlyHLO:
+    """Pattern 3: assert collectives in the lowered program."""
+
+    def test_tp_step_has_allreduce(self):
+        hcg = _reset_fleet(mp_degree=8)
+        tp = nn.Sequential(
+            fleet.meta_parallel.ColumnParallelLinear(8, 16, gather_output=False),
+            nn.ReLU(),
+            fleet.meta_parallel.RowParallelLinear(16, 4, input_is_parallel=True),
+        )
+        step = TrainStep(tp, lambda o, l: F.cross_entropy(o, l),
+                         SGD(learning_rate=0.1, parameters=tp.parameters()),
+                         mesh=hcg.mesh)
+        x, y = _data(n=8)
+        hlo = step.lower_text((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert "all-reduce" in hlo
+
+    def test_dp_grad_sync_present(self):
+        hcg = _reset_fleet(dp_degree=8)
+        m = nn.Linear(8, 4)
+        step = TrainStep(m, lambda o, l: F.cross_entropy(o, l),
+                         SGD(learning_rate=0.1, parameters=m.parameters()),
+                         mesh=hcg.mesh)
+        x, y = _data(n=8)
+        hlo = step.lower_text((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert ("all-reduce" in hlo) or ("reduce-scatter" in hlo)
+
+    def test_serial_step_has_no_collectives(self):
+        m = nn.Linear(8, 4)
+        step = TrainStep(m, lambda o, l: F.cross_entropy(o, l),
+                         SGD(learning_rate=0.1, parameters=m.parameters()))
+        x, y = _data(n=8)
+        hlo = step.lower_text((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert "all-reduce" not in hlo
+
+
+class TestMoE:
+    def test_moe_forward_and_train(self):
+        paddle.seed(400)
+        from paddle_tpu.parallel.moe import ExpertLayer, MoELayer
+        d = 16
+        moe = MoELayer(d, [ExpertLayer(d, 32) for _ in range(4)],
+                       gate={"type": "gshard", "top_k": 2},
+                       capacity_factor=2.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, d).astype(np.float32),
+            stop_gradient=False)
+        out = moe(x)
+        assert out.shape == [2, 8, d]
+        assert moe.aux_loss is not None
+        loss = out.sum() + moe.aux_loss * 0.01
+        loss.backward()
+        gate_grad = moe.gate.gate.weight.grad
+        assert gate_grad is not None
+
+    def test_switch_gate(self):
+        paddle.seed(401)
+        from paddle_tpu.parallel.moe import ExpertLayer, MoELayer
+        moe = MoELayer(8, [ExpertLayer(8, 16) for _ in range(2)],
+                       gate={"type": "switch"}, capacity_factor=4.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 4, 8).astype(np.float32))
+        out = moe(x)
+        assert out.shape == [4, 4, 8]
+
+    def test_capacity_ops(self):
+        from paddle_tpu.parallel.moe import number_count, limit_by_capacity
+        nums = paddle.to_tensor(np.array([0, 1, 1, 2, 2, 2]))
+        cnt = number_count(nums, 4)
+        np.testing.assert_array_equal(cnt.numpy(), [1, 2, 3, 0])
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed import save_state_dict, load_state_dict
+        m = nn.Linear(8, 8)
+        sd = m.state_dict()
+        save_state_dict(sd, str(tmp_path / "ckpt"))
+        m2 = nn.Linear(8, 8)
+        sd2 = m2.state_dict()
+        load_state_dict(sd2, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+
+    def test_reshard_on_load(self, tmp_path):
+        """Save sharded over 8, load into a differently-sharded target."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel import mesh as mesh_mod
+        from paddle_tpu.distributed import save_state_dict, load_state_dict
+        mesh_mod._STATE["mesh"] = None
+        mesh = mesh_mod.ensure_mesh({"dp": 8})
+        w = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharded = paddle.Tensor(jax.device_put(
+            w, NamedSharding(mesh, P("dp", None))))
+        save_state_dict({"w": sharded}, str(tmp_path / "ck2"))
+        target = paddle.Tensor(np.zeros((8, 8), np.float32))
+        load_state_dict({"w": target}, str(tmp_path / "ck2"))
+        np.testing.assert_allclose(target.numpy(), w)
+
+
+class TestRecompute:
+    def test_recompute_matches_plain(self):
+        paddle.seed(500)
+        from paddle_tpu.distributed import recompute
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32),
+                             stop_gradient=False)
+        plain = m(x).sum()
+        plain.backward()
+        g_plain = m[0].weight.grad.numpy().copy()
+        for p in m.parameters():
+            p.clear_grad()
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        out = recompute(m, x2).sum()
+        out.backward()
+        np.testing.assert_allclose(m[0].weight.grad.numpy(), g_plain,
+                                   rtol=1e-5)
+
+
+class TestPipelineLayerStructure:
+    def test_segmentation_uniform(self):
+        from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+        pl = PipelineLayer(descs, num_stages=4)
+        assert pl.segment_parts == [0, 2, 4, 6, 8]
+        assert len(pl.get_stage_layers(0)) == 2
+
+    def test_pipeline_forward_matches_sequential(self):
+        paddle.seed(600)
+        from paddle_tpu.distributed.fleet import PipelineLayer
+        layers = [nn.Linear(8, 8) for _ in range(4)]
+        pl = PipelineLayer(list(layers), num_stages=2)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+        out = pl(x)
+        ref = x
+        for l in layers:
+            ref = l(ref)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_shared_layer_desc_ties_weights(self):
+        from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                                  SharedLayerDesc)
+        descs = [
+            SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+            LayerDesc(nn.Linear, 8, 8),
+            SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+        ]
+        pl = PipelineLayer(descs, num_stages=1)
+        l0 = pl.run_function[0].shared
+        l2 = pl.run_function[2].shared
+        assert l0 is l2
+
+
+class TestPipelineTrainBatch:
+    def test_train_batch_runs_and_converges(self):
+        paddle.seed(700)
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"pp_degree": 1, "dp_degree": 1,
+                            "pp_configs": {"accumulate_steps": 4,
+                                           "micro_batch_size": 4}}
+        fleet.init(is_collective=True, strategy=s)
+        from paddle_tpu.distributed.fleet import PipelineLayer
+
+        losses = []
+        pl = PipelineLayer([nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4)],
+                           num_stages=1,
+                           loss_fn=lambda o, l: F.cross_entropy(o, l))
+        model = fleet.distributed_model(pl)
+        opt = fleet.distributed_optimizer(
+            SGD(learning_rate=0.1, parameters=pl.parameters()))
+        x, y = _data(n=16)
+        for i in range(5):
+            loss = model.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)],
+                                     opt)
+            losses.append(float(loss.value))
+        assert losses[-1] < losses[0]
