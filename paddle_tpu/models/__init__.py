@@ -1,0 +1,16 @@
+"""Model zoo covering the five BASELINE.json configs:
+1. ResNet-50 — paddle_tpu.vision.models.resnet50
+2. GPT-3 345M DP — models.gpt
+3. LLaMA-2 7B/13B hybrid — models.llama (flagship)
+4. ERNIE-ViL multimodal DP — models.ernie_vil
+5. GShard-MoE EP — models.moe_gpt
+"""
+from . import llama
+from . import gpt
+from . import ernie_vil
+from . import moe_gpt
+from .llama import (LlamaConfig, LlamaForCausalLM, llama_7b, llama_13b,
+                    llama_tiny)
+from .gpt import GPTConfig, GPTForCausalLM, gpt3_345m, gpt_tiny
+from .ernie_vil import ErnieViLConfig, ErnieViLModel, ernie_vil_base, ernie_vil_tiny
+from .moe_gpt import MoEGPTConfig, MoEGPTForCausalLM, gshard_moe_8x, moe_tiny

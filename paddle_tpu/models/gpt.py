@@ -1,0 +1,149 @@
+"""GPT family (reference: PaddleFleetX/PaddleNLP gpt configs — config 2 of
+BASELINE.json is GPT-3 345M under Fleet data parallelism).
+
+Per-layer module implementation (the debug-friendly structure; the
+scan-over-layers form used by LLaMA is the perf path) built from the
+tensor-parallel layer library so the same model runs DP-only or hybrid.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..parallel.fleet.mp import (ColumnParallelLinear, RowParallelLinear,
+                                 VocabParallelEmbedding, parallel_matmul,
+                                 shard_annotate)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    use_mp_layers: bool = True
+
+
+def gpt3_345m(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt_tiny(**kw):
+    d = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+             num_attention_heads=4, intermediate_size=128,
+             max_position_embeddings=64, hidden_dropout_prob=0.0,
+             attention_probs_dropout_prob=0.0)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.nh = c.num_attention_heads
+        self.hd = c.hidden_size // c.num_attention_heads
+        Lin = ColumnParallelLinear if c.use_mp_layers else nn.Linear
+        Rin = RowParallelLinear if c.use_mp_layers else nn.Linear
+        if c.use_mp_layers:
+            self.qkv = Lin(c.hidden_size, 3 * c.hidden_size, gather_output=False)
+            self.out_proj = Rin(c.hidden_size, c.hidden_size,
+                                input_is_parallel=True)
+        else:
+            self.qkv = Lin(c.hidden_size, 3 * c.hidden_size)
+            self.out_proj = Rin(c.hidden_size, c.hidden_size)
+        self.dropout = c.attention_probs_dropout_prob
+
+    def forward(self, x):
+        from ..ops import reshape, split
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = reshape(qkv, [B, S, 3, self.nh, self.hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = reshape(out, [B, S, self.nh * self.hd])
+        return self.out_proj(out)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        self.attn = GPTAttention(c)
+        self.ln_2 = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        Lin = ColumnParallelLinear if c.use_mp_layers else nn.Linear
+        Rin = RowParallelLinear if c.use_mp_layers else nn.Linear
+        if c.use_mp_layers:
+            self.fc_in = Lin(c.hidden_size, c.intermediate_size,
+                             gather_output=False)
+            self.fc_out = Rin(c.intermediate_size, c.hidden_size,
+                              input_is_parallel=True)
+        else:
+            self.fc_in = Lin(c.hidden_size, c.intermediate_size)
+            self.fc_out = Rin(c.intermediate_size, c.hidden_size)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        Emb = VocabParallelEmbedding if c.use_mp_layers else nn.Embedding
+        self.wte = Emb(c.vocab_size, c.hidden_size)
+        self.wpe = nn.Embedding(c.max_position_embeddings, c.hidden_size)
+        self.drop = nn.Dropout(c.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..ops import arange, unsqueeze
+        if position_ids is None:
+            position_ids = unsqueeze(arange(input_ids.shape[1], dtype="int32"), 0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        # tied lm head against the (possibly vocab-sharded) embedding
+        logits = parallel_matmul(hidden, self.gpt.wte.weight, transpose_y=True,
+                                 tensor_parallel_output=False) \
+            if self.config.use_mp_layers else \
+            _plain_head(hidden, self.gpt.wte.weight)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits[:, :-1], labels[:, 1:],
+                               ignore_index=-100)
+        return loss
+
+    def num_params(self):
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+def _plain_head(hidden, w):
+    from ..ops import matmul
+    return matmul(hidden, w, transpose_y=True)

@@ -1,0 +1,112 @@
+"""GShard-MoE transformer (reference: config 5 of BASELINE.json —
+GShard-MoE 8×7B with Fleet expert parallelism via
+``paddle.incubate.distributed.models.moe``).
+
+GPT backbone with every other FFN replaced by a GShard MoELayer; experts
+shard over the expert mesh axis (EP rides 'mp'/'sep'), tokens move via the
+dense capacity-dispatch einsums that XLA lowers to all-to-all.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..parallel.moe import ExpertLayer, MoELayer
+from .gpt import GPTAttention, GPTConfig
+
+
+@dataclass
+class MoEGPTConfig(GPTConfig):
+    num_experts: int = 8
+    moe_every: int = 2          # every Nth block is MoE
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+
+def gshard_moe_8x(**kw):
+    d = dict(num_experts=8)
+    d.update(kw)
+    return MoEGPTConfig(**d)
+
+
+def moe_tiny(**kw):
+    d = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+             num_attention_heads=4, intermediate_size=128,
+             max_position_embeddings=64, num_experts=4,
+             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+             use_mp_layers=False)
+    d.update(kw)
+    return MoEGPTConfig(**d)
+
+
+class MoEBlock(nn.Layer):
+    def __init__(self, c: MoEGPTConfig, use_moe: bool):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        self.attn = GPTAttention(c)
+        self.ln_2 = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        self.use_moe = use_moe
+        if use_moe:
+            self.moe = MoELayer(
+                c.hidden_size,
+                [ExpertLayer(c.hidden_size, c.intermediate_size)
+                 for _ in range(c.num_experts)],
+                gate={"type": "gshard", "top_k": 2},
+                capacity_factor=c.capacity_factor)
+        else:
+            self.fc_in = nn.Linear(c.hidden_size, c.intermediate_size)
+            self.fc_out = nn.Linear(c.intermediate_size, c.hidden_size)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        h = self.ln_2(x)
+        if self.use_moe:
+            x = x + self.moe(h)
+        else:
+            x = x + self.fc_out(F.gelu(self.fc_in(h)))
+        return x
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss if self.use_moe else None
+
+
+class MoEGPTForCausalLM(nn.Layer):
+    def __init__(self, config: MoEGPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.wte = nn.Embedding(c.vocab_size, c.hidden_size)
+        self.wpe = nn.Embedding(c.max_position_embeddings, c.hidden_size)
+        self.h = nn.LayerList([
+            MoEBlock(c, use_moe=(i % c.moe_every == c.moe_every - 1))
+            for i in range(c.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+
+    def forward(self, input_ids, labels=None):
+        from ..ops import arange, matmul, unsqueeze
+        pos = unsqueeze(arange(input_ids.shape[1], dtype="int32"), 0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        aux_losses = []
+        for block in self.h:
+            x = block(x)
+            if block.aux_loss is not None:
+                aux_losses.append(block.aux_loss)
+        x = self.ln_f(x)
+        logits = matmul(x, self.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits[:, :-1], labels[:, 1:])
+        if aux_losses:
+            total_aux = aux_losses[0]
+            for a in aux_losses[1:]:
+                total_aux = total_aux + a
+            loss = loss + self.config.aux_loss_weight * total_aux
+        return loss
+
+    def num_params(self):
+        return sum(int(np.prod(p.shape)) if p.shape else 1
+                   for p in self.parameters())
