@@ -29,7 +29,17 @@ def _use_pallas(seq_len):
     except Exception:
         return False
     # axon = tunneled TPU platform name in this environment
-    return platform in ("tpu", "axon") and seq_len >= 1024
+    return platform in ("tpu", "axon") and seq_len >= 512
+
+
+def attention(q, k, v, causal=True):
+    """Raw-array attention dispatcher for model internals: Pallas flash on
+    TPU for long sequences, jnp reference otherwise."""
+    B, S, H, D = q.shape
+    if _use_pallas(S) and S % 128 == 0 and D % 8 == 0:
+        from .pallas_flash import flash_attention_pallas
+        return flash_attention_pallas(q, k, v, causal=causal)
+    return _ref_attention(q, k, v, causal)
 
 
 # --------------------------------------------------------------- jnp reference

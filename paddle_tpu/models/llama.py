@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..core.tensor import Tensor
-from ..kernels.flash_attention import _ref_attention
+from ..kernels.flash_attention import attention as _attention
 from ..nn import functional as F
 from ..ops._op import tensor_op
 from ..parallel import mesh as mesh_mod
@@ -50,6 +50,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_recompute: bool = True
+    recompute_policy: str = "full"  # "full" | "dots" (save matmul outputs)
     sequence_parallel: bool = False
     dtype: str = "float32"
 
@@ -116,6 +117,39 @@ def _apply_rope(x, sin, cos):
     return (x * cos[None, :, None, :] + rotated * sin[None, :, None, :]).astype(x.dtype)
 
 
+def _apply_rope_bhsd(x, sin, cos):
+    # x: [B, H, S, D]
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos[None, None, :, :] + rotated * sin[None, None, :, :]).astype(x.dtype)
+
+
+def _attention_bhsd(q, k, v, nh):
+    """[B, H, S, D] attention: Pallas flash on TPU, jnp reference elsewhere."""
+    B, Hq, S, D = q.shape
+    Hk = k.shape[1]
+    if Hk != Hq:
+        rep = Hq // Hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    from ..kernels.flash_attention import _use_pallas
+    if _use_pallas(S) and S % 128 == 0 and D % 8 == 0:
+        from ..kernels.pallas_flash import flash_attention_bhsd
+        o = flash_attention_bhsd(q.reshape(B * Hq, S, D),
+                                 k.reshape(B * Hq, S, D),
+                                 v.reshape(B * Hq, S, D), causal=True)
+        return o.reshape(B, Hq, S, D)
+    import math as _m
+    scale = 1.0 / _m.sqrt(D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
 def _rms(x, w, eps):
     xf = x.astype(jnp.float32)
     out = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + eps)
@@ -180,7 +214,8 @@ class LlamaForCausalLM(nn.Layer):
         out = _llama_forward(
             input_ids, labels, c.num_attention_heads, c.num_key_value_heads,
             c.head_dim, float(c.rms_norm_eps), float(c.rope_theta),
-            bool(c.use_recompute), self.lm_head is None, **params)
+            bool(c.use_recompute), self.lm_head is None,
+            policy=c.recompute_policy, **params)
         return out
 
     def num_params(self):
@@ -190,8 +225,8 @@ class LlamaForCausalLM(nn.Layer):
 
 @tensor_op
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
-                   embed, wq, wk, wv, wo, w_gate, w_up, w_down, input_ln,
-                   post_ln, final_norm, lm_head):
+                   policy="full", *, embed, wq, wk, wv, wo, w_gate, w_up,
+                   w_down, input_ln, post_ln, final_norm, lm_head):
     B, S = input_ids.shape
     H = embed.shape[1]
     batch_spec = ("dp", "sharding")
@@ -212,7 +247,7 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
         k = _apply_rope(k, sin, cos)
         q = _ann(q, batch_spec, None, "mp", None)
         k = _ann(k, batch_spec, None, "mp", None)
-        attn = _ref_attention(q, k, v, causal=True)
+        attn = _attention(q, k, v, causal=True)
         attn = attn.reshape(B, S, nh * hd)
         h = resid + _ann(jnp.einsum("bsd,dh->bsh", attn, lwo),
                          batch_spec, "sep", None)
@@ -226,7 +261,12 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                          batch_spec, "sep", None)
         return h, None
 
-    body = jax.checkpoint(layer_body) if remat else layer_body
+    if remat:
+        ck_policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                     if policy == "dots" else None)
+        body = jax.checkpoint(layer_body, policy=ck_policy)
+    else:
+        body = layer_body
     stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
     x, _ = jax.lax.scan(lambda h, lp: body(h, lp), x, stack)
 
@@ -236,14 +276,16 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
         logits = jnp.einsum("bsh,hv->bsv", x, head)
         return _ann(logits, batch_spec, None, "mp")
 
-    # training: shifted CE without materializing logits outside fp32 softmax
+    # training: shifted CE via logsumexp (loss = lse - picked_logit); the
+    # f32 materialization is only the [B,S] lse + picked terms
     logits = jnp.einsum("bsh,hv->bsv", x[:, :-1], head)
     logits = _ann(logits, batch_spec, None, "mp")
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
     tgt = labels[:, 1:]
-    picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    picked = jnp.take_along_axis(lf, tgt[..., None], axis=-1)[..., 0]
     mask = (tgt >= 0).astype(jnp.float32)
-    loss = -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum((lse - picked) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return loss
 
 
