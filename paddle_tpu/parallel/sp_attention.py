@@ -1,0 +1,171 @@
+"""Context parallelism: ring attention + Ulysses (DeepSpeed-style) all-to-all
+attention over the 'sep' mesh axis.
+
+The reference keeps a reserved sep axis in core and implements ring/Ulysses
+in the PaddleNLP ecosystem over ``batch_isend_irecv`` p2p (SURVEY.md §5.7).
+Here both are first-class, TPU-native:
+
+- **Ring attention**: KV chunks rotate around the sep ring via
+  ``lax.ppermute`` (ICI-neighbor transfers), with online-softmax combination
+  of per-chunk partial results — flash attention's math at the inter-chip
+  level, so sequence length scales linearly with ring size and each hop
+  overlaps with the local attention compute.
+- **Ulysses**: ``lax.all_to_all`` re-shards [seq/n, H] -> [seq, H/n] so each
+  chip runs full-sequence attention for a head subset, then back.
+
+Both run inside ``jax.shard_map`` over the global mesh and compose with the
+dp/sharding batch axes.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+SEQ_AXIS = "sep"
+
+
+def _batch_axes(mesh):
+    return tuple(a for a in ("dp", "sharding") if a in mesh.axis_names)
+
+
+# ------------------------------------------------------------------ ring
+def _chunk_attn_stats(q, k, v, rows_g, cols_g, scale, causal):
+    """Local block attention returning (o_unnorm [.., S_l, D], m, l)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = rows_g[:, None] >= cols_g[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # rows with no valid key yet: keep m finite to avoid nan exp
+    m_safe = jnp.maximum(m, -1e30 + 1.0)
+    p = jnp.exp(s - m_safe)
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m_safe, l
+
+
+def _ring_local(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body: q/k/v [B, H, S_local, D] (seq-sharded over the ring)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, S_l, D = q.shape
+    rows_g = idx * S_l + jnp.arange(S_l)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src = (idx - i) % n  # global chunk id currently held
+        cols_g = src * S_l + jnp.arange(S_l)
+        o_b, m_b, l_b = _chunk_attn_stats(q, k_cur, v_cur, rows_g, cols_g,
+                                          scale, causal)
+        m_new = jnp.maximum(m_acc, m_b)
+        a_old = jnp.exp(m_acc - m_new)
+        a_new = jnp.exp(m_b - m_new)
+        o_acc = o_acc * a_old + o_b * a_new
+        l_acc = l_acc * a_old + l_b * a_new
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_acc, m_new, l_acc, k_nxt, v_nxt
+
+    o0 = jnp.zeros((B, H, S_l, D), jnp.float32)
+    m0 = jnp.full((B, H, S_l, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, S_l, 1), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
+    """Global [B, H, S, D] arrays, S sharded over the sep ring."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] == 1:
+        from ..kernels.flash_attention import _ref_attention
+        o = _ref_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                           jnp.swapaxes(v, 1, 2), causal)
+        return jnp.swapaxes(o, 1, 2)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ba = _batch_axes(mesh)
+    spec = P(ba if ba else None, None, axis_name, None)
+    fn = functools.partial(_ring_local, axis_name=axis_name, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ------------------------------------------------------------------ ulysses
+def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+    """q/k/v [B, H, S_local, D] -> all_to_all to [B, H/n, S, D] -> attention
+    -> back."""
+    def head_scatter(x):
+        # [B, H, S_l, D] -> [B, H/n, S, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def head_gather(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qg, kg, vg = head_scatter(q), head_scatter(k), head_scatter(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qg, kg,
+                   preferred_element_type=jnp.float32) * scale
+    S = qg.shape[2]
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+    og = jnp.einsum("bhqk,bhkd->bhqd", p, vg)
+    return head_gather(og)
+
+
+def ulysses_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
+    """DeepSpeed-Ulysses sequence parallelism: heads scatter / seq gather."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] == 1:
+        return ring_attention(q, k, v, causal, mesh, axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ba = _batch_axes(mesh)
+    spec = P(ba if ba else None, None, axis_name, None)
+    fn = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ------------------------------------------------------------ Tensor surface
+def ring_flash_attention(query, key, value, causal=True, axis_name=SEQ_AXIS):
+    """Tensor-level API ([B, S, H, D] paddle layout)."""
+    from ..ops._op import tensor_op
+
+    @tensor_op(name="ring_flash_attention")
+    def _op(q, k, v):
+        o = ring_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                           jnp.swapaxes(v, 1, 2), causal=causal,
+                           axis_name=axis_name)
+        return jnp.swapaxes(o, 1, 2)
+
+    return _op(query, key, value)
+
+
+def ulysses_flash_attention(query, key, value, causal=True,
+                            axis_name=SEQ_AXIS):
+    from ..ops._op import tensor_op
+
+    @tensor_op(name="ulysses_flash_attention")
+    def _op(q, k, v):
+        o = ulysses_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                              jnp.swapaxes(v, 1, 2), causal=causal,
+                              axis_name=axis_name)
+        return jnp.swapaxes(o, 1, 2)
+
+    return _op(query, key, value)
