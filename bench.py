@@ -8,18 +8,31 @@ number is comparable across chip generations).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = MFU / 0.45 (the north-star target ratio).
+
+Robustness contract (VERDICT r1 item 3): the tunneled axon TPU backend can
+be transiently unreachable, and when it is, backend init *hangs* rather
+than raising. So the measurement runs in a child process under a watchdog:
+the parent probes the backend in a killable subprocess with bounded
+retry/backoff and ALWAYS prints a parseable JSON line, even on total
+backend failure.
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+METRIC = "llama_350m_train_mfu_bf16"
+PROBE_TIMEOUT_S = 90
+BENCH_TIMEOUT_S = 900
+BACKOFFS_S = (5, 15, 30)
+
 
 def main():
     import jax
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
@@ -62,14 +75,71 @@ def main():
     mfu = achieved / peak
 
     print(json.dumps({
-        "metric": "llama_350m_train_mfu_bf16",
+        "metric": METRIC,
         "value": round(float(mfu), 4),
         "unit": f"MFU (6N formula, N={n_params/1e6:.0f}M, "
                 f"{tokens_per_sec:.0f} tok/s/chip, "
                 f"peak={peak/1e12:.0f}TF, loss={final_loss:.3f})",
         "vs_baseline": round(float(mfu) / 0.45, 4),
     }))
+    return 0
+
+
+def _fail_line(reason):
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": f"MFU (FAILED: {reason})",
+        "vs_baseline": 0.0,
+    }))
+
+
+def _run(args, timeout):
+    """Run a python subprocess; return (rc, stdout) with rc=124 on timeout."""
+    try:
+        p = subprocess.run([sys.executable] + args, timeout=timeout,
+                           capture_output=True, text=True,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        def _text(v):
+            if isinstance(v, bytes):
+                return v.decode(errors="replace")
+            return v or ""
+        return 124, _text(e.stdout), _text(e.stderr)
+
+
+def watchdog():
+    last_err = "unknown"
+    for attempt, backoff in enumerate(BACKOFFS_S + (None,)):
+        rc, out, err = _run(
+            ["-c", "import jax; print('NDEV', len(jax.devices()))"],
+            PROBE_TIMEOUT_S)
+        if rc == 0 and "NDEV" in out:
+            break
+        last_err = (f"backend probe rc={rc}"
+                    + (" (hang killed)" if rc == 124 else ""))
+        if backoff is None:
+            _fail_line(f"tpu backend unreachable after "
+                       f"{len(BACKOFFS_S) + 1} probes; last: {last_err}")
+            return 0  # a parsed JSON line IS the success contract
+        time.sleep(backoff)
+
+    for attempt in (1, 2):
+        rc, out, err = _run([os.path.abspath(__file__), "--child"],
+                            BENCH_TIMEOUT_S)
+        line = next((ln for ln in reversed(out.splitlines())
+                     if ln.startswith("{")), None)
+        if rc == 0 and line:
+            print(line)
+            return 0
+        last_err = f"bench child rc={rc}; stderr tail: {err.strip()[-300:]}"
+        time.sleep(5)
+    _fail_line(last_err)
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if "--child" in sys.argv:
+        sys.exit(main())
+    sys.exit(watchdog())

@@ -198,9 +198,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     capture = {id(t): None for t in inputs}
     backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
     results = []
-    for t in inputs:
+    for i, t in enumerate(inputs):
         g = capture[id(t)]
         if g is None and not allow_unused:
-            g = jnp.zeros_like(t.value)
+            # match the reference: unreachable inputs are an error unless
+            # the caller opted in — zeros here would mask disconnected-graph
+            # bugs (e.g. an accidentally detached subgraph)
+            raise ValueError(
+                f"input {i} (shape {tuple(t.shape)}) is unreachable from "
+                "outputs; pass allow_unused=True to get None for it")
         results.append(Tensor(g, stop_gradient=True) if g is not None else None)
     return results

@@ -329,6 +329,7 @@ class TrainStep:
     def eval_step(self, inputs, labels):
         key = jax.random.fold_in(self._base_key, self._step_count)
         inputs, labels = _norm_batch(inputs), _norm_labels(labels)
+        inputs, labels = self._place_batch(inputs), self._place_batch(labels)
         loss = self._compiled_eval(self._params, self._buffers, inputs,
                                    labels, key)
         return Tensor(loss)

@@ -36,7 +36,9 @@ def attention(q, k, v, causal=True):
     """Raw-array attention dispatcher for model internals: Pallas flash on
     TPU for long sequences, jnp reference otherwise."""
     B, S, H, D = q.shape
-    if _use_pallas(S) and S % 128 == 0 and D % 8 == 0:
+    # no seq-length divisibility guard: the kernels mask the padded tail
+    # block explicitly, so any S is safe
+    if _use_pallas(S) and D % 8 == 0:
         from .pallas_flash import flash_attention_pallas
         return flash_attention_pallas(q, k, v, causal=causal)
     return _ref_attention(q, k, v, causal)

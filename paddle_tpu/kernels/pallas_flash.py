@@ -32,12 +32,21 @@ def _cparams(dims):
             return None
 
 
+def _row_valid(ref_block, idx, block, seq_len):
+    """[block, D] mask zeroing rows whose global index >= seq_len (the
+    Pallas-padded tail when seq_len % block != 0 — padded reads are
+    undefined and must not reach the accumulators)."""
+    rows = idx * block + jax.lax.broadcasted_iota(jnp.int32, ref_block.shape, 0)
+    return jnp.where(rows < seq_len, ref_block, jnp.zeros_like(ref_block))
+
+
 # ----------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_q, block_k):
+                scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    tail = seq_len % block_q != 0 or seq_len % block_k != 0
 
     @pl.when(ki == 0)
     def _init():
@@ -55,18 +64,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or tail:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = (rows >= cols) if causal else (s == s)
+            if tail:
+                keep = keep & (cols < seq_len)
+            s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
+        if tail:  # exp underflows to exact 0 on masked cols, but padded v
+            p = jnp.where(  # rows may be NaN garbage and 0*NaN = NaN
+                ki * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) < seq_len,
+                p, 0.0)
+        v = v_ref[0]
+        if tail:
+            v = _row_valid(v, ki, block_k, seq_len)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -83,7 +103,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(S, block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k, seq_len=S)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -114,10 +134,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # ----------------------------------------------------------------- backward
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
-                block_k):
+                block_k, seq_len):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
+    tail = seq_len % block_q != 0 or seq_len % block_k != 0
 
     @pl.when(qi == 0)
     def _init():
@@ -136,6 +157,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if tail:  # padded q rows are undefined and sum into every dk/dv row
+            q = _row_valid(q, qi, block_q, seq_len)
+            do = _row_valid(do, qi, block_q, seq_len)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -143,12 +167,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
+        if tail:  # padded-row lse/delta are garbage: zero p and ds there
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            p = jnp.where(rows < seq_len, p, 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
+        if tail:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, ds.shape, 0)
+            ds = jnp.where(rows < seq_len, ds, 0.0)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -160,10 +190,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k):
+               dq_scr, *, scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    tail = seq_len % block_q != 0 or seq_len % block_k != 0
 
     @pl.when(ki == 0)
     def _init():
@@ -181,13 +212,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if tail:  # padded k/v rows are undefined and sum into every dq row
+            k = _row_valid(k, ki, block_k, seq_len)
+            v = _row_valid(v, ki, block_k, seq_len)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or tail:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = (rows >= cols) if causal else (s == s)
+            if tail:
+                keep = keep & (cols < seq_len)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if tail:
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where(cols < seq_len, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -211,7 +251,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, seq_len=S),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
@@ -240,7 +280,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, seq_len=S),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),

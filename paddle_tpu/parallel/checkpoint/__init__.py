@@ -102,6 +102,13 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             continue
         entry = merged[name]["entry"]
         if "scalar" in entry:
+            # optimizer scalars like '@step' must survive resume — dropping
+            # them silently reset Adam bias correction / LR-schedule step
+            if isinstance(target, Tensor):
+                import jax.numpy as jnp
+                target._rebind(jnp.asarray(entry["scalar"]))
+            else:
+                state_dict[name] = entry["scalar"]
             continue
         gshape = tuple(entry["global_shape"])
         # assemble the full logical tensor from slices, then let the target's

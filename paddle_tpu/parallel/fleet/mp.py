@@ -35,6 +35,13 @@ def _mesh():
     return mesh_mod.get_mesh()
 
 
+# UNCONSTRAINED leaves a dim's sharding for GSPMD to choose. Activation
+# annotations in hybrid dp×mp meshes MUST use it for non-mp dims: a bare
+# ``None`` is a hard fully-replicated constraint that would un-shard the dp
+# batch dim and force a batch all-gather at every MP layer (ADVICE r1).
+U = P.UNCONSTRAINED
+
+
 @tensor_op
 def _constrain(x, spec_tuple):
     mesh = _mesh()
@@ -48,15 +55,22 @@ def _constrain(x, spec_tuple):
         return x
 
 
+def _is_unconstrained(s):
+    return s is U or (isinstance(s, str) and s == "unconstrained")
+
+
 def shard_annotate(x, *spec):
     """Annotate a Tensor's sharding (identity op; a hint to GSPMD)."""
     mesh = _mesh()
     if mesh is None:
         return x
     names = set(mesh.axis_names)
-    clean = tuple(s if (s is None or (isinstance(s, str) and s in names) or
-                        (isinstance(s, tuple) and all(n in names for n in s)))
-                  else None for s in spec)
+    clean = tuple(
+        U if _is_unconstrained(s)
+        else s if (s is None or (isinstance(s, str) and s in names) or
+                   (isinstance(s, tuple) and all(n in names for n in s)))
+        else None
+        for s in spec)
     return _constrain(x, clean)
 
 
@@ -91,12 +105,12 @@ class ColumnParallelLinear(nn.Layer):
 
     def forward(self, x):
         # input replicated across mp (the reference's _c_identity)
-        x = shard_annotate(x, *([None] * (len(x.shape) - 1)), None)
+        x = shard_annotate(x, *([U] * (len(x.shape) - 1)), None)
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            out = shard_annotate(out, *([None] * len(out.shape)))
+            out = shard_annotate(out, *([U] * (len(out.shape) - 1)), None)
         else:
-            out = shard_annotate(out, *([None] * (len(out.shape) - 1)), MP_AXIS)
+            out = shard_annotate(out, *([U] * (len(out.shape) - 1)), MP_AXIS)
         return out
 
 
@@ -123,11 +137,11 @@ class RowParallelLinear(nn.Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = shard_annotate(x, *([None] * (len(x.shape) - 1)), MP_AXIS)
+            x = shard_annotate(x, *([U] * (len(x.shape) - 1)), MP_AXIS)
         out = F.linear(x, self.weight, None)
         # replicated output == allreduce of partial sums (reference
         # _mp_allreduce in fwd, identity in bwd)
-        out = shard_annotate(out, *([None] * len(out.shape)))
+        out = shard_annotate(out, *([U] * (len(out.shape) - 1)), None)
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -147,7 +161,7 @@ class VocabParallelEmbedding(nn.Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return shard_annotate(out, *([None] * len(out.shape)))
+        return shard_annotate(out, *([U] * (len(out.shape) - 1)), None)
 
 
 class ParallelCrossEntropy(nn.Layer):
@@ -160,7 +174,7 @@ class ParallelCrossEntropy(nn.Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        input = shard_annotate(input, *([None] * (len(input.shape) - 1)),
+        input = shard_annotate(input, *([U] * (len(input.shape) - 1)),
                                MP_AXIS)
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)
@@ -171,35 +185,35 @@ def parallel_matmul(x, weight, transpose_y=False, tensor_parallel_output=True):
     from ...ops import matmul
     out = matmul(x, weight, transpose_y=transpose_y)
     if tensor_parallel_output:
-        return shard_annotate(out, *([None] * (len(out.shape) - 1)), MP_AXIS)
-    return shard_annotate(out, *([None] * len(out.shape)))
+        return shard_annotate(out, *([U] * (len(out.shape) - 1)), MP_AXIS)
+    return shard_annotate(out, *([U] * (len(out.shape) - 1)), None)
 
 
 # ---------------------------------------------------------------- mp_ops
 def _c_identity(x, group=None):
     """Copy in fwd; allreduce grads in bwd — in GSPMD this is exactly what a
     'replicated' annotation produces for an input consumed by sharded ops."""
-    return shard_annotate(x, *([None] * len(x.shape)))
+    return shard_annotate(x, *([U] * (len(x.shape) - 1)), None)
 
 
 def _mp_allreduce(x, group=None, use_calc_stream=True, use_model_parallel=True):
-    return shard_annotate(x, *([None] * len(x.shape)))
+    return shard_annotate(x, *([U] * (len(x.shape) - 1)), None)
 
 
 def _c_split(x, group=None):
     """Split last dim across mp (fwd) / allgather (bwd)."""
-    return shard_annotate(x, *([None] * (len(x.shape) - 1)), MP_AXIS)
+    return shard_annotate(x, *([U] * (len(x.shape) - 1)), MP_AXIS)
 
 
 def _c_concat(x, group=None):
     """Allgather last dim across mp."""
-    return shard_annotate(x, *([None] * len(x.shape)))
+    return shard_annotate(x, *([U] * (len(x.shape) - 1)), None)
 
 
 def split_model_parallel(x, axis=-1):
     nd = len(x.shape)
     axis = axis % nd
-    spec = [None] * nd
+    spec = [U] * nd
     spec[axis] = MP_AXIS
     return shard_annotate(x, *spec)
 

@@ -230,6 +230,18 @@ class TestAutogradEngine:
         np.testing.assert_allclose(float(g.value), 6.0)
         assert x.grad is None  # paddle.grad must not pollute .grad
 
+    def test_grad_unused_input_raises(self):
+        """ADVICE r1: silently substituting zeros for unreachable inputs
+        masks disconnected-graph bugs — the reference raises."""
+        import pytest
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        unused = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+        y = x * x
+        with pytest.raises(ValueError, match="unreachable"):
+            paddle.grad(y, [unused])
+        g, = paddle.grad(y, [unused], allow_unused=True)
+        assert g is None
+
     def test_detach(self):
         x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
         d = (x * 2).detach()

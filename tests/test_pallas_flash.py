@@ -1,0 +1,99 @@
+"""Pallas flash-attention kernels exercised in CI via interpret=True.
+
+VERDICT r1 weak item 4: the CPU test suite only ever ran the jnp reference
+path, so a kernel regression was invisible until a TPU bench run. These
+tests force interpret mode so the actual kernel bodies (online softmax,
+causal pruning, tail-block masking, bwd dkv/dq) run on every CI pass.
+
+Oracle: ``_ref_attention`` (jnp, full S×S materialization) and its
+``jax.grad`` — the reference's OpTest check_output/check_grad pattern
+(SURVEY.md §4, test/legacy_test/op_test.py †).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels import pallas_flash
+from paddle_tpu.kernels.flash_attention import _ref_attention
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    pallas_flash._FORCE_INTERPRET[0] = True
+    yield
+    pallas_flash._FORCE_INTERPRET[0] = False
+
+
+def _mk(bh, s, d, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(bh, s, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(bh, s, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(bh, s, d).astype(np.float32)) * 0.3
+    return q, k, v
+
+
+def _ref_bhsd(q, k, v, causal):
+    # [BH, S, D] -> [BH, S, 1, D] paddle layout for the oracle
+    out = _ref_attention(q[:, :, None], k[:, :, None], v[:, :, None], causal)
+    return out[:, :, 0]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s,block", [(256, 128), (320, 128), (384, 256)])
+def test_fwd_matches_reference(causal, s, block):
+    """320/384 with block 128/256 exercise the padded tail block — the
+    ADVICE r1 high-severity bug (unmasked padded cols in non-causal)."""
+    q, k, v = _mk(2, s, 64)
+    out = pallas_flash.flash_attention_bhsd(q, k, v, causal=causal,
+                                            block_q=block, block_k=block)
+    ref = _ref_bhsd(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s,block", [(256, 128), (320, 128)])
+def test_grads_match_reference(causal, s, block):
+    q, k, v = _mk(2, s, 32, seed=1)
+
+    def loss_flash(q, k, v):
+        o = pallas_flash.flash_attention_bhsd(q, k, v, causal=causal,
+                                              block_q=block, block_k=block)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = _ref_bhsd(q, k, v, causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_gqa_paddle_layout():
+    """[B,S,H,D] entry with grouped-query kv heads (H=4, Hk=2)."""
+    rng = np.random.RandomState(2)
+    B, S, H, Hk, D = 2, 256, 4, 2, 32
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, S, Hk, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, S, Hk, D).astype(np.float32)) * 0.3
+    out = pallas_flash.flash_attention_pallas(q, k, v, causal=True,
+                                              block_q=128, block_k=128)
+    ref = _ref_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_tail_block_nondivisible_long():
+    """S=1500-style case from ADVICE r1 (scaled down): S % block != 0,
+    non-causal — previously returned silently wrong output."""
+    q, k, v = _mk(1, 200, 32, seed=3)
+    out = pallas_flash.flash_attention_bhsd(q, k, v, causal=False,
+                                            block_q=128, block_k=128)
+    ref = _ref_bhsd(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

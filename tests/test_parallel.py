@@ -250,6 +250,51 @@ class TestCompileOnlyHLO:
         hlo = step.lower_text((paddle.to_tensor(x),), (paddle.to_tensor(y),))
         assert ("all-reduce" in hlo) or ("reduce-scatter" in hlo)
 
+    def test_hybrid_dp_mp_no_batch_allgather(self):
+        """ADVICE r1: all-None activation specs in MP layers un-sharded the
+        dp batch dim, forcing a batch all-gather at every MP layer. With
+        P.UNCONSTRAINED on non-mp dims the dp sharding must survive — this
+        forward/backward contains all-reduces but NO all-gather."""
+        hcg = _reset_fleet(dp_degree=2, mp_degree=4)
+        tp = nn.Sequential(
+            fleet.meta_parallel.ColumnParallelLinear(8, 16, gather_output=False),
+            nn.ReLU(),
+            fleet.meta_parallel.RowParallelLinear(16, 4, input_is_parallel=True),
+        )
+        step = TrainStep(tp, lambda o, l: F.cross_entropy(o, l),
+                         SGD(learning_rate=0.1, parameters=tp.parameters()),
+                         mesh=hcg.mesh)
+        x, y = _data(n=8)
+        hlo = step.lower_text((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert "all-reduce" in hlo
+        assert "all-gather" not in hlo
+
+    def test_hybrid_dp_mp_parity(self):
+        """dp2×mp4 hybrid step matches serial (previously only dp-only and
+        mp-only meshes were exercised)."""
+        paddle.seed(202)
+        hcg = _reset_fleet(dp_degree=2, mp_degree=4)
+        serial = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        tp = nn.Sequential(
+            fleet.meta_parallel.ColumnParallelLinear(8, 16, gather_output=False),
+            nn.ReLU(),
+            fleet.meta_parallel.RowParallelLinear(16, 4, input_is_parallel=True),
+        )
+        tp.set_state_dict(serial.state_dict())
+        x, y = _data(n=8)
+        s_step = TrainStep(serial, lambda o, l: F.cross_entropy(o, l),
+                           SGD(learning_rate=0.1,
+                               parameters=serial.parameters()))
+        t_step = TrainStep(tp, lambda o, l: F.cross_entropy(o, l),
+                           SGD(learning_rate=0.1, parameters=tp.parameters()),
+                           mesh=hcg.mesh)
+        for _ in range(3):
+            ls = float(s_step.step((paddle.to_tensor(x),),
+                                   (paddle.to_tensor(y),)).value)
+            lt = float(t_step.step((paddle.to_tensor(x),),
+                                   (paddle.to_tensor(y),)).value)
+            np.testing.assert_allclose(ls, lt, rtol=1e-4, atol=1e-5)
+
     def test_serial_step_has_no_collectives(self):
         m = nn.Linear(8, 4)
         step = TrainStep(m, lambda o, l: F.cross_entropy(o, l),
@@ -321,6 +366,17 @@ class TestDistributedCheckpoint:
         target = paddle.Tensor(np.zeros((8, 8), np.float32))
         load_state_dict({"w": target}, str(tmp_path / "ck2"))
         np.testing.assert_allclose(target.numpy(), w)
+
+    def test_scalar_entries_restored(self, tmp_path):
+        """ADVICE r1: optimizer scalars like '@step' were skipped on load,
+        silently resetting Adam bias correction / LR schedule on resume."""
+        from paddle_tpu.distributed import save_state_dict, load_state_dict
+        sd = {"w": paddle.Tensor(np.ones((4,), np.float32)), "@step": 17}
+        save_state_dict(sd, str(tmp_path / "ck3"))
+        sd2 = {"w": paddle.Tensor(np.zeros((4,), np.float32)), "@step": 0}
+        load_state_dict(sd2, str(tmp_path / "ck3"))
+        assert int(sd2["@step"]) == 17
+        np.testing.assert_allclose(sd2["w"].numpy(), 1.0)
 
 
 class TestRecompute:
