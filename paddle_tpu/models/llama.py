@@ -52,6 +52,11 @@ class LlamaConfig:
     use_recompute: bool = True
     recompute_policy: str = "full"  # "full" | "dots" (save matmul outputs)
     sequence_parallel: bool = False
+    # >0 routes the decoder stack through parallel.pp.pipeline_spmd when the
+    # mesh has pp>1: stage-resident weights + ppermute handoffs over M
+    # microbatches (the real pipeline schedule, vs pp-sharding the scan's
+    # layer dim). Batch size must be divisible by this.
+    pipeline_microbatches: int = 0
     dtype: str = "float32"
 
     @property
@@ -215,7 +220,8 @@ class LlamaForCausalLM(nn.Layer):
             input_ids, labels, c.num_attention_heads, c.num_key_value_heads,
             c.head_dim, float(c.rms_norm_eps), float(c.rope_theta),
             bool(c.use_recompute), self.lm_head is None,
-            policy=c.recompute_policy, **params)
+            policy=c.recompute_policy,
+            pipeline_microbatches=int(c.pipeline_microbatches), **params)
         return out
 
     def num_params(self):
@@ -225,8 +231,9 @@ class LlamaForCausalLM(nn.Layer):
 
 @tensor_op
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
-                   policy="full", *, embed, wq, wk, wv, wo, w_gate, w_up,
-                   w_down, input_ln, post_ln, final_norm, lm_head):
+                   policy="full", pipeline_microbatches=0, *, embed, wq, wk,
+                   wv, wo, w_gate, w_up, w_down, input_ln, post_ln,
+                   final_norm, lm_head):
     B, S = input_ids.shape
     H = embed.shape[1]
     batch_spec = ("dp", "sharding")
@@ -237,18 +244,19 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
 
     def layer_body(h, lp):
         (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
+        Bh, Sh = h.shape[0], h.shape[1]  # microbatch-sized under pipeline
         resid = h
         hn = _rms(h, lin, eps)
         hn = _ann(hn, batch_spec, "sep", None)
-        q = jnp.einsum("bsh,hd->bsd", hn, lwq).reshape(B, S, nh, hd)
-        k = jnp.einsum("bsh,hd->bsd", hn, lwk).reshape(B, S, nkv, hd)
-        v = jnp.einsum("bsh,hd->bsd", hn, lwv).reshape(B, S, nkv, hd)
+        q = jnp.einsum("bsh,hd->bsd", hn, lwq).reshape(Bh, Sh, nh, hd)
+        k = jnp.einsum("bsh,hd->bsd", hn, lwk).reshape(Bh, Sh, nkv, hd)
+        v = jnp.einsum("bsh,hd->bsd", hn, lwv).reshape(Bh, Sh, nkv, hd)
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
         q = _ann(q, batch_spec, None, "mp", None)
         k = _ann(k, batch_spec, None, "mp", None)
         attn = _attention(q, k, v, causal=True)
-        attn = attn.reshape(B, S, nh * hd)
+        attn = attn.reshape(Bh, Sh, nh * hd)
         h = resid + _ann(jnp.einsum("bsd,dh->bsh", attn, lwo),
                          batch_spec, "sep", None)
         resid = h
@@ -268,7 +276,21 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
     else:
         body = layer_body
     stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
-    x, _ = jax.lax.scan(lambda h, lp: body(h, lp), x, stack)
+    mesh = mesh_mod.get_mesh()
+    pp_deg = (int(mesh.shape["pp"]) if mesh is not None and
+              "pp" in mesh.axis_names else 1)
+    if pipeline_microbatches > 0 and pp_deg > 1:
+        # real pipeline: stage-resident weight slices + ppermute handoffs
+        from ..parallel.pp import pipeline_spmd
+
+        def stage_fn(local_stack, h):
+            h, _ = jax.lax.scan(lambda hh, lp: body(hh, lp), h, local_stack)
+            return h
+
+        x = pipeline_spmd(stage_fn, stack, x,
+                          num_microbatches=pipeline_microbatches, mesh=mesh)
+    else:
+        x, _ = jax.lax.scan(lambda h, lp: body(h, lp), x, stack)
 
     x = _rms(x, final_norm, eps)
     head = lm_head.T if tied else lm_head

@@ -73,6 +73,8 @@ class PipelineParallel(MetaParallelBase):
         pp_cfg = strategy.hybrid_configs.get("pp_configs", {})
         self.micro_batch_size = int(pp_cfg.get("micro_batch_size", 1))
         self.accumulate_steps = int(pp_cfg.get("accumulate_steps", 1))
+        # enable the real SPMD schedule inside the layer's forward
+        layers._pp_microbatches = self.accumulate_steps
         self._train_step = None
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

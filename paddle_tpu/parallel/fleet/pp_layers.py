@@ -74,6 +74,8 @@ class PipelineLayer(nn.Layer):
         self._recompute_interval = recompute_interval
         self._virtual_pp = num_virtual_pipeline_stages or 1
         self._shared = {}
+        self._pp_microbatches = 0  # set by PipelineParallel from pp_configs
+        self._homogeneous = None
         self._build()
 
     # ---------------------------------------------------------------- build
@@ -124,9 +126,89 @@ class PipelineLayer(nn.Layer):
 
     # ---------------------------------------------------------------- run
     def forward(self, x):
+        if self._should_pipeline(x) and self._in_trace(x):
+            return self._forward_pipelined(x)
         for layer in self.run_function:
             x = layer(x)
         return x
+
+    @staticmethod
+    def _in_trace(x):
+        """The SPMD pipeline path is for compiled steps (TrainStep tracing);
+        eager forward keeps the tape-correct sequential loop."""
+        import jax
+        val = x.value if hasattr(x, "value") else x
+        return isinstance(val, jax.core.Tracer)
+
+    def _should_pipeline(self, x):
+        """Route through parallel.pp.pipeline_1f1b when (a) a pp>1 mesh
+        matching num_stages is active, (b) a microbatch count was set by
+        PipelineParallel, (c) batch divides, and (d) stage activation
+        shapes are homogeneous (the ppermute handoff contract). Otherwise
+        the numerically-identical sequential loop runs."""
+        from .. import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+        S = self._num_stages
+        M = int(getattr(self, "_pp_microbatches", 0))
+        if S <= 1 or M <= 1 or mesh is None or "pp" not in mesh.axis_names:
+            return False
+        if int(mesh.shape["pp"]) != S or x.shape[0] % M != 0:
+            return False
+        if self._homogeneous is None:
+            self._homogeneous = self._check_homogeneous(x)
+        return self._homogeneous
+
+    def _stage_closures(self):
+        """(stage_fns, stage_param_values): pure array functions + the
+        current (possibly traced) param leaves, per stage."""
+        from ...core.tensor import Tensor as _T
+        fns, vals = [], []
+        for s in range(self._num_stages):
+            layers_s = self.get_stage_layers(s)
+            pobjs = [p for l in layers_s for p in l.parameters()]
+
+            def fn(pvals, h, layers_s=layers_s, pobjs=pobjs):
+                saved = [p._value for p in pobjs]
+                for p, v in zip(pobjs, pvals):
+                    p._value = v
+                try:
+                    t = _T(h)
+                    for l in layers_s:
+                        t = l(t)
+                    return t.value
+                finally:
+                    for p, v in zip(pobjs, saved):
+                        p._value = v
+
+            fns.append(fn)
+            vals.append(tuple(p.value for p in pobjs))
+        return fns, tuple(vals)
+
+    def _check_homogeneous(self, x):
+        import jax
+        fns, vals = self._stage_closures()
+        mb_shape = jax.ShapeDtypeStruct(
+            (1,) + tuple(x.shape[1:]),
+            x.value.dtype if hasattr(x, "value") else x.dtype)
+        try:
+            h = mb_shape
+            for fn, pv in zip(fns, vals):
+                h = jax.eval_shape(fn, pv, h)
+                if (h.shape, h.dtype) != (mb_shape.shape, mb_shape.dtype):
+                    return False
+            return True
+        except Exception:
+            return False
+
+    def _forward_pipelined(self, x):
+        from ...core.tensor import Tensor as _T
+        from ..pp import pipeline_1f1b
+        fns, vals = self._stage_closures()
+        out = pipeline_1f1b(
+            fns, vals, x.value if isinstance(x, _T) else x,
+            num_microbatches=int(self._pp_microbatches),
+            remat=True)  # 1F1B memory bound: remat each tick's stage body
+        return _T(out)
 
     def forward_stage(self, x, stage_id):
         for layer in self.get_stage_layers(stage_id):

@@ -34,17 +34,28 @@ def pipeline_train_batch(pp_model, data, optimizer, lr_scheduler=None,
     bsz = x.shape[0]
     micro = max(bsz // accum, 1)
 
+    # When the layer routes through the SPMD pipeline schedule
+    # (parallel.pp), the microbatching happens INSIDE the compiled forward —
+    # a grad-accum outer loop on top would microbatch twice. The decision
+    # depends on the batch's divisibility, so it is made per batch (a
+    # remainder batch falls back to grad-accum without freezing the choice);
+    # one TrainStep is cached per mode.
+    use_pipe = layers._should_pipeline(x)
     if pp_model._train_step is None:
+        pp_model._train_step = {}
+    if use_pipe not in pp_model._train_step:
         inner_opt = getattr(optimizer, "_inner_opt", optimizer)
 
         def scaled_loss(out, label):
             return loss_fn(out, label)
 
-        pp_model._train_step = TrainStep(layers, scaled_loss, inner_opt,
-                                         grad_accum_steps=accum)
+        pp_model._train_step[use_pipe] = TrainStep(
+            layers, scaled_loss, inner_opt,
+            grad_accum_steps=1 if use_pipe else accum)
+    pp_model._uses_spmd_pipe = use_pipe
 
-    step = pp_model._train_step
-    if accum > 1 and bsz % accum == 0:
+    step = pp_model._train_step[use_pipe]
+    if not use_pipe and accum > 1 and bsz % accum == 0:
         loss = step.accum_step((x,), (y,), accum)
     else:
         loss = step.step((x,), (y,))

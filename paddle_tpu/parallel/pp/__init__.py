@@ -1,0 +1,159 @@
+"""SPMD pipeline-parallel schedules over the 'pp' mesh axis.
+
+The TPU rewrite of the reference's pipeline runtime
+(``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+1F1B schedule + ``pp_utils/p2p_communication.py`` p2p send/recv):
+
+- **Stage-resident weights**: each pp device holds only its stage's slice
+  of the stacked layer weights (``in_specs=P('pp')`` on the layer dim) —
+  unlike the r1 scan-over-layers layout, weights never stream across
+  stages.
+- **collective-permute handoffs**: activations move stage s -> s+1 with
+  ``lax.ppermute`` — the ICI-neighbor transfer that replaces the
+  reference's NCCL ``send_v2``/``recv_v2`` pair (shape metadata handshake
+  unnecessary: shapes are static under jit).
+- **Microbatch loop**: ``lax.scan`` over M + S - 1 ticks. Differentiating
+  through the scan-of-ppermute yields the reverse pipeline automatically —
+  the backward pass IS a pipelined schedule with reversed permutes, so the
+  1F1B fwd/bwd interleaving the reference hand-schedules falls out of
+  autodiff. Pass ``remat=True`` (or checkpoint inside your own stage_fn,
+  as the llama model does per-layer) to rematerialize each tick's stage
+  body in backward — that bounds live activations at ~one microbatch per
+  stage, the 1F1B memory behavior; without remat, scan residuals grow
+  linearly in num_microbatches.
+
+Partial-manual ``jax.shard_map``: only 'pp' is manual; dp/sharding/sep/mp
+stay in GSPMD's hands inside the stage body, so tensor-parallel layers and
+batch sharding compose with the pipeline unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import mesh as mesh_mod
+
+PP_AXIS = "pp"
+
+
+def _pp_degree(mesh, axis):
+    if mesh is None:
+        return 1
+    return int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+
+
+def _run_schedule(apply_fn, params, params_in_specs, x, *, M, S, mesh, axis,
+                  remat):
+    """Shared microbatch-tick schedule.
+
+    ``apply_fn(params_local, a) -> a`` is the per-device stage computation
+    (plain stage_fn, or a lax.switch over heterogeneous branches).
+    """
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+    T = M + S - 1
+    stage = jax.checkpoint(apply_fn) if remat else apply_fn
+
+    def body(params_local, xs):
+        s = jax.lax.axis_index(axis)
+        fwd = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(a, t):
+            # stage 0 pulls microbatch t from the input stream (clipped in
+            # the drain phase — those outputs never reach the last stage)
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            a_in = jnp.where(s == 0, x_t, a)
+            y = stage(params_local, a_in)
+            a_next = jax.lax.ppermute(y, axis, fwd)
+            return a_next, y
+
+        a0 = jnp.zeros_like(xs[0])
+        _, ys = jax.lax.scan(tick, a0, jnp.arange(T))
+        return ys[None]  # [1, T, mb, ...] -> global [S, T, mb, ...]
+
+    ys = jax.shard_map(
+        body, mesh=mesh, axis_names={axis},
+        in_specs=(params_in_specs, P()), out_specs=P(axis),
+        check_vma=False)(params, xs)
+    # valid outputs: last stage, ticks S-1 .. T-1 == microbatches 0 .. M-1
+    out = ys[S - 1, S - 1:]
+    return out.reshape(B, *out.shape[2:])
+
+
+def pipeline_spmd(stage_fn, stacked_params, x, *, num_microbatches,
+                  mesh=None, axis=PP_AXIS, remat=False):
+    """Pipelined application of a homogeneous layer stack.
+
+    Args:
+      stage_fn: ``(local_params, h) -> h`` applying one *stage* — the
+        pp-local slice of the stack (leading dim ``L // S``) — to an
+        activation microbatch. Typically an inner ``lax.scan`` over the
+        local layers.
+      stacked_params: pytree of arrays with leading dim L (total layers),
+        L % S == 0. Sharded (or shardable) ``P('pp')`` on dim 0 — each
+        device keeps only its stage's layers.
+      x: activations ``[B, ...]``; B % num_microbatches == 0. Non-batch
+        dims may carry auto-axis shardings (mp/sep) — they survive.
+      num_microbatches: M. Pipeline bubble fraction is (S-1)/(M+S-1).
+      remat: checkpoint the stage body per tick (1F1B memory bound). Leave
+        False if stage_fn already remats internally (e.g. per layer).
+
+    Returns ``[B, ...]`` activations after all L layers.
+    """
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    S = _pp_degree(mesh, axis)
+    if S <= 1:
+        return stage_fn(stacked_params, x)
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"layer count {L} not divisible by pp degree {S}")
+    return _run_schedule(
+        stage_fn, stacked_params,
+        jax.tree.map(lambda _: P(axis), stacked_params), x,
+        M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
+
+
+def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
+                  mesh=None, axis=PP_AXIS, remat=False):
+    """Pipelined application of *heterogeneous* stages (general
+    PipelineLayer topologies) via ``lax.switch`` on the stage index.
+
+    ``stage_fns[i](stage_params[i], h) -> h`` must all map activations of
+    the same shape/dtype (the pipeline handoff contract). Stage params are
+    passed replicated w.r.t. 'pp' (arbitrary per-stage pytrees can't be
+    mesh-sharded on a stage dim); weight residency therefore applies only
+    to the homogeneous ``pipeline_spmd`` path. Gradients for every stage's
+    params come out correct: shard_map's autodiff psums the replicated-in
+    cotangents over 'pp', and only stage i's devices contribute nonzero.
+    """
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    S = _pp_degree(mesh, axis)
+    if S <= 1:
+        h = x
+        for fn, p in zip(stage_fns, stage_params):
+            h = fn(p, h)
+        return h
+    if len(stage_fns) != S:
+        raise ValueError(f"{len(stage_fns)} stage fns for pp degree {S}")
+    params_tuple = tuple(stage_params)
+
+    def apply_switch(params_all, a):
+        s = jax.lax.axis_index(axis)
+        branches = [
+            (lambda a, i=i: stage_fns[i](params_all[i], a)) for i in range(S)
+        ]
+        return jax.lax.switch(s, branches, a)
+
+    return _run_schedule(
+        apply_switch, params_tuple,
+        jax.tree.map(lambda _: P(), params_tuple), x,
+        M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
+
+
+# Name referenced by docstrings elsewhere in the tree.
+schedule = pipeline_spmd

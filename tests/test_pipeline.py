@@ -1,0 +1,285 @@
+"""Real pipeline-parallel schedule tests (VERDICT r1 item 2).
+
+The r1 pp tests passed with or without a pipeline because execution was a
+sequential loop. These test the actual schedule in parallel.pp:
+- parity vs serial on pp2/pp4 meshes (fwd + grads)
+- compile-only: collective-permute present, stage weights pp-sharded
+- LLaMA end-to-end with pipeline_microbatches routed through the schedule
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW, SGD
+from paddle_tpu.parallel.pp import pipeline_1f1b, pipeline_spmd
+
+
+def _reset_fleet(**degrees):
+    from paddle_tpu.parallel import mesh as mesh_mod
+    mesh_mod._STATE["mesh"] = None
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = degrees
+    fleet.init(is_collective=True, strategy=s)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _layer(h, w):
+    return jnp.tanh(h @ w), None
+
+
+def _serial(W, x):
+    y, _ = jax.lax.scan(_layer, x, W)
+    return y
+
+
+def _mk(L=8, H=16, B=8, seed=0):
+    rng = np.random.RandomState(seed)
+    W = jnp.asarray(rng.randn(L, H, H).astype(np.float32)) * 0.1
+    x = jnp.asarray(rng.randn(B, H).astype(np.float32))
+    return W, x
+
+
+def _stage_fn(local_W, h):
+    h, _ = jax.lax.scan(_layer, h, local_W)
+    return h
+
+
+class TestPipelineSpmd:
+    @pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (8, 2)])
+    def test_forward_parity(self, pp, m):
+        hcg = _reset_fleet(pp_degree=pp, dp_degree=8 // pp)
+        W, x = _mk()
+        y0 = _serial(W, x)
+        y1 = jax.jit(lambda W, x: pipeline_spmd(
+            _stage_fn, W, x, num_microbatches=m, mesh=hcg.mesh))(W, x)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_parity(self):
+        hcg = _reset_fleet(pp_degree=4, dp_degree=2)
+        W, x = _mk(seed=1)
+
+        def loss_pipe(W, x):
+            return jnp.sum(jnp.sin(pipeline_spmd(
+                _stage_fn, W, x, num_microbatches=4, mesh=hcg.mesh)))
+
+        def loss_serial(W, x):
+            return jnp.sum(jnp.sin(_serial(W, x)))
+
+        gw0, gx0 = jax.grad(loss_serial, argnums=(0, 1))(W, x)
+        gw1, gx1 = jax.jit(jax.grad(loss_pipe, argnums=(0, 1)))(W, x)
+        np.testing.assert_allclose(np.asarray(gw0), np.asarray(gw1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx0), np.asarray(gx1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_collective_permute_in_hlo(self):
+        hcg = _reset_fleet(pp_degree=4, dp_degree=2)
+        W, x = _mk()
+        hlo = jax.jit(lambda W, x: pipeline_spmd(
+            _stage_fn, W, x, num_microbatches=4,
+            mesh=hcg.mesh)).lower(W, x).compile().as_text()
+        assert "collective-permute" in hlo
+
+    def test_validation_errors(self):
+        hcg = _reset_fleet(pp_degree=4, dp_degree=2)
+        W, x = _mk()
+        with pytest.raises(ValueError, match="not divisible by microbatches"):
+            pipeline_spmd(_stage_fn, W, x, num_microbatches=3, mesh=hcg.mesh)
+        W6, _ = _mk(L=6)
+        with pytest.raises(ValueError, match="not divisible by pp degree"):
+            pipeline_spmd(_stage_fn, W6, x, num_microbatches=4, mesh=hcg.mesh)
+
+    def test_pp1_falls_back_to_serial(self):
+        _reset_fleet(dp_degree=8)
+        W, x = _mk()
+        y = pipeline_spmd(_stage_fn, W, x, num_microbatches=4)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_serial(W, x)),
+                                   rtol=1e-6)
+
+
+class TestPipeline1F1BHeterogeneous:
+    def test_switch_stages_parity(self):
+        hcg = _reset_fleet(pp_degree=2, dp_degree=4)
+        rng = np.random.RandomState(2)
+        H = 8
+        w0 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        w1 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        x = jnp.asarray(rng.randn(8, H).astype(np.float32))
+        fns = [lambda p, h: jnp.tanh(h @ p),      # stage 0: tanh linear
+               lambda p, h: jax.nn.relu(h @ p)]   # stage 1: relu linear
+        y0 = fns[1](w1, fns[0](w0, x))
+        y1 = jax.jit(lambda p, x: pipeline_1f1b(
+            fns, p, x, num_microbatches=4, mesh=hcg.mesh))((w0, w1), x)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_switch_stages_grads(self):
+        hcg = _reset_fleet(pp_degree=2, dp_degree=4)
+        rng = np.random.RandomState(3)
+        H = 8
+        w0 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        w1 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        x = jnp.asarray(rng.randn(8, H).astype(np.float32))
+        fns = [lambda p, h: jnp.tanh(h @ p),
+               lambda p, h: jax.nn.relu(h @ p)]
+
+        def loss_pipe(ps, x):
+            return jnp.sum(jnp.sin(pipeline_1f1b(
+                fns, ps, x, num_microbatches=4, mesh=hcg.mesh)))
+
+        def loss_serial(ps, x):
+            return jnp.sum(jnp.sin(fns[1](ps[1], fns[0](ps[0], x))))
+
+        g0 = jax.grad(loss_serial)((w0, w1), x)
+        g1 = jax.jit(jax.grad(loss_pipe))((w0, w1), x)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestFleetTrainBatchPipelined:
+    """fleet.distributed_model(PipelineLayer).train_batch routes through the
+    SPMD schedule when pp>1 and stages are homogeneous."""
+
+    def _run(self, pp_degree, steps=4):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"pp_degree": pp_degree, "dp_degree": 8 // pp_degree,
+                            "pp_configs": {"accumulate_steps": 4,
+                                           "micro_batch_size": 4}}
+        fleet.init(is_collective=True, strategy=s)
+        from paddle_tpu.distributed.fleet import PipelineLayer
+        paddle.seed(800)
+        pl = PipelineLayer(
+            [nn.Linear(8, 8) for _ in range(4)], num_stages=pp_degree,
+            loss_fn=lambda o, l: F.mse_loss(o, l))
+        model = fleet.distributed_model(pl)
+        opt = fleet.distributed_optimizer(
+            SGD(learning_rate=0.05, parameters=pl.parameters()))
+        rng = np.random.RandomState(4)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 8).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            loss = model.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+            losses.append(float(loss.value))
+        return losses, model
+
+    def test_pp2_train_batch_matches_pp1(self):
+        serial, _ = self._run(pp_degree=1)
+        piped, model = self._run(pp_degree=2)
+        assert model._uses_spmd_pipe
+        np.testing.assert_allclose(serial, piped, rtol=1e-4, atol=1e-5)
+
+    def test_pp4_train_batch_matches_pp1(self):
+        serial, _ = self._run(pp_degree=1)
+        piped, model = self._run(pp_degree=4)
+        assert model._uses_spmd_pipe
+        np.testing.assert_allclose(serial, piped, rtol=1e-4, atol=1e-5)
+
+    def test_remainder_batch_does_not_freeze_decision(self):
+        """A non-divisible first batch must not permanently disable the
+        SPMD pipeline for later divisible batches (review finding)."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"pp_degree": 2, "dp_degree": 4,
+                            "pp_configs": {"accumulate_steps": 4}}
+        fleet.init(is_collective=True, strategy=s)
+        from paddle_tpu.distributed.fleet import PipelineLayer
+        paddle.seed(802)
+        pl = PipelineLayer([nn.Linear(8, 8) for _ in range(4)], num_stages=2,
+                           loss_fn=lambda o, l: F.mse_loss(o, l))
+        model = fleet.distributed_model(pl)
+        opt = fleet.distributed_optimizer(
+            SGD(learning_rate=0.05, parameters=pl.parameters()))
+        rng = np.random.RandomState(6)
+        x15 = rng.randn(15, 8).astype(np.float32)
+        model.train_batch([paddle.to_tensor(x15),
+                           paddle.to_tensor(x15.copy())], opt)
+        assert not model._uses_spmd_pipe  # 15 % 4 != 0 -> fallback
+        x16 = rng.randn(16, 8).astype(np.float32)
+        model.train_batch([paddle.to_tensor(x16),
+                           paddle.to_tensor(x16.copy())], opt)
+        assert model._uses_spmd_pipe  # divisible batch re-enables
+
+    def test_heterogeneous_shapes_fall_back(self):
+        """Stage output shapes differ -> sequential fallback, still correct."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import mesh as mesh_mod
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"pp_degree": 2, "dp_degree": 4,
+                            "pp_configs": {"accumulate_steps": 4}}
+        fleet.init(is_collective=True, strategy=s)
+        from paddle_tpu.distributed.fleet import PipelineLayer
+        paddle.seed(801)
+        pl = PipelineLayer(
+            [nn.Linear(8, 16), nn.Linear(16, 8)], num_stages=2,
+            loss_fn=lambda o, l: F.mse_loss(o, l))
+        model = fleet.distributed_model(pl)
+        opt = fleet.distributed_optimizer(
+            SGD(learning_rate=0.05, parameters=pl.parameters()))
+        rng = np.random.RandomState(5)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 8).astype(np.float32)
+        loss = model.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)],
+                                 opt)
+        assert not model._uses_spmd_pipe
+        assert np.isfinite(float(loss.value))
+
+
+class TestLlamaPipeline:
+    def _losses(self, pp, microbatches, steps=3):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        hcg = _reset_fleet(pp_degree=pp, dp_degree=8 // pp)
+        paddle.seed(42)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=4, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=32,
+                          use_recompute=False,
+                          pipeline_microbatches=microbatches)
+        model = LlamaForCausalLM(cfg)
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = TrainStep(model, lambda loss, _l: loss, opt,
+                         mesh=hcg.mesh if pp > 1 else None)
+        ids = paddle.to_tensor(np.random.RandomState(7).randint(
+            0, 64, (8, 16)).astype(np.int32))
+        out = []
+        for _ in range(steps):
+            out.append(float(step.step((ids, ids), (ids,)).value))
+        return out, step
+
+    def test_llama_pp2_pipeline_matches_serial(self):
+        serial, _ = self._losses(pp=1, microbatches=0)
+        piped, _ = self._losses(pp=2, microbatches=4)
+        np.testing.assert_allclose(serial, piped, rtol=2e-4, atol=2e-5)
+
+    def test_llama_pp4_pipeline_matches_serial(self):
+        serial, _ = self._losses(pp=1, microbatches=0)
+        piped, _ = self._losses(pp=4, microbatches=2)
+        np.testing.assert_allclose(serial, piped, rtol=2e-4, atol=2e-5)
+
+    def test_llama_pipeline_hlo_and_stage_residency(self):
+        _, step = self._losses(pp=2, microbatches=4, steps=1)
+        from paddle_tpu.models.llama import LlamaConfig
+        ids = paddle.to_tensor(np.random.RandomState(7).randint(
+            0, 64, (8, 16)).astype(np.int32))
+        hlo = step.lower_text((ids, ids), (ids,))
+        assert "collective-permute" in hlo
+        # stage residency: stacked layer weights sharded over pp on dim 0
+        wq = step.params["wq"]
+        spec = wq.sharding.spec
+        assert spec[0] == "pp" or spec[0] == ("pp",)
+        # each device holds L/S = 2 of the 4 layers
+        assert wq.addressable_shards[0].data.shape[0] == 2
