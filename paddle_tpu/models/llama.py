@@ -57,6 +57,11 @@ class LlamaConfig:
     # microbatches (the real pipeline schedule, vs pp-sharding the scan's
     # layer dim). Batch size must be divisible by this.
     pipeline_microbatches: int = 0
+    # "" | "ring" | "ulysses": context parallelism over the 'sep' mesh axis
+    # (parallel.sp_attention). Requires sep>1 in the mesh and (for now)
+    # pp degree 1 — nesting the sep shard_map inside the pipeline's manual
+    # 'pp' region is unsupported.
+    context_parallel: str = ""
     dtype: str = "float32"
 
     @property
@@ -221,7 +226,8 @@ class LlamaForCausalLM(nn.Layer):
             c.head_dim, float(c.rms_norm_eps), float(c.rope_theta),
             bool(c.use_recompute), self.lm_head is None,
             policy=c.recompute_policy,
-            pipeline_microbatches=int(c.pipeline_microbatches), **params)
+            pipeline_microbatches=int(c.pipeline_microbatches),
+            context_parallel=str(c.context_parallel), **params)
         return out
 
     def num_params(self):
@@ -231,9 +237,9 @@ class LlamaForCausalLM(nn.Layer):
 
 @tensor_op
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
-                   policy="full", pipeline_microbatches=0, *, embed, wq, wk,
-                   wv, wo, w_gate, w_up, w_down, input_ln, post_ln,
-                   final_norm, lm_head):
+                   policy="full", pipeline_microbatches=0, context_parallel="",
+                   *, embed, wq, wk, wv, wo, w_gate, w_up, w_down, input_ln,
+                   post_ln, final_norm, lm_head):
     B, S = input_ids.shape
     H = embed.shape[1]
     batch_spec = ("dp", "sharding")
@@ -241,6 +247,10 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
     x = jnp.take(embed, input_ids, axis=0)
     x = _ann(x, batch_spec, "sep", None)
     sin, cos = _rope_tables(S, hd, theta)
+    mesh = mesh_mod.get_mesh()
+    sep_deg = (int(mesh.shape["sep"]) if mesh is not None and
+               "sep" in mesh.axis_names else 1)
+    use_cp = bool(context_parallel) and sep_deg > 1
 
     def layer_body(h, lp):
         (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
@@ -255,7 +265,22 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
         k = _apply_rope(k, sin, cos)
         q = _ann(q, batch_spec, None, "mp", None)
         k = _ann(k, batch_spec, None, "mp", None)
-        attn = _attention(q, k, v, causal=True)
+        if use_cp:
+            # context parallelism: seq stays sep-sharded through attention
+            from ..parallel.sp_attention import (ring_attention,
+                                                 ulysses_attention)
+            kr, vr = k, v
+            if nkv != nh:  # GQA: the cp kernels take equal head counts
+                kr = jnp.repeat(k, nh // nkv, axis=2)
+                vr = jnp.repeat(v, nh // nkv, axis=2)
+            cp_fn = (ring_attention if context_parallel == "ring"
+                     else ulysses_attention)
+            attn = jnp.swapaxes(
+                cp_fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(kr, 1, 2),
+                      jnp.swapaxes(vr, 1, 2), causal=True, mesh=mesh),
+                1, 2)
+        else:
+            attn = _attention(q, k, v, causal=True)
         attn = attn.reshape(Bh, Sh, nh * hd)
         h = resid + _ann(jnp.einsum("bsd,dh->bsh", attn, lwo),
                          batch_spec, "sep", None)
@@ -276,9 +301,12 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
     else:
         body = layer_body
     stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
-    mesh = mesh_mod.get_mesh()
     pp_deg = (int(mesh.shape["pp"]) if mesh is not None and
               "pp" in mesh.axis_names else 1)
+    if use_cp and pp_deg > 1 and pipeline_microbatches > 0:
+        raise ValueError("context_parallel cannot be combined with the "
+                         "pipeline schedule (nested shard_map regions); "
+                         "set pipeline_microbatches=0 or sep_degree=1")
     if pipeline_microbatches > 0 and pp_deg > 1:
         # real pipeline: stage-resident weight slices + ppermute handoffs
         from ..parallel.pp import pipeline_spmd

@@ -94,11 +94,13 @@ def ring_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
                            jnp.swapaxes(v, 1, 2), causal)
         return jnp.swapaxes(o, 1, 2)
     scale = 1.0 / math.sqrt(q.shape[-1])
-    ba = _batch_axes(mesh)
-    spec = P(ba if ba else None, None, axis_name, None)
+    # partial-manual: only the ring axis is manual; dp/sharding/mp stay in
+    # GSPMD's hands so any batch/head sharding composes unchanged
+    spec = P(None, None, axis_name, None)
     fn = functools.partial(_ring_local, axis_name=axis_name, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return jax.shard_map(fn, mesh=mesh, axis_names={axis_name},
+                         in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
@@ -134,11 +136,11 @@ def ulysses_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
             mesh.shape[axis_name] == 1:
         return ring_attention(q, k, v, causal, mesh, axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
-    ba = _batch_axes(mesh)
-    spec = P(ba if ba else None, None, axis_name, None)
+    spec = P(None, None, axis_name, None)
     fn = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return jax.shard_map(fn, mesh=mesh, axis_names={axis_name},
+                         in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
