@@ -1,0 +1,136 @@
+"""paddle.autograd.PyLayer — user-defined differentiable ops (reference:
+``python/paddle/autograd/py_layer.py`` †, the eager ``PyLayerContext`` /
+``PyLayer.apply`` pair backed by C++ ``PyLayerGradNode``).
+
+TPU-native: the custom forward/backward pair is a ``jax.custom_vjp``
+function, so the user's backward participates in BOTH execution modes —
+the eager tape (``jax.vjp`` of a custom_vjp fn invokes the custom rule)
+and jit-compiled TrainStep autodiff (where a tape-only design would
+silently lose the custom gradient).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import engine
+
+
+def _tensor_cls():
+    # deferred: core.tensor imports autograd.engine at package init
+    from ..core.tensor import Tensor
+    return Tensor
+
+
+def _wrap(v):
+    Tensor = _tensor_cls()
+    return jax.tree.map(
+        lambda x: Tensor(x, stop_gradient=False)
+        if not isinstance(x, Tensor) else x, v)
+
+
+def _unwrap(v):
+    Tensor = _tensor_cls()
+    return jax.tree.map(
+        lambda t: t.value if isinstance(t, Tensor) else t, v,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class PyLayerContext:
+    """Reference ``PyLayerContext``: carries state from forward to backward."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass with ``@staticmethod forward(ctx, *args)`` and
+    ``@staticmethod backward(ctx, *grads)``; call ``MyOp.apply(*args)``.
+
+    Tensor args are differentiable; non-Tensor args are closed over
+    statically. ``backward`` may return ``None`` for non-differentiable
+    inputs (mapped to zeros, matching reference semantics under
+    accumulation).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def _make_vjp_fn(cls, treedef, tensor_pos, n_args):
+        Tensor = _tensor_cls()
+
+        def rebuild(tensor_vals):
+            flat = list(treedef)
+            for p, tv in zip(tensor_pos, tensor_vals):
+                flat[p] = Tensor(tv, stop_gradient=False)
+            return flat
+
+        @jax.custom_vjp
+        def f(*tvals):
+            ctx = PyLayerContext()
+            with engine.no_grad():
+                out = cls.forward(ctx, *rebuild(tvals))
+            return _unwrap(out)
+
+        def f_fwd(*tvals):
+            ctx = PyLayerContext()
+            with engine.no_grad():
+                out = cls.forward(ctx, *rebuild(tvals))
+            return _unwrap(out), _unwrap(ctx._saved)
+
+        def f_bwd(res, g):
+            ctx = PyLayerContext()
+            ctx._saved = tuple(_wrap(list(res)))
+            with engine.no_grad():
+                grads = cls.backward(ctx, *_wrap(jax.tree.leaves(g)))
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grads = list(_unwrap(tuple(grads)))
+            # pad/None -> zeros for each differentiable input
+            out = []
+            for i, p in enumerate(tensor_pos):
+                gi = grads[i] if i < len(grads) else None
+                if gi is None:
+                    orig = treedef[p]
+                    val = orig.value if isinstance(orig, _tensor_cls()) else orig
+                    gi = jnp.zeros(jnp.shape(val), jnp.result_type(val))
+                out.append(gi)
+            return tuple(out)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if kwargs:
+            raise TypeError("PyLayer.apply takes positional arguments only "
+                            "(reference eager PyLayer semantics)")
+        Tensor = _tensor_cls()
+        flat = list(args)
+        tensor_pos = tuple(i for i, a in enumerate(flat)
+                           if isinstance(a, Tensor))
+        # note: `flat` (with its non-tensor statics) is captured per-call;
+        # the custom_vjp fn itself is rebuilt per call because the closure
+        # carries the static args. jax caches tracing by fn identity, so
+        # repeated apply() in eager is fine; inside jit it traces once.
+        f = cls._make_vjp_fn(flat, tensor_pos, len(flat))
+        tensors = [flat[p] for p in tensor_pos]
+        from ..ops._op import apply as _op_apply
+        return _op_apply(f, tuple(tensors), {},
+                         name=f"pylayer.{cls.__name__}")
+
+
+LegacyPyLayer = PyLayer  # reference alias (paddle.autograd.PyLayer pre-2.4)

@@ -7,6 +7,7 @@ from ..parallel import (DataParallel, Group, ParallelEnv, ReduceOp, all_gather,
                         get_rank, get_world_size, init_parallel_env,
                         is_initialized, new_group, recv, reduce,
                         reduce_scatter, scatter, send, spawn,
+                        batch_isend_irecv, irecv, isend, P2POp,
                         load_state_dict, save_state_dict,
                         group_sharded_parallel, save_group_sharded_model)
 from . import fleet

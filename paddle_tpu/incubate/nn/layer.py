@@ -146,7 +146,7 @@ class FusedMultiTransformer(nn.Layer):
                  ffn1_weight_attrs=None, ffn1_bias_attrs=None,
                  ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
                  num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
-                 name=None):
+                 kv_num_heads=None, name=None):
         super().__init__()
         assert normalize_before, "reference fused op is pre-LN"
         if num_layers < 0:
@@ -154,17 +154,23 @@ class FusedMultiTransformer(nn.Layer):
         self.num_layers = num_layers
         self.embed_dim = embed_dim
         self.num_heads = num_heads
+        # GQA (reference gqa_group_size): kv_num_heads < num_heads shares
+        # each kv head across num_heads//kv_num_heads query heads
+        self.kv_num_heads = kv_num_heads if kv_num_heads else num_heads
+        assert num_heads % self.kv_num_heads == 0
         self.head_dim = embed_dim // num_heads
         self.dim_feedforward = dim_feedforward
         self.activation = activation
         self.epsilon = epsilon
         L, H, D, E, FF = (num_layers, num_heads, self.head_dim, embed_dim,
                           dim_feedforward)
+        Hkv = self.kv_num_heads
         mk = self.create_parameter
         self.ln_scale = mk([L, E], default_initializer=nn.initializer.Constant(1.0))
         self.ln_bias = mk([L, E], is_bias=True)
-        self.qkv_weight = mk([L, 3, H, D, E])
-        self.qkv_bias = mk([L, 3, H, D], is_bias=True)
+        # packed q|k|v on the head dim: [L, H + 2*Hkv, D, E]
+        self.qkv_weight = mk([L, H + 2 * Hkv, D, E])
+        self.qkv_bias = mk([L, H + 2 * Hkv, D], is_bias=True)
         self.linear_weight = mk([L, E, E])
         self.linear_bias = mk([L, E], is_bias=True)
         self.ffn_ln_scale = mk([L, E], default_initializer=nn.initializer.Constant(1.0))
@@ -190,19 +196,25 @@ class FusedMultiTransformer(nn.Layer):
         ts = int(time_step) if time_step is not None else None
         act = self.activation
         eps = self.epsilon
-        H, D = self.num_heads, self.head_dim
+        H, D, Hkv = self.num_heads, self.head_dim, self.kv_num_heads
 
         def stack_fn(src_v, mask_v, cache_v, **p):
-            return _fmt_forward(src_v, mask_v, cache_v, p, H, D, act, eps, ts)
+            return _fmt_forward(src_v, mask_v, cache_v, p, H, D, act, eps, ts,
+                                Hkv)
 
         out = op_apply(stack_fn, (src, attn_mask, cache_vals), vals,
                        name="fused_multi_transformer")
         return out
 
 
-def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step):
-    """Layer-scan body for the fused stack. cache: [L, 2, B, S_max, H, D]."""
+def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None):
+    """Layer-scan body for the fused stack. cache: [L, 2, B, S_max, Hkv, D].
+
+    ``time_step`` is the cache write offset: prefill = Sq tokens written at
+    0, decode = 1 token written at t; attention reads cache[:, :t+Sq].
+    """
     E = x.shape[-1]
+    Hkv = H if Hkv is None else Hkv
 
     def ln(v, scale, bias):
         vf = v.astype(jnp.float32)
@@ -216,16 +228,22 @@ def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step):
          layer_cache) = per_layer
         residual = h
         hn = ln(h, ls, lb)
-        qkv = jnp.einsum("bse,tnde->bstnd", hn, qkvw) + qkvb
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qkv = jnp.einsum("bse,nde->bsnd", hn, qkvw) + qkvb
+        q = qkv[:, :, :H]
+        k = qkv[:, :, H:H + Hkv]
+        v = qkv[:, :, H + Hkv:]
+        Sq = q.shape[1]
         new_cache = None
         if layer_cache is not None:
             ck, cv = layer_cache[0], layer_cache[1]
             if time_step is not None:
                 ck = jax.lax.dynamic_update_slice_in_dim(ck, k, time_step, 1)
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, v, time_step, 1)
-                k, v = ck[:, :time_step + 1], cv[:, :time_step + 1]
+                k, v = ck[:, :time_step + Sq], cv[:, :time_step + Sq]
             new_cache = jnp.stack([ck, cv])
+        if Hkv != H:  # GQA: each kv head serves H//Hkv query heads
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         scale = 1.0 / math.sqrt(D)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32) * scale

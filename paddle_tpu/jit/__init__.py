@@ -234,11 +234,29 @@ class TrainStep:
 
         opt = optimizer
 
+        # Debug NaN/Inf guard (reference FLAGS_check_nan_inf /
+        # ``paddle/fluid/framework/details/nan_inf_utils_detail`` †): when
+        # the flag is on at construction, the compiled step also returns a
+        # non-finite count over loss+grads and step() raises host-side.
+        from ..utils.flags import get_flag
+        self._check_nan = bool(get_flag("FLAGS_check_nan_inf", False))
+        check_nan = self._check_nan
+
+        def _bad_count(loss, grads):
+            if not check_nan:
+                return jnp.zeros((), jnp.int32)
+            bad = jnp.sum(~jnp.isfinite(loss)).astype(jnp.int32)
+            for g in jax.tree.leaves(grads):
+                if jnp.issubdtype(jnp.result_type(g), jnp.inexact):
+                    bad = bad + jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+            return bad
+
         def step_fn(p, b, opt_state, inputs, labels, lr, key):
             (loss, new_b), grads = jax.value_and_grad(loss_f, has_aux=True)(
                 p, b, inputs, labels, key)
+            bad = _bad_count(loss, grads)
             new_p, new_opt = opt.apply_gradients(p, grads, opt_state, lr)
-            return loss, new_p, new_b, new_opt
+            return loss, new_p, new_b, new_opt, bad
 
         donate_argnums = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(step_fn, donate_argnums=donate_argnums)
@@ -268,8 +286,9 @@ class TrainStep:
                         jnp.zeros((), jnp.int32)),
                 (inputs_m, labels_m))
             grads = jax.tree.map(lambda g: g / accum, g_sum)
+            bad = _bad_count(loss_sum, grads)
             new_p, new_opt = opt.apply_gradients(p, grads, opt_state, lr)
-            return loss_sum / accum, new_p, new_b, new_opt
+            return loss_sum / accum, new_p, new_b, new_opt, bad
 
         self._accum_compiled = jax.jit(
             accum_step_fn, donate_argnums=donate_argnums,
@@ -303,13 +322,21 @@ class TrainStep:
         key = jax.random.fold_in(self._base_key, self._step_count)
         inputs, labels = _norm_batch(inputs), _norm_labels(labels)
         inputs, labels = self._place_batch(inputs), self._place_batch(labels)
-        loss, self._params, self._buffers, self._opt_state = self._compiled(
-            self._params, self._buffers, self._opt_state, inputs, labels,
-            lr, key)
+        loss, self._params, self._buffers, self._opt_state, bad = \
+            self._compiled(self._params, self._buffers, self._opt_state,
+                           inputs, labels, lr, key)
         self._step_count += 1
         self.optimizer._step_count = self._step_count
         self.sync_to_model()
+        self._raise_on_nan(bad, loss)
         return Tensor(loss)
+
+    def _raise_on_nan(self, bad, loss):
+        if self._check_nan and int(bad) > 0:
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: {int(bad)} non-finite value(s) in "
+                f"loss/gradients at step {self._step_count} "
+                f"(loss={float(loss)})")
 
     def accum_step(self, inputs, labels, accum: int):
         """Gradient-accumulating step: `accum` microbatches, one update."""
@@ -317,13 +344,14 @@ class TrainStep:
         key = jax.random.fold_in(self._base_key, self._step_count)
         inputs, labels = _norm_batch(inputs), _norm_labels(labels)
         inputs, labels = self._place_batch(inputs), self._place_batch(labels)
-        loss, self._params, self._buffers, self._opt_state = \
+        loss, self._params, self._buffers, self._opt_state, bad = \
             self._accum_compiled(
                 self._params, self._buffers, self._opt_state, inputs, labels,
                 lr, key, int(accum))
         self._step_count += 1
         self.optimizer._step_count = self._step_count
         self.sync_to_model()
+        self._raise_on_nan(bad, loss)
         return Tensor(loss)
 
     def eval_step(self, inputs, labels):

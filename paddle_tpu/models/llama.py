@@ -62,6 +62,15 @@ class LlamaConfig:
     # pp degree 1 — nesting the sep shard_map inside the pipeline's manual
     # 'pp' region is unsupported.
     context_parallel: str = ""
+    # "bshd" ([B,S,H,D], paddle layout) | "bhsd" (head-major: the qkv
+    # projections emit [B,H,S,D] directly and the o-projection consumes it,
+    # so the flash kernel's head-fold needs no HBM transpose pass).
+    attention_layout: str = "bshd"
+    # >0: compute the shifted-CE loss in sequence chunks of this size under
+    # jax.checkpoint, so only one [B, chunk, V] f32 logits block is ever
+    # live (the reference's c_softmax_with_cross_entropy memory trick,
+    # TPU-style). 0 = single fused [B,S,V] logsumexp.
+    loss_chunk: int = 0
     dtype: str = "float32"
 
     @property
@@ -227,7 +236,9 @@ class LlamaForCausalLM(nn.Layer):
             bool(c.use_recompute), self.lm_head is None,
             policy=c.recompute_policy,
             pipeline_microbatches=int(c.pipeline_microbatches),
-            context_parallel=str(c.context_parallel), **params)
+            context_parallel=str(c.context_parallel),
+            attention_layout=str(c.attention_layout),
+            loss_chunk=int(c.loss_chunk), **params)
         return out
 
     def num_params(self):
@@ -238,6 +249,7 @@ class LlamaForCausalLM(nn.Layer):
 @tensor_op
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                    policy="full", pipeline_microbatches=0, context_parallel="",
+                   attention_layout="bshd", loss_chunk=0,
                    *, embed, wq, wk, wv, wo, w_gate, w_up, w_down, input_ln,
                    post_ln, final_norm, lm_head):
     B, S = input_ids.shape
@@ -252,38 +264,64 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                "sep" in mesh.axis_names else 1)
     use_cp = bool(context_parallel) and sep_deg > 1
 
+    head_major = attention_layout == "bhsd"
+
     def layer_body(h, lp):
         (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
         Bh, Sh = h.shape[0], h.shape[1]  # microbatch-sized under pipeline
         resid = h
         hn = _rms(h, lin, eps)
         hn = _ann(hn, batch_spec, "sep", None)
-        q = jnp.einsum("bsh,hd->bsd", hn, lwq).reshape(Bh, Sh, nh, hd)
-        k = jnp.einsum("bsh,hd->bsd", hn, lwk).reshape(Bh, Sh, nkv, hd)
-        v = jnp.einsum("bsh,hd->bsd", hn, lwv).reshape(Bh, Sh, nkv, hd)
-        q = _apply_rope(q, sin, cos)
-        k = _apply_rope(k, sin, cos)
-        q = _ann(q, batch_spec, None, "mp", None)
-        k = _ann(k, batch_spec, None, "mp", None)
+        H_ = hn.shape[-1]
+        if head_major:
+            # head-major: projections emit [B, H, S, D] directly, so the
+            # flash kernel's head fold is a free reshape — no HBM transpose
+            q = jnp.einsum("bsh,hnd->bnsd", hn, lwq.reshape(H_, nh, hd))
+            k = jnp.einsum("bsh,hnd->bnsd", hn, lwk.reshape(H_, nkv, hd))
+            v = jnp.einsum("bsh,hnd->bnsd", hn, lwv.reshape(H_, nkv, hd))
+            q = _apply_rope_bhsd(q, sin, cos)
+            k = _apply_rope_bhsd(k, sin, cos)
+            q = _ann(q, batch_spec, "mp", None, None)
+            k = _ann(k, batch_spec, "mp", None, None)
+        else:
+            q = jnp.einsum("bsh,hd->bsd", hn, lwq).reshape(Bh, Sh, nh, hd)
+            k = jnp.einsum("bsh,hd->bsd", hn, lwk).reshape(Bh, Sh, nkv, hd)
+            v = jnp.einsum("bsh,hd->bsd", hn, lwv).reshape(Bh, Sh, nkv, hd)
+            q = _apply_rope(q, sin, cos)
+            k = _apply_rope(k, sin, cos)
+            q = _ann(q, batch_spec, None, "mp", None)
+            k = _ann(k, batch_spec, None, "mp", None)
         if use_cp:
             # context parallelism: seq stays sep-sharded through attention
             from ..parallel.sp_attention import (ring_attention,
                                                  ulysses_attention)
+            rep_ax = 1 if head_major else 2
             kr, vr = k, v
             if nkv != nh:  # GQA: the cp kernels take equal head counts
-                kr = jnp.repeat(k, nh // nkv, axis=2)
-                vr = jnp.repeat(v, nh // nkv, axis=2)
+                kr = jnp.repeat(k, nh // nkv, axis=rep_ax)
+                vr = jnp.repeat(v, nh // nkv, axis=rep_ax)
             cp_fn = (ring_attention if context_parallel == "ring"
                      else ulysses_attention)
-            attn = jnp.swapaxes(
-                cp_fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(kr, 1, 2),
-                      jnp.swapaxes(vr, 1, 2), causal=True, mesh=mesh),
-                1, 2)
+            if head_major:
+                attn = cp_fn(q, kr, vr, causal=True, mesh=mesh)
+            else:
+                attn = jnp.swapaxes(
+                    cp_fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(kr, 1, 2),
+                          jnp.swapaxes(vr, 1, 2), causal=True, mesh=mesh),
+                    1, 2)
+        elif head_major:
+            attn = _attention_bhsd(q, k, v, nh)
         else:
             attn = _attention(q, k, v, causal=True)
-        attn = attn.reshape(Bh, Sh, nh * hd)
-        h = resid + _ann(jnp.einsum("bsd,dh->bsh", attn, lwo),
-                         batch_spec, "sep", None)
+        if head_major:
+            # o-projection consumes [B, H, S, D]: transpose folds into matmul
+            h = resid + _ann(
+                jnp.einsum("bnsd,ndh->bsh", attn, lwo.reshape(nh, hd, H_)),
+                batch_spec, "sep", None)
+        else:
+            attn = attn.reshape(Bh, Sh, nh * hd)
+            h = resid + _ann(jnp.einsum("bsd,dh->bsh", attn, lwo),
+                             batch_spec, "sep", None)
         resid = h
         hn = _rms(h, lpost, eps)
         hn = _ann(hn, batch_spec, "sep", None)
@@ -326,8 +364,40 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
         logits = jnp.einsum("bsh,hv->bsv", x, head)
         return _ann(logits, batch_spec, None, "mp")
 
-    # training: shifted CE via logsumexp (loss = lse - picked_logit); the
-    # f32 materialization is only the [B,S] lse + picked terms
+    # training: shifted CE via logsumexp (loss = lse - picked_logit)
+    if loss_chunk > 0 and S % loss_chunk != 0:
+        import warnings
+        warnings.warn(
+            f"loss_chunk={loss_chunk} does not divide seq_len={S}; falling "
+            f"back to the unfused CE (full [B,S,V] f32 logits materialize)")
+    if loss_chunk > 0 and S % loss_chunk == 0:
+        # chunked lm-head+CE: only one [B, chunk, V] f32 logits block is
+        # ever live; jax.checkpoint recomputes it per-chunk in the backward
+        # instead of saving S/chunk of them (the reference's fused
+        # c_softmax_with_cross_entropy memory behavior, scan-style)
+        nch = S // loss_chunk
+        tgt = jnp.concatenate(
+            [labels[:, 1:], jnp.full((B, 1), -1, labels.dtype)], axis=1)
+        xs = jnp.swapaxes(x.reshape(B, nch, loss_chunk, H), 0, 1)
+        tc = jnp.swapaxes(tgt.reshape(B, nch, loss_chunk), 0, 1)
+
+        def ce_chunk(carry, xt):
+            xc, t = xt
+            lg = jnp.einsum("bch,hv->bcv", xc, head,
+                            preferred_element_type=jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(
+                lg, jnp.maximum(t, 0)[..., None], axis=-1)[..., 0]
+            m = (t >= 0).astype(jnp.float32)
+            s, n = carry
+            return (s + jnp.sum((lse - picked) * m), n + jnp.sum(m)), None
+
+        (tot, cnt), _ = jax.lax.scan(jax.checkpoint(ce_chunk),
+                                     (jnp.float32(0.0), jnp.float32(0.0)),
+                                     (xs, tc))
+        return tot / jnp.maximum(cnt, 1.0)
+
+    # unfused path: the f32 [B,S,V] logits materialize once
     logits = jnp.einsum("bsh,hv->bsv", x[:, :-1], head)
     logits = _ann(logits, batch_spec, None, "mp")
     lf = logits.astype(jnp.float32)

@@ -16,7 +16,8 @@ from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
                   is_initialized)
 from .mesh import Group, build_mesh, ensure_mesh, get_mesh, new_group, set_mesh
 from .communication import (ReduceOp, all_gather, all_reduce, alltoall,
-                            barrier, broadcast, recv, reduce, reduce_scatter,
+                            barrier, batch_isend_irecv, broadcast, irecv,
+                            isend, P2POp, recv, reduce, reduce_scatter,
                             scatter, send)
 from ..nn.parallel import DataParallel
 

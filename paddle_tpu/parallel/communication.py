@@ -83,8 +83,14 @@ def _is_sharded_over(value, group):
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """Reduce a *sharded* tensor across the group axis; each shard is one
-    rank's contribution (leading-dim concat layout). Replicated input with
-    group world: identity-sum semantics (already equal on all ranks)."""
+    rank's contribution (leading-dim concat layout) and the result is the
+    reduced value with the rank dim collapsed.
+
+    WARNING — replicated input: a replicated eager tensor models N identical
+    per-rank copies, so ``all_reduce(sum)`` returns ``v * nranks`` (exactly
+    what N reference processes each holding ``v`` would get); ``avg`` and
+    ``max``/``min`` return ``v``. Pinned by
+    ``tests/test_sequence_parallel.py::TestEagerCollectiveSemantics``."""
     g = _group(group)
     v = tensor.value
     if g.nranks == 1:
@@ -212,16 +218,148 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM,
     return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
 
 
+# --------------------------------------------------------------------- p2p
+# Reference: send_v2/recv_v2 CUDA ops + ``batch_isend_irecv``
+# (``paddle/fluid/operators/collective/send_v2_op.cu`` †,
+# ``python/paddle/distributed/communication/batch_isend_irecv.py`` †).
+#
+# TPU-native single-controller semantics: a tensor is a *global* array whose
+# leading dim is sharded over the group axis (one shard = one rank's
+# buffer). A matched send(dst=d)/recv(src=s) pair describes the edge s→d;
+# the transfer executes as ``lax.ppermute`` inside shard_map — identity on
+# every other rank, so only dst's shard changes. A send enqueues until its
+# recv arrives (the two calls that separate processes would make
+# concurrently arrive sequentially under one controller).
+_P2P_PENDING: dict = {}
+
+
+def _p2p_key(g: Group):
+    return (id(g.mesh), g.axis_names)
+
+
+@functools.lru_cache(maxsize=256)
+def _p2p_prog(mesh, axes, edges, n):
+    def f(sendv, recvv):
+        # only dst shards are read from the permuted array (the `where`
+        # keeps everyone else's recv buffer), so perm needs only the edges
+        moved = jax.lax.ppermute(sendv, axes, edges)
+        idx = jax.lax.axis_index(axes)
+        is_dst = functools.reduce(
+            jnp.logical_or,
+            [idx == d for _, d in edges],
+            jnp.zeros((), bool))
+        return jnp.where(is_dst, moved, recvv)
+
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(P(axes), P(axes)),
+                                 out_specs=P(axes)))
+
+
+def _p2p_execute(g: Group, send_val, recv_tensor: Tensor, edges):
+    """Run the ppermute for `edges` on committed, axis-sharded arrays."""
+    axes = _axes(g)
+    n = g.nranks
+    sharding = NamedSharding(g.mesh, P(axes))
+
+    def commit(v):
+        if _is_sharded_over(v, g):
+            return v
+        return jax.device_put(v, sharding)
+
+    prog = _p2p_prog(g.mesh, axes, tuple(edges), n)
+    out = prog(commit(send_val), commit(recv_tensor.value))
+    recv_tensor._rebind(out)
+    return _Task(out)
+
+
+def isend(tensor: Tensor, dst=0, group=None):
+    """Queue a send; completes when the matching recv/irecv runs."""
+    g = _group(group)
+    _P2P_PENDING.setdefault(_p2p_key(g), []).append((tensor.value, dst))
+    return _Task(tensor.value)
+
+
+def irecv(tensor: Tensor, src=0, group=None):
+    g = _group(group)
+    q = _P2P_PENDING.get(_p2p_key(g), [])
+    if not q:
+        raise RuntimeError("recv without a matching pending send "
+                           "(single-controller p2p pairs send/recv in "
+                           "program order)")
+    send_val, dst = q.pop(0)
+    return _p2p_execute(g, send_val, tensor, [(src, dst)])
+
+
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point eager send/recv across processes is expressed via "
-        "ppermute inside jitted pipeline schedules on TPU (parallel.pp); "
-        "host-side p2p uses the launch coordinator store")
+    return isend(tensor, dst=dst, group=group)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "see send(): use pipeline schedules / coordinator store on TPU")
+    t = irecv(tensor, src=src, group=group)
+    if sync_op:
+        t.wait()
+    return t
+
+
+class P2POp:
+    """Reference ``paddle.distributed.P2POp`` — an entry of
+    batch_isend_irecv. ``op`` is :func:`isend` or :func:`irecv`.
+
+    Single-controller extension: ``rank`` is the issuing rank (in a
+    multi-process reference program it is implicit — each process only
+    appends its own ops; under one controller the whole exchange is one
+    list, so the issuer must be stated)."""
+
+    def __init__(self, op, tensor, peer, group=None, rank=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.rank = rank
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of p2p edges as ppermutes (the ring-exchange
+    primitive of SURVEY §5.7: every rank's isend(next)+irecv(prev) pair
+    becomes one full ring permutation, compiled to one ICI collective).
+
+    A send op issued by rank r to peer d is the edge (r → d); a recv op
+    issued by rank r from peer s is the edge (s → r). Each recv is matched
+    to the send with the same edge; edges sharing the same (payload,
+    destination) buffers are fused into a single ppermute program."""
+    if not p2p_op_list:
+        return []
+    for op in p2p_op_list:
+        if op.rank is None:
+            raise ValueError(
+                "P2POp.rank (issuing rank) is required under the "
+                "single-controller runtime; e.g. "
+                "P2POp(isend, t, peer=(r+1)%n, rank=r)")
+    sends = {}
+    for op in p2p_op_list:
+        if op.op in (isend, send):
+            sends[(op.rank, op.peer)] = op
+    groups = {}  # (send_tensor_id, recv_tensor_id, group) -> (s, r, edges)
+    for op in p2p_op_list:
+        if op.op not in (irecv, recv):
+            continue
+        edge = (op.peer, op.rank)
+        s = sends.pop(edge, None)
+        if s is None:
+            raise ValueError(f"irecv edge {edge} has no matching isend")
+        if (s.group is not None and op.group is not None
+                and s.group is not op.group):
+            raise ValueError(f"isend/irecv groups differ for edge {edge}")
+        grp = s.group if s.group is not None else op.group
+        k = (id(s.tensor), id(op.tensor), id(grp))
+        groups.setdefault(k, (s.tensor, op.tensor, grp, []))[3].append(edge)
+    if sends:
+        raise ValueError(f"unmatched isend edges: {list(sends)}")
+    tasks = []
+    for send_t, recv_t, grp, edges in groups.values():
+        tasks.append(_p2p_execute(_group(grp), send_t.value, recv_t, edges))
+    return tasks
 
 
 def barrier(group: Optional[Group] = None):

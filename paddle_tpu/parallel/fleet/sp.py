@@ -11,11 +11,45 @@ parallelism over the 'sep' axis) live in paddle_tpu.parallel.sp_attention.
 """
 from __future__ import annotations
 
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
 from ... import nn
 from ...nn import functional as F
+from ...ops._op import tensor_op
+from .. import mesh as mesh_mod
 from .mp import MP_AXIS, mark_sharding, shard_annotate
 
 SEQ_DIM = 1  # [batch, seq, hidden]
+
+
+@functools.lru_cache(maxsize=64)
+def _row_rs_prog(mesh):
+    def f(xl, wl):
+        out = jnp.einsum("bsh,hd->bsd", xl, wl)
+        return jax.lax.psum_scatter(out, MP_AXIS, scatter_dimension=SEQ_DIM,
+                                    tiled=True)
+
+    sm = jax.shard_map(f, mesh=mesh,
+                       in_specs=(P(None, None, MP_AXIS), P(MP_AXIS, None)),
+                       out_specs=P(None, MP_AXIS, None),
+                       check_vma=False, axis_names={MP_AXIS})
+    # partial-manual shard_map needs a jit scope even when called eagerly;
+    # cached per mesh so eager steps hit the jit cache, not a recompile
+    return jax.jit(sm)
+
+
+@tensor_op
+def _row_matmul_reduce_scatter(x, w):
+    """Row-parallel matmul with an EXPLICIT reduce-scatter epilogue
+    (``lax.psum_scatter`` in a partial-manual shard_map over 'mp' only —
+    other axes stay under GSPMD). This pins the Megatron-SP fusion the
+    reference hand-writes; plain annotations let the partitioner pick
+    all-reduce+slice on some backends."""
+    return _row_rs_prog(mesh_mod.get_mesh())(x, w)
 
 
 def scatter(x, axis=SEQ_DIM):
@@ -80,11 +114,17 @@ class RowSequenceParallelLinear(nn.Layer):
             if has_bias else None
 
     def forward(self, x):
-        out = F.linear(x, self.weight, None)
-        # reduce-scatter: partial sums combined AND seq dim sharded
-        spec = [None] * len(out.shape)
-        spec[SEQ_DIM] = MP_AXIS
-        out = shard_annotate(out, *spec)
+        mesh = mesh_mod.get_mesh()
+        deg = int(mesh.shape.get(MP_AXIS, 1)) if mesh is not None else 1
+        if (deg > 1 and len(x.shape) == 3 and x.shape[SEQ_DIM] % deg == 0
+                and self.weight.shape[0] % deg == 0):
+            out = _row_matmul_reduce_scatter(x, self.weight)
+        else:
+            out = F.linear(x, self.weight, None)
+            # reduce-scatter: partial sums combined AND seq dim sharded
+            spec = [None] * len(out.shape)
+            spec[SEQ_DIM] = MP_AXIS
+            out = shard_annotate(out, *spec)
         if self.bias is not None:
             out = out + self.bias
         return out

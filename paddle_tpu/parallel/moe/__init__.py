@@ -23,9 +23,35 @@ from ...core import random as random_mod
 from ...core.tensor import Tensor
 from ...nn import functional as F
 from ...ops._op import tensor_op
-from ..fleet.mp import shard_annotate
+from .. import mesh as mesh_mod
+from ..fleet.mp import mark_sharding, shard_annotate
 
 EXPERT_AXIS = "mp"  # default mesh axis carrying experts (ep maps onto mp/sep)
+
+
+def _raw_ann(x, *spec):
+    """with_sharding_constraint on a raw array, axes filtered to the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+    clean = tuple(s if (s is None or s in names) else None for s in spec)
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*clean)))
+    except (ValueError, TypeError):
+        return x
+
+
+def _group_degree(S):
+    """EP degree = size of the expert mesh axis (1 off-mesh). Tokens are
+    processed in G groups of S/G so the dispatch is the GShard [G,S/G] →
+    [E,...] axis swap that GSPMD lowers to an all-to-all."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or EXPERT_AXIS not in mesh.axis_names:
+        return 1
+    g = int(mesh.shape[EXPERT_AXIS])
+    return g if g > 1 and S % g == 0 else 1
 
 
 # ----------------------------------------------------------------- gates
@@ -80,8 +106,7 @@ class SwitchGate(nn.Layer):
 
 
 # ----------------------------------------------------------------- routing
-@tensor_op
-def _gshard_dispatch(logits, key, capacity, num_expert, random_routing, second_place):
+def _gshard_route(logits, key, capacity, num_expert, random_routing):
     """GShard top-2 routing: returns combine weights [S, E, C], dispatch mask
     [S, E, C] (bool) and aux loss. Pure-jnp, static shapes."""
     S, E = logits.shape
@@ -129,7 +154,12 @@ def _gshard_dispatch(logits, key, capacity, num_expert, random_routing, second_p
 
 
 @tensor_op
-def _switch_dispatch(logits, capacity):
+def _gshard_dispatch(logits, key, capacity, num_expert, random_routing,
+                     second_place):
+    return _gshard_route(logits, key, capacity, num_expert, random_routing)
+
+
+def _switch_route(logits, capacity):
     S, E = logits.shape
     C = capacity
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -147,23 +177,105 @@ def _switch_dispatch(logits, capacity):
     return combine, combine > 0, aux
 
 
+@tensor_op
+def _switch_dispatch(logits, capacity):
+    return _switch_route(logits, capacity)
+
+
+# ------------------------------------------------------- stacked expert path
+@tensor_op
+def _moe_forward_stacked(xf, logits2d, w1, b1, w2, b2, key, G, C, E, kind,
+                         random_routing):
+    """Full GShard MoE over stacked expert weights (reference ``MoELayer``
+    forward = gate + global_scatter + experts + global_gather,
+    ``python/paddle/incubate/distributed/models/moe/moe_layer.py`` †).
+
+    Tokens [S, d] are viewed as [G, S/G, d] with G = EP degree sharded over
+    the expert mesh axis; the dispatch einsum produces [G, E, C, d] sharded
+    on G, and the annotation flip to sharded-on-E is exactly the
+    global_scatter all-to-all (GSPMD emits it). Expert FFNs run as one
+    batched einsum over weights [E, d, h] sharded on E — each device holds
+    and computes only its E/G experts."""
+    S, d = xf.shape
+    Sg = S // G
+    xg = _raw_ann(xf.reshape(G, Sg, d), EXPERT_AXIS, None, None)
+    logits = logits2d.reshape(G, Sg, E).astype(jnp.float32)
+    if kind == "switch":
+        combine, dispatch, aux = jax.vmap(
+            lambda l: _switch_route(l, C))(logits)
+    else:
+        keys = jax.random.split(key, G)
+        combine, dispatch, aux = jax.vmap(
+            lambda l, k: _gshard_route(l, k, C, E, random_routing)
+        )(logits, keys)
+    aux = jnp.mean(aux)
+    disp = dispatch.astype(xf.dtype)
+    expert_in = jnp.einsum("gsec,gsd->gecd", disp, xg)
+    # global_scatter: g-sharded -> e-sharded (all-to-all over EP axis)
+    expert_in = _raw_ann(expert_in, None, EXPERT_AXIS, None, None)
+    h = jax.nn.gelu(
+        jnp.einsum("gecd,edh->gech", expert_in, w1) + b1[None, :, None, :])
+    eo = jnp.einsum("gech,ehd->gecd", h, w2) + b2[None, :, None, :]
+    # global_gather: e-sharded -> g-sharded (all-to-all back)
+    eo = _raw_ann(eo, EXPERT_AXIS, None, None, None)
+    out = jnp.einsum("gsec,gecd->gsd", combine.astype(xf.dtype), eo)
+    return out.reshape(S, d), aux
+
+
 class MoELayer(nn.Layer):
     """Reference ``MoELayer(d_model, experts, gate, ...)``:
     gate -> dispatch (all-to-all over expert axis) -> experts -> gather.
 
-    ``experts`` is a LayerList of per-(local-)expert FFNs. Expert weights are
-    annotated sharded over the expert mesh axis; the dispatch einsum's
-    sharding mismatch makes XLA emit the all-to-all (the reference's
-    global_scatter/global_gather CUDA ops)."""
+    TPU-native expert parallelism: when ``experts`` are standard FFNs
+    (``ExpertLayer``-shaped), their weights are absorbed at construction
+    into stacked parameters ``w1 [E, d, h]`` / ``w2 [E, h, d]`` sharded on
+    the expert axis — each device *holds* only E/ep experts, the expert
+    compute is one batched einsum (MXU-friendly), and the group→expert
+    dispatch reshard is GSPMD's all-to-all (the reference's CUDA
+    ``global_scatter``/``global_gather``). Heterogeneous or bias-less
+    expert Layers fall back to a replicated per-expert loop (no EP).
+
+    NOTE: absorption copies the expert weights ONCE at construction; the
+    stacked ``w1/b1/w2/b2`` are then THE trainable state (state_dict keys
+    too). Mutating the original expert Layers afterwards has no effect —
+    load checkpoints into the stacked params."""
 
     def __init__(self, d_model, experts: List[nn.Layer], gate=None,
                  moe_group=None, mp_group=None, recompute_interval=0,
                  capacity_factor=1.2, top_k=2, gate_type=None, **kwargs):
         super().__init__()
         self.d_model = d_model
-        self.experts = experts if isinstance(experts, nn.LayerList) \
-            else nn.LayerList(list(experts))
-        self.num_expert = len(self.experts)
+        ex_list = list(experts)
+        self.num_expert = len(ex_list)
+        self._stacked = bool(ex_list) and all(
+            isinstance(getattr(e, "htoh4", None), nn.Linear) and
+            isinstance(getattr(e, "h4toh", None), nn.Linear) and
+            getattr(e.htoh4, "bias", None) is not None and
+            getattr(e.h4toh, "bias", None) is not None
+            for e in ex_list) and len({
+                (tuple(e.htoh4.weight.shape), tuple(e.h4toh.weight.shape))
+                for e in ex_list}) == 1
+        if self._stacked:
+            import numpy as np
+            mk = self.create_parameter
+            asg = nn.initializer.Assign
+
+            def stacked(get):
+                arr = np.stack([np.asarray(get(e).value) for e in ex_list])
+                return mk(list(arr.shape), default_initializer=asg(arr),
+                          dtype=str(arr.dtype))
+
+            self.w1 = stacked(lambda e: e.htoh4.weight)
+            self.b1 = stacked(lambda e: e.htoh4.bias)
+            self.w2 = stacked(lambda e: e.h4toh.weight)
+            self.b2 = stacked(lambda e: e.h4toh.bias)
+            mark_sharding(self.w1, EXPERT_AXIS, None, None)
+            mark_sharding(self.b1, EXPERT_AXIS, None)
+            mark_sharding(self.w2, EXPERT_AXIS, None, None)
+            mark_sharding(self.b2, EXPERT_AXIS, None)
+        else:
+            self.experts = experts if isinstance(experts, nn.LayerList) \
+                else nn.LayerList(ex_list)
         self.capacity_factor = capacity_factor
         gate_conf = gate_type or gate
         if gate_conf is None or (isinstance(gate_conf, dict) and
@@ -176,8 +288,8 @@ class MoELayer(nn.Layer):
             self.gate = SwitchGate(d_model, self.num_expert, topk=1)
             self._gate_kind = "switch"
         elif isinstance(gate_conf, dict) and gate_conf.get("type") == "naive":
-            self.gate = NaiveGate(d_model, self.num_expert)
-            self._gate_kind = "gshard"  # routed the same way via logits
+            # naive top-k routes through the same gshard dispatch on logits
+            self._gate_kind = "gshard"
             self.gate = GShardGate(d_model, self.num_expert)
         elif isinstance(gate_conf, nn.Layer):
             self.gate = gate_conf
@@ -193,6 +305,22 @@ class MoELayer(nn.Layer):
         xf = reshape(x, [-1, d])
         S = xf.shape[0]
         E = self.num_expert
+        if self._stacked:
+            G = _group_degree(S)
+            C = max(int(self.capacity_factor * (S // G) / E), 4)
+            key = random_mod.next_key()
+            # the gate Layer's own forward computes logits (custom gates
+            # keep their logic; grads flow to gate params through the op).
+            # NaiveGate's forward returns (idx, prob, None), so its raw
+            # logits Linear is used instead.
+            logits = (self.gate.gate(xf) if isinstance(self.gate, NaiveGate)
+                      else self.gate(xf))
+            out, aux = _moe_forward_stacked(
+                xf, logits, self.w1, self.b1, self.w2, self.b2, key, G, C, E,
+                self._gate_kind,
+                getattr(self.gate, "random_routing", True))
+            self.aux_loss = aux
+            return reshape(out, orig_shape)
         C = max(int(self.capacity_factor * S / E), 4)
         logits = self.gate.gate(xf) if hasattr(self.gate, "gate") else self.gate(xf)
         if self._gate_kind == "switch":
@@ -203,7 +331,7 @@ class MoELayer(nn.Layer):
                 logits, key, C, E, getattr(self.gate, "random_routing", True),
                 None)
         self.aux_loss = aux
-        # dispatch: [E, C, d] expert inputs (all-to-all happens here on mesh)
+        # dispatch: [E, C, d] expert inputs (replicated fallback — no EP)
         from ...ops import einsum, cast
         disp = cast(dispatch, xf.dtype)
         expert_in = einsum("sec,sd->ecd", disp, xf)

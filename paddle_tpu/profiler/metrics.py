@@ -88,3 +88,63 @@ class MFUMeter:
             "peak_flops_per_chip": self.peak,
             "n_chips": self.n_chips,
         }
+
+
+class DecodeMeter:
+    """Decode-throughput meter (SURVEY §3.5 / L7): tokens/sec and ms/token
+    for autoregressive generation, per-phase (prefill vs decode).
+
+    Decode FLOPs/token ≈ 2·N (forward only), so ``mbu`` reports the
+    memory-bandwidth-bound utilization proxy instead of MFU: decode is
+    weight-streaming-bound, tokens/s · bytes_per_param / HBM_BW.
+    """
+
+    def __init__(self, n_params=None, n_chips=None, bytes_per_param=2.0,
+                 hbm_bw_per_chip=8.1e11):
+        self.n_params = n_params
+        self.n_chips = n_chips or jax.device_count()
+        self.bytes_per_param = bytes_per_param
+        self.hbm_bw = hbm_bw_per_chip
+        self.reset()
+
+    def reset(self):
+        self._prefill_tokens = 0
+        self._prefill_time = 0.0
+        self._decode_tokens = 0
+        self._decode_time = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def end_prefill(self, tokens):
+        self._prefill_time += time.perf_counter() - self._t0
+        self._prefill_tokens += tokens
+
+    def end_decode(self, tokens=1):
+        self._decode_time += time.perf_counter() - self._t0
+        self._decode_tokens += tokens
+
+    @property
+    def decode_tokens_per_sec(self):
+        return (self._decode_tokens / self._decode_time
+                if self._decode_time else 0.0)
+
+    @property
+    def prefill_tokens_per_sec(self):
+        return (self._prefill_tokens / self._prefill_time
+                if self._prefill_time else 0.0)
+
+    def report(self):
+        out = {
+            "prefill_tokens_per_sec": self.prefill_tokens_per_sec,
+            "decode_tokens_per_sec": self.decode_tokens_per_sec,
+            "decode_ms_per_token": (1000.0 / self.decode_tokens_per_sec
+                                    if self.decode_tokens_per_sec else 0.0),
+            "n_chips": self.n_chips,
+        }
+        if self.n_params:
+            bw = (self.decode_tokens_per_sec * self.n_params *
+                  self.bytes_per_param)
+            out["decode_mbu"] = bw / (self.n_chips * self.hbm_bw)
+        return out

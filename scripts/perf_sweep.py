@@ -1,0 +1,104 @@
+"""Round-3 perf sweep: time the bench train step under config variants.
+
+Each variant runs in a child process (isolated compile cache / OOM blast
+radius). Prints one JSON line per variant.
+
+Usage:
+    python scripts/perf_sweep.py            # run all variants
+    python scripts/perf_sweep.py --child '{"attention_layout": "bhsd"}'
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+VARIANTS = [
+    ("base", {}),
+    ("dots", {"recompute_policy": "dots"}),
+    ("bhsd", {"attention_layout": "bhsd"}),
+    ("chunk512", {"loss_chunk": 512}),
+    ("bhsd+chunk", {"attention_layout": "bhsd", "loss_chunk": 512}),
+    ("bhsd+chunk+dots", {"attention_layout": "bhsd", "loss_chunk": 512,
+                         "recompute_policy": "dots"}),
+    ("bhsd+chunk+norematt", {"attention_layout": "bhsd", "loss_chunk": 512,
+                             "use_recompute": False}),
+]
+
+
+def child(overrides):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.profiler.metrics import peak_flops_per_chip
+
+    paddle.seed(0)
+    kw = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+              num_hidden_layers=24, num_attention_heads=16,
+              num_key_value_heads=16, max_position_embeddings=2048,
+              use_recompute=True, dtype="bfloat16")
+    kw.update(overrides)
+    cfg = LlamaConfig(**kw)
+    model = LlamaForCausalLM(cfg)
+    n_params = model.num_params()
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    step = TrainStep(model, lambda loss, _lab: loss, opt)
+
+    B, S = 8, 2048
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(step.step((ids, ids), (ids,)).value)
+    compile_s = time.perf_counter() - t0
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step.step((ids, ids), (ids,))
+    final_loss = float(loss.value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = iters * B * S / dt
+    mfu = tokens_per_sec * 6.0 * n_params / peak_flops_per_chip()
+    print(json.dumps({"mfu": round(float(mfu), 4),
+                      "tok_s": round(tokens_per_sec),
+                      "step_ms": round(dt / iters * 1000, 1),
+                      "warm_s": round(compile_s, 1),
+                      "loss": round(final_loss, 3)}))
+    return 0
+
+
+def main():
+    for name, overrides in VARIANTS:
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 json.dumps(overrides)],
+                timeout=600, capture_output=True, text=True, cwd=REPO)
+            line = next((ln for ln in reversed(p.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if p.returncode == 0 and line:
+                print(f"{name:24s} {line}", flush=True)
+            else:
+                print(f"{name:24s} FAILED rc={p.returncode} "
+                      f"{p.stderr.strip()[-300:]}", flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{name:24s} TIMEOUT", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child(json.loads(sys.argv[sys.argv.index("--child") + 1])))
+    sys.exit(main())

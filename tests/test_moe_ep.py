@@ -1,0 +1,173 @@
+"""Expert-parallel MoE tests (VERDICT r2 item 2).
+
+The r2 MoE ran experts as a replicated Python loop. These test the real EP
+path: stacked expert weights sharded over the expert mesh axis, GShard
+group-wise dispatch, and the all-to-all the reference implements as CUDA
+``global_scatter``/``global_gather``
+(``python/paddle/incubate/distributed/models/moe/moe_layer.py`` †):
+- parity vs a dense FFN oracle when all experts are identical and capacity
+  is effectively infinite (top-2 weights renormalize to 1)
+- expert residency: each device holds E/ep experts (addressable_shards)
+- compile: all-to-all present in the HLO on an ep>1 mesh
+- on-mesh parity vs the meshless path
+"""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.moe import ExpertLayer, GShardGate, MoELayer
+
+
+def _reset_fleet(**degrees):
+    mesh_mod._STATE["mesh"] = None
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = degrees
+    fleet.init(is_collective=True, strategy=s)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _no_mesh():
+    mesh_mod._STATE["mesh"] = None
+
+
+def _identical_experts(d, dh, E, seed=0):
+    paddle.seed(seed)
+    experts = [ExpertLayer(d, dh) for _ in range(E)]
+    for e in experts[1:]:
+        e.htoh4.weight.set_value(experts[0].htoh4.weight.numpy())
+        e.htoh4.bias.set_value(experts[0].htoh4.bias.numpy())
+        e.h4toh.weight.set_value(experts[0].h4toh.weight.numpy())
+        e.h4toh.bias.set_value(experts[0].h4toh.bias.numpy())
+    return experts
+
+
+class _MoEModel(nn.Layer):
+    def __init__(self, d, dh, E, capacity_factor=2.0):
+        super().__init__()
+        self.moe = MoELayer(
+            d, [ExpertLayer(d, dh) for _ in range(E)],
+            gate={"type": "gshard", "top_k": 2},
+            capacity_factor=capacity_factor)
+
+    def forward(self, x):
+        return self.moe(x)
+
+
+class TestExpertParallel:
+    def test_stacked_weights_absorbed(self):
+        _no_mesh()
+        experts = _identical_experts(8, 16, 4)
+        moe = MoELayer(8, experts, gate={"type": "gshard", "top_k": 2})
+        assert moe._stacked
+        assert list(moe.w1.shape) == [4, 8, 16]
+        assert list(moe.w2.shape) == [4, 16, 8]
+        # absorbed params are THE trainable state; no duplicated experts
+        names = [n for n, _ in moe.named_parameters()]
+        assert any("w1" in n for n in names)
+        assert not any("htoh4" in n for n in names)
+
+    def test_parity_vs_dense_ffn_oracle(self):
+        """All experts identical + capacity -> inf: top-2 combine weights
+        renormalize to 1, so MoE(x) == FFN(x) exactly."""
+        _no_mesh()
+        d, dh, E = 16, 32, 4
+        experts = _identical_experts(d, dh, E)
+        gate = GShardGate(d, E, random_routing=False)
+        moe = MoELayer(d, experts, gate=gate, capacity_factor=1e4)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, d).astype(np.float32))
+        out = moe(x).numpy()
+        # dense oracle from the absorbed expert-0 weights
+        w1, b1 = experts[0].htoh4.weight.numpy(), experts[0].htoh4.bias.numpy()
+        w2, b2 = experts[0].h4toh.weight.numpy(), experts[0].h4toh.bias.numpy()
+        xf = x.numpy().reshape(-1, d)
+        h = np.asarray(jax.nn.gelu(xf @ w1 + b1))
+        dense = (h @ w2 + b2).reshape(2, 8, d)
+        np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-6)
+
+    def test_capacity_drops_tokens(self):
+        """With capacity 4 slots per expert, overflow tokens are dropped
+        (output rows go to zero) — pinning GShard capacity semantics."""
+        _no_mesh()
+        d, E = 8, 2
+        experts = _identical_experts(d, 16, E)
+        gate = GShardGate(d, E, random_routing=False)
+        moe = MoELayer(d, experts, gate=gate, capacity_factor=0.01)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 64, d).astype(np.float32))
+        out = moe(x).numpy().reshape(-1, d)
+        # capacity = max(4, ...) = 4 per expert; top-2 over 2 experts means
+        # every token wants both experts -> at most 8 rows survive
+        nonzero = np.sum(np.any(np.abs(out) > 1e-9, axis=-1))
+        assert nonzero <= 8, nonzero
+
+    def test_expert_residency_on_mesh(self):
+        """Each device holds E/ep experts — the point of EP (the r2 loop
+        replicated all experts everywhere)."""
+        hcg = _reset_fleet(mp_degree=4, dp_degree=2)
+        paddle.seed(10)
+        model = _MoEModel(8, 16, E=8)
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = TrainStep(model, lambda out, _l: out.sum(), opt, mesh=hcg.mesh)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(8, 4, 8).astype(np.float32))
+        float(step.step((x,), (x,)).value)
+        w1 = step.params["moe.w1"] if "moe.w1" in step.params else \
+            next(v for k, v in step.params.items() if k.endswith("w1"))
+        spec = w1.sharding.spec
+        assert spec[0] in ("mp", ("mp",))
+        assert w1.addressable_shards[0].data.shape[0] == 2  # 8 experts / mp4
+
+    def test_all_to_all_in_hlo(self):
+        """The group->expert reshard must compile to an all-to-all on an
+        ep>1 mesh (reference: global_scatter/global_gather)."""
+        hcg = _reset_fleet(mp_degree=4, dp_degree=2)
+        paddle.seed(11)
+        model = _MoEModel(8, 16, E=8)
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = TrainStep(model, lambda out, _l: out.sum(), opt, mesh=hcg.mesh)
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(8, 4, 8).astype(np.float32))
+        hlo = step.lower_text((x,), (x,))
+        assert "all-to-all" in hlo
+
+    def test_mesh_parity_vs_meshless(self):
+        """Group-wise dispatch on an ep4 mesh computes the same function as
+        the meshless (G=1) path when capacity is non-binding."""
+        d, dh, E = 16, 32, 4
+        x_np = np.random.RandomState(5).randn(2, 16, d).astype(np.float32)
+
+        def run(on_mesh):
+            if on_mesh:
+                _reset_fleet(mp_degree=4, dp_degree=2)
+            else:
+                _no_mesh()
+            experts = _identical_experts(d, dh, E, seed=7)
+            gate = GShardGate(d, E, random_routing=False)
+            moe = MoELayer(d, experts, gate=gate, capacity_factor=1e4)
+            return moe(paddle.to_tensor(x_np)).numpy()
+
+        np.testing.assert_allclose(run(False), run(True), rtol=2e-5, atol=2e-6)
+
+    def test_moe_gradients_flow_to_stacked_experts(self):
+        _no_mesh()
+        paddle.seed(12)
+        d, dh, E = 8, 16, 4
+        moe = MoELayer(d, [ExpertLayer(d, dh) for _ in range(E)],
+                       gate={"type": "gshard", "top_k": 2},
+                       capacity_factor=4.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(2, 8, d).astype(np.float32),
+            stop_gradient=False)
+        out = moe(x)
+        loss = out.sum() + moe.aux_loss * 0.01
+        loss.backward()
+        assert moe.w1.grad is not None
+        assert np.any(np.abs(moe.w1.grad.numpy()) > 0)
+        assert moe.gate.gate.weight.grad is not None
