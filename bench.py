@@ -30,7 +30,19 @@ BENCH_TIMEOUT_S = 900
 BACKOFFS_S = (5, 15, 30)
 
 
-def main():
+# Candidate configs measured in ONE child, best MFU reported. The r3
+# variants: head-major attention layout (projection-fused head fold, no HBM
+# transpose pass) and chunked lm-head+CE (one [B,chunk,V] f32 block live
+# instead of the full [B,S,V]). Measured rather than assumed: each is timed
+# on-chip and the winner is named in the unit string.
+CONFIGS = [
+    ("bhsd+chunk", {"attention_layout": "bhsd", "loss_chunk": 512}),
+    ("chunk", {"loss_chunk": 512}),
+    ("base", {}),
+]
+
+
+def _measure_config(name, overrides, iters=10):
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
@@ -40,10 +52,12 @@ def main():
 
     paddle.seed(0)
     # ~350M-param llama sized for a single v5e chip in bf16 + fp32 adam state
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-        max_position_embeddings=2048, use_recompute=True, dtype="bfloat16")
+    kw = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+              num_hidden_layers=24, num_attention_heads=16,
+              num_key_value_heads=16, max_position_embeddings=2048,
+              use_recompute=True, dtype="bfloat16")
+    kw.update(overrides)
+    cfg = LlamaConfig(**kw)
     model = LlamaForCausalLM(cfg)
     n_params = model.num_params()
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
@@ -60,7 +74,6 @@ def main():
     for _ in range(3):
         float(step.step((ids, ids), (ids,)).value)
 
-    iters = 10
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step.step((ids, ids), (ids,))
@@ -69,18 +82,48 @@ def main():
 
     n_chips = jax.device_count()
     tokens_per_sec = iters * B * S / dt
-    flops_per_token = 6.0 * n_params
-    achieved = tokens_per_sec * flops_per_token
     peak = peak_flops_per_chip() * n_chips
-    mfu = achieved / peak
+    mfu = tokens_per_sec * 6.0 * n_params / peak
+    return {"name": name, "mfu": float(mfu), "tok_s": tokens_per_sec,
+            "loss": final_loss, "n_params": n_params, "peak": peak}
 
+
+def main():
+    results = []
+    for name, overrides in CONFIGS:
+        try:
+            results.append(_measure_config(name, overrides))
+        except Exception as e:  # one bad config must not kill the bench
+            print(f"# config {name} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    if not results:
+        _fail_line("all bench configs failed")
+        return 0
+    best = max(results, key=lambda r: r["mfu"])
+
+    # 7B-shaped evidence (VERDICT r3 item 3): one decoder layer at exact 7B
+    # dims through the same scan body; reported in the unit string
+    layer7b = ""
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_7b_layer import measure as measure_7b
+        r7 = measure_7b(iters=6)
+        layer7b = (f", 7b-layer {r7['layer7b_tok_s']} tok/s "
+                   f"{r7['layer7b_mfu']:.3f} MFU")
+    except Exception as e:
+        print(f"# 7b layer bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    mfu = best["mfu"]
     print(json.dumps({
         "metric": METRIC,
-        "value": round(float(mfu), 4),
-        "unit": f"MFU (6N formula, N={n_params/1e6:.0f}M, "
-                f"{tokens_per_sec:.0f} tok/s/chip, "
-                f"peak={peak/1e12:.0f}TF, loss={final_loss:.3f})",
-        "vs_baseline": round(float(mfu) / 0.45, 4),
+        "value": round(mfu, 4),
+        "unit": f"MFU (6N formula, N={best['n_params']/1e6:.0f}M, "
+                f"{best['tok_s']:.0f} tok/s/chip, "
+                f"peak={best['peak']/1e12:.0f}TF, loss={best['loss']:.3f}, "
+                f"cfg={best['name']}{layer7b})",
+        "vs_baseline": round(mfu / 0.45, 4),
     }))
     return 0
 

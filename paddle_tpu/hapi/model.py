@@ -28,14 +28,16 @@ class Model:
         self._train_step: Optional[TrainStep] = None
         self.stop_training = False
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, mesh=None):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
         if optimizer is not None and loss is not None:
             loss_fn = loss if callable(loss) else (lambda out, lab: loss(out, lab))
-            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+            self._train_step = TrainStep(self.network, loss_fn, optimizer,
+                                         mesh=mesh)
         return self
 
     # ------------------------------------------------------------------ steps

@@ -1,16 +1,22 @@
-"""DataLoader (reference: ``python/paddle/io/dataloader/dataloader_iter.py`` —
-multiprocess workers + pinned-memory + prefetch).
+"""DataLoader (reference: ``python/paddle/io/dataloader/dataloader_iter.py``
++ ``worker.py`` — multiprocess workers + pinned-memory + prefetch).
 
 TPU-native host loop: workers produce numpy batches, a bounded prefetch queue
 overlaps host data prep with device steps (the jitted step's async dispatch
-means the host runs ahead; the queue keeps it fed). Worker pool uses threads
-by default (numpy collate releases the GIL); a native C++ prefetch core
-(paddle_tpu/csrc) can be swapped in for heavy pipelines.
+means the host runs ahead; the queue keeps it fed).
+
+``num_workers>0`` defaults to a thread pool (numpy collate releases the
+GIL, so threads are usually the right TPU-host choice — and they need no
+dataset pickling or __main__ guard). ``worker_mode="process"`` opts into
+real OS worker processes (spawn context — fork is unsafe after jax backend
+init) with an order-preserving reorder buffer and worker-crash propagation,
+like the reference's _DataLoaderIterMultiProcess.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import traceback
 from typing import Callable, Optional
 
 import numpy as np
@@ -32,6 +38,30 @@ _worker_info = threading.local()
 
 def get_worker_info():
     return getattr(_worker_info, "info", None)
+
+
+def _mp_worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
+                    num_workers, worker_init_fn):
+    """Worker-process main (reference ``worker.py::_worker_loop``): pull
+    (task_id, indices), fetch+collate, push (task_id, batch, error)."""
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            task_id, indices = task
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                result_queue.put((task_id, batch, None))
+            except Exception as e:  # noqa: BLE001 — propagated to parent
+                result_queue.put(
+                    (task_id, None,
+                     f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+    except KeyboardInterrupt:
+        pass
 
 
 def default_collate_fn(batch):
@@ -69,13 +99,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_mode="thread"):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
+        self.timeout = float(timeout)
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be 'thread' or 'process', "
+                             f"got {worker_mode!r}")
+        self.worker_mode = worker_mode
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -105,6 +140,8 @@ class DataLoader:
             yield from self._iter_iterable()
         elif self.num_workers == 0:
             yield from self._iter_sync()
+        elif self.worker_mode == "process":
+            yield from self._iter_multiprocess()
         else:
             yield from self._iter_prefetch()
 
@@ -160,3 +197,85 @@ class DataLoader:
                 fut = futures.get()
                 submit_next()
                 yield _to_tensor_batch(fut.result())
+
+    def _iter_multiprocess(self):
+        """Spawn-context worker processes + order-preserving reorder buffer
+        + crash propagation (reference _DataLoaderIterMultiProcess)."""
+        import multiprocessing as mp
+
+        import os
+
+        ctx = mp.get_context("spawn")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        nw = self.num_workers
+        workers = [
+            ctx.Process(
+                target=_mp_worker_loop,
+                args=(self.dataset, index_q, result_q, self.collate_fn, wid,
+                      nw, self.worker_init_fn),
+                daemon=True)
+            for wid in range(nw)]
+        # workers are host-side data producers: pin them to the CPU jax
+        # platform and suppress TPU plugin registration so their
+        # paddle_tpu import never initializes (or blocks on) the
+        # accelerator backend the trainer process owns — the TPU tunnel
+        # admits one client, and the trainer IS that client while the
+        # loader runs
+        overrides = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            for w in workers:
+                w.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        batches = list(self.batch_sampler)
+        depth = min(nw * self.prefetch_factor, len(batches))
+        poll_s = self.timeout if self.timeout > 0 else 5.0
+        try:
+            for i in range(depth):
+                index_q.put((i, batches[i]))
+            next_submit = depth
+            next_out = 0
+            buffer = {}
+            while next_out < len(batches):
+                if next_out in buffer:
+                    batch = buffer.pop(next_out)
+                    next_out += 1
+                    if next_submit < len(batches):
+                        index_q.put((next_submit, batches[next_submit]))
+                        next_submit += 1
+                    yield _to_tensor_batch(batch)
+                    continue
+                try:
+                    tid, batch, err = result_q.get(timeout=poll_s)
+                except queue.Empty:
+                    dead = [w.pid for w in workers if not w.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} exited "
+                            f"unexpectedly") from None
+                    if self.timeout > 0:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {self.timeout}s "
+                            f"waiting for a worker batch") from None
+                    continue
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker raised:\n{err}")
+                buffer[tid] = batch
+        finally:
+            for _ in workers:
+                try:
+                    index_q.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=2.0)
+                if w.is_alive():
+                    w.terminate()

@@ -57,6 +57,11 @@ class LlamaConfig:
     # microbatches (the real pipeline schedule, vs pp-sharding the scan's
     # layer dim). Batch size must be divisible by this.
     pipeline_microbatches: int = 0
+    # >1 uses the interleaved (virtual-stage) schedule: each pp device
+    # holds this many layer chunks and microbatches make that many ring
+    # passes — cuts the pipeline bubble ~by this factor (reference
+    # PipelineParallelWithInterleave). Requires microbatches <= pp degree.
+    pipeline_virtual_stages: int = 1
     # "" | "ring" | "ulysses": context parallelism over the 'sep' mesh axis
     # (parallel.sp_attention). Requires sep>1 in the mesh and (for now)
     # pp degree 1 — nesting the sep shard_map inside the pipeline's manual
@@ -236,6 +241,7 @@ class LlamaForCausalLM(nn.Layer):
             bool(c.use_recompute), self.lm_head is None,
             policy=c.recompute_policy,
             pipeline_microbatches=int(c.pipeline_microbatches),
+            pipeline_virtual_stages=int(c.pipeline_virtual_stages),
             context_parallel=str(c.context_parallel),
             attention_layout=str(c.attention_layout),
             loss_chunk=int(c.loss_chunk), **params)
@@ -248,7 +254,8 @@ class LlamaForCausalLM(nn.Layer):
 
 @tensor_op
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
-                   policy="full", pipeline_microbatches=0, context_parallel="",
+                   policy="full", pipeline_microbatches=0,
+                   pipeline_virtual_stages=1, context_parallel="",
                    attention_layout="bshd", loss_chunk=0,
                    *, embed, wq, wk, wv, wo, w_gate, w_up, w_down, input_ln,
                    post_ln, final_norm, lm_head):
@@ -347,14 +354,20 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                          "set pipeline_microbatches=0 or sep_degree=1")
     if pipeline_microbatches > 0 and pp_deg > 1:
         # real pipeline: stage-resident weight slices + ppermute handoffs
-        from ..parallel.pp import pipeline_spmd
+        from ..parallel.pp import pipeline_interleaved, pipeline_spmd
 
         def stage_fn(local_stack, h):
             h, _ = jax.lax.scan(lambda hh, lp: body(hh, lp), h, local_stack)
             return h
 
-        x = pipeline_spmd(stage_fn, stack, x,
-                          num_microbatches=pipeline_microbatches, mesh=mesh)
+        if pipeline_virtual_stages > 1:
+            x = pipeline_interleaved(
+                stage_fn, stack, x, num_microbatches=pipeline_microbatches,
+                num_virtual=pipeline_virtual_stages, mesh=mesh)
+        else:
+            x = pipeline_spmd(stage_fn, stack, x,
+                              num_microbatches=pipeline_microbatches,
+                              mesh=mesh)
     else:
         x, _ = jax.lax.scan(lambda h, lp: body(h, lp), x, stack)
 

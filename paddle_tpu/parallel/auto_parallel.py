@@ -89,13 +89,30 @@ def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
 
 
 def shard_tensor(x, mesh: ProcessMesh, placements: List[Placement],
-                 dtype=None, stop_gradient=None) -> Tensor:
+                 dtype=None, stop_gradient=None, _annotate_params=True) -> Tensor:
     """Place a tensor on the mesh with the given placements; returns a Tensor
-    whose value is a global sharded jax Array (the DistTensor analog)."""
+    whose value is a global sharded jax Array (the DistTensor analog).
+
+    A ``Parameter`` is annotated IN PLACE (dist_spec consumed by
+    jit.TrainStep for param/grad/opt-state layout) and returned, so the
+    reference's ``layer.weight = dist.shard_tensor(layer.weight, ...)``
+    idiom and plain ``shard_tensor(layer.weight, ...)`` both wire the
+    annotation into the compiled step. ``reshard`` passes
+    ``_annotate_params=False`` to get a fresh view instead."""
+    from ..core.tensor import Parameter
     t = x if isinstance(x, Tensor) else Tensor(x)
     spec = _placements_to_spec(placements, mesh, t.ndim)
     sharding = NamedSharding(mesh.jax_mesh, spec)
     v = jax.device_put(t.value, sharding)
+    if _annotate_params and isinstance(t, Parameter):
+        t._rebind(v)
+        t.dist_spec = spec
+        t.is_distributed = True
+        t.process_mesh = mesh
+        t.placements = list(placements)
+        if stop_gradient is not None:
+            t.stop_gradient = stop_gradient
+        return t
     out = Tensor(v, stop_gradient=t.stop_gradient if stop_gradient is None
                  else stop_gradient)
     out.dist_spec = spec
@@ -120,7 +137,10 @@ def shard_op(op, mesh: ProcessMesh, in_placements=None, out_placements=None):
 
 
 def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
-    return shard_tensor(x, mesh, placements, stop_gradient=x.stop_gradient)
+    """Returns a NEW resharded view; never mutates the input (unlike the
+    shard_tensor Parameter-annotation idiom)."""
+    return shard_tensor(x, mesh, placements, stop_gradient=x.stop_gradient,
+                        _annotate_params=False)
 
 
 def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
@@ -138,15 +158,27 @@ def get_mesh():
 
 
 class Engine:
-    """auto_parallel.static Engine facade: fit/evaluate/predict over a jitted
-    step compiled from shard_tensor annotations (completion/partitioner/
-    reshard = XLA SPMD)."""
+    """auto_parallel.static Engine facade (reference
+    ``python/paddle/distributed/auto_parallel/static/engine.py`` †):
+    fit/evaluate/predict over a jitted TrainStep compiled ON THE CURRENT
+    MESH from shard_tensor annotations — the reference's completion/
+    partitioner/reshard pipeline collapses into XLA SPMD partitioning of
+    the annotated program."""
 
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
-                 strategy=None):
+                 strategy=None, mesh=None):
         from ..hapi.model import Model
+        if mesh is None:
+            mesh = mesh_mod.get_mesh()
+        elif isinstance(mesh, ProcessMesh):
+            mesh = mesh.jax_mesh
+        self.mesh = mesh
         self._model = Model(model)
-        self._model.prepare(optimizer, loss, metrics)
+        self._model.prepare(optimizer, loss, metrics, mesh=mesh)
+
+    @property
+    def train_step(self):
+        return self._model._train_step
 
     def fit(self, train_data, epochs=1, batch_size=1, **kwargs):
         return self._model.fit(train_data, epochs=epochs,

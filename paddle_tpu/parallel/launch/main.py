@@ -48,12 +48,14 @@ def build_parser():
                         "runtime")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--restart_backoff", type=float, default=3.0,
+                   help="base seconds for exponential restart backoff")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
 
 
-def _child_env(args, local_rank, nnodes_min):
+def _child_env(args, local_rank, nnodes_min, kv_endpoint=None):
     env = dict(os.environ)
     world = nnodes_min * max(args.procs, 1)
     rank = args.rank * max(args.procs, 1) + local_rank
@@ -63,6 +65,8 @@ def _child_env(args, local_rank, nnodes_min):
         env["PADDLE_MASTER"] = args.master
         host = args.master.split(":")[0]
         env["PADDLE_CURRENT_ENDPOINT"] = f"{host}:{35000 + rank}"
+    if kv_endpoint:
+        env["PADDLE_MASTER_KV"] = kv_endpoint
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
     env["FLAGS_selected_tpus"] = str(local_rank)
     return env
@@ -75,11 +79,28 @@ def launch():
     os.makedirs(args.log_dir, exist_ok=True)
     cmd_base = [sys.executable, args.script] + args.script_args
 
+    # rank-0 rendezvous store (reference controllers/master.py): an HTTP KV
+    # service for worker bootstrap/barrier. It binds an EPHEMERAL port on
+    # the master host — NOT the --master port itself, which stays free for
+    # the jax.distributed coordinator (PADDLE_MASTER) — and the resolved
+    # endpoint is exported to workers as PADDLE_MASTER_KV.
+    kv_server = None
+    if args.master and args.rank == 0:
+        from .rendezvous import KVServer
+        host, _, _port = args.master.partition(":")
+        try:
+            kv_server = KVServer(port=0, host=host or "127.0.0.1")
+            logger.info(f"rendezvous KV store serving on {kv_server.endpoint}")
+        except OSError as e:
+            logger.warning(f"KV store not started ({e}); assuming an "
+                           f"external rendezvous service")
+
     restarts = 0
     while True:
         procs = []
         for lr in range(max(args.procs, 1)):
-            env = _child_env(args, lr, nmin)
+            env = _child_env(args, lr, nmin,
+                             kv_server.endpoint if kv_server else None)
             logfile = os.path.join(args.log_dir, f"workerlog.{lr}")
             out = open(logfile, "ab")
             logger.info(f"spawn rank {env['PADDLE_TRAINER_ID']}: "
@@ -106,15 +127,24 @@ def launch():
             raise
         if all(c == 0 for c in codes):
             logger.info("job finished successfully")
+            if kv_server is not None:
+                kv_server.stop()
             return 0
         restarts += 1
         if restarts > args.max_restart or args.elastic_level < 0:
             logger.error(f"job failed with exit codes {codes}")
+            if kv_server is not None:
+                kv_server.stop()
             return 1
+        backoff = min(args.restart_backoff * (2 ** (restarts - 1)), 30.0)
         logger.warning(f"restart {restarts}/{args.max_restart} after failure "
-                       f"{codes} (elastic mode)")
+                       f"{codes} (elastic mode, backoff {backoff:.1f}s)")
         terminate_all()
-        time.sleep(3)
+        if kv_server is not None:
+            # stale rank registrations from the failed run would satisfy the
+            # next run's wait_world barrier with dead endpoints
+            kv_server.clear()
+        time.sleep(backoff)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,136 @@
+"""Rank-0 rendezvous KV store (reference:
+``python/paddle/distributed/launch/controllers/master.py`` † — the HTTP
+master the launcher starts on rank 0 for collective bootstrap; etcd fills
+this role in the reference's elastic mode).
+
+A tiny threaded HTTP KV server + client: workers register their endpoint
+under ``/job/<id>/rank/<r>``, barrier on world size, and read the peer
+table. TPU note: this is HOST-level bootstrap only — device-level
+coordination (collectives) is XLA's job; one process per host.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # silence per-request stderr spam
+        pass
+
+    def _store(self):
+        return self.server._kv_store, self.server._kv_lock
+
+    def do_PUT(self):
+        store, lock = self._store()
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n).decode()
+        with lock:
+            store[self.path] = val
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        store, lock = self._store()
+        if self.path.endswith("?prefix"):
+            prefix = self.path[: -len("?prefix")]
+            with lock:
+                out = {k: v for k, v in store.items() if k.startswith(prefix)}
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        with lock:
+            val = store.get(self.path)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(val.encode())
+
+    def do_DELETE(self):
+        store, lock = self._store()
+        with lock:
+            store.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """Threaded KV store bound to ``port`` (0 = ephemeral; see ``.port``)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _KVHandler)
+        self._httpd._kv_store = {}
+        self._httpd._kv_lock = threading.Lock()
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def clear(self):
+        """Wipe all keys (elastic restart: drop the dead run's ranks)."""
+        with self._httpd._kv_lock:
+            self._httpd._kv_store.clear()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KVClient:
+    def __init__(self, endpoint: str, timeout=5.0):
+        self._base = f"http://{endpoint}"
+        self._timeout = timeout
+
+    def _req(self, method, path, data=None):
+        req = urllib.request.Request(self._base + path, data=data,
+                                     method=method)
+        return urllib.request.urlopen(req, timeout=self._timeout)
+
+    def put(self, key: str, value: str):
+        self._req("PUT", key, value.encode()).read()
+
+    def get(self, key: str):
+        try:
+            return self._req("GET", key).read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def get_prefix(self, prefix: str) -> dict:
+        body = self._req("GET", prefix + "?prefix").read().decode()
+        return json.loads(body)
+
+    def delete(self, key: str):
+        self._req("DELETE", key).read()
+
+    def register(self, job_id: str, rank: int, endpoint: str):
+        self.put(f"/job/{job_id}/rank/{rank}", endpoint)
+
+    def wait_world(self, job_id: str, world: int, timeout=60.0) -> dict:
+        """Barrier: poll until all `world` ranks registered; return the
+        rank -> endpoint table."""
+        deadline = time.time() + timeout
+        prefix = f"/job/{job_id}/rank/"
+        while True:
+            table = self.get_prefix(prefix)
+            if len(table) >= world:
+                return {int(k.rsplit("/", 1)[1]): v for k, v in table.items()}
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {len(table)}/{world} ranks after {timeout}s")
+            time.sleep(0.1)

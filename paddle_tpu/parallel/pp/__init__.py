@@ -157,3 +157,83 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
 
 # Name referenced by docstrings elsewhere in the tree.
 schedule = pipeline_spmd
+
+
+def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
+                         num_virtual=2, mesh=None, axis=PP_AXIS, remat=False):
+    """Interleaved (virtual-stage) pipeline schedule — the reference's
+    ``PipelineParallelWithInterleave``
+    (``meta_parallel/pipeline_parallel.py`` †), SPMD-style.
+
+    Layers are split into S·V chunks; device s holds chunks
+    ``{s, s+S, ..., s+(V-1)S}`` of K = L/(S·V) layers each, and every
+    microbatch makes V passes around the device RING (``ppermute`` with the
+    wrap edge S-1 -> 0). Busy fraction rises from M/(M+S-1) to
+    M·V/(M·V+S-1)-equivalent: the bubble shrinks ~by the interleave factor
+    V for the same microbatch count, which is the point of the reference
+    schedule.
+
+    Conflict-free injection requires ``num_microbatches <= S`` (stage 0's
+    injection window must not collide with pass-v wrap-arounds; the
+    reference's interleave similarly constrains M to multiples of S). For
+    M > S use :func:`pipeline_spmd` or raise V.
+
+    ``stage_fn(chunk_params, h) -> h`` applies ONE chunk (leading dim K).
+    """
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    S = _pp_degree(mesh, axis)
+    if S <= 1:
+        return stage_fn(stacked_params, x)
+    M = int(num_microbatches)
+    V = int(num_virtual)
+    if M > S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) <= pp degree "
+            f"({S}) for conflict-free injection; use pipeline_spmd or fewer "
+            f"microbatches")
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % (S * V) != 0:
+        raise ValueError(f"layer count {L} not divisible by S*V = {S * V}")
+    K = L // (S * V)
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    # layer l = (v*S + s)*K + k  ->  [V, S, K, ...]; dim 1 is the stage dim
+    params_r = jax.tree.map(
+        lambda p: p.reshape(V, S, K, *p.shape[1:]), stacked_params)
+    xs = x.reshape(M, mb, *x.shape[1:])
+    T = M + S * V - 1
+    stage = jax.checkpoint(stage_fn) if remat else stage_fn
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def body(params_local, xs_):
+        s = jax.lax.axis_index(axis)
+        pl = jax.tree.map(lambda p: p[:, 0], params_local)  # [V, K, ...]
+
+        def tick(a, t):
+            rel = t - s
+            m = jnp.mod(rel, S)          # microbatch id (when in window)
+            v = jnp.clip(jnp.where(rel >= 0, rel, 0) // S, 0, V - 1)
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs_, jnp.clip(m, 0, M - 1), 0, keepdims=False)
+            inject = (s == 0) & (rel >= 0) & (rel < M)  # first-pass window
+            a_in = jnp.where(inject, x_t, a)
+            chunk_params = jax.tree.map(
+                lambda p: jax.lax.dynamic_index_in_dim(p, v, 0,
+                                                       keepdims=False), pl)
+            y = stage(chunk_params, a_in)
+            a_next = jax.lax.ppermute(y, axis, ring)
+            return a_next, y
+
+        a0 = jnp.zeros_like(xs_[0])
+        _, ys = jax.lax.scan(tick, a0, jnp.arange(T))
+        return ys[None]
+
+    ys = jax.shard_map(
+        body, mesh=mesh, axis_names={axis},
+        in_specs=(jax.tree.map(lambda _: P(None, axis), params_r), P()),
+        out_specs=P(axis), check_vma=False)(params_r, xs)
+    # microbatch m finishes chunk S*V-1 on device S-1 at tick m + S*V - 1
+    out = ys[S - 1, S * V - 1: S * V - 1 + M]
+    return out.reshape(B, *out.shape[2:])

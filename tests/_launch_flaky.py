@@ -1,0 +1,11 @@
+"""Toy worker that fails on its first run (marker file), succeeds after —
+exercises the launcher's elastic restart-with-backoff path."""
+import os
+import sys
+
+marker = os.path.join(sys.argv[1], "ran_once")
+if not os.path.exists(marker):
+    open(marker, "w").write("1")
+    print("first run: failing deliberately")
+    sys.exit(1)
+print("second run: ok")

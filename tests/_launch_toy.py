@@ -1,0 +1,12 @@
+"""Toy worker for launcher tests: dumps its paddle env to a per-rank file.
+Deliberately imports no jax/paddle — launcher plumbing only."""
+import json
+import os
+import sys
+
+out_dir = sys.argv[1]
+rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+with open(os.path.join(out_dir, f"env.{rank}.json"), "w") as f:
+    json.dump({k: v for k, v in os.environ.items()
+               if k.startswith(("PADDLE_", "FLAGS_selected"))}, f)
+print(f"toy worker rank={rank} ok")

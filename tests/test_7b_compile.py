@@ -1,0 +1,146 @@
+"""7B-shaped hybrid-parallel compile evidence (VERDICT r2 item 3).
+
+AOT-lowers LLaMA with REAL 7B layer shapes (hidden 4096, ffn 11008,
+32 heads, vocab 32000) over hybrid meshes using ShapeDtypeStruct inputs
+(no host RAM for weights), and asserts the partitioned HLO never
+materializes a full-size decoder weight via all-gather (the OOM signature
+of a wrong layout: ZeRO-3-style gather of [4096,11008] onto every device).
+
+Two cases, scoped to what XLA's CPU backend can compile on this 1-core
+host (found by bisection):
+- fwd+bwd over dp2 x mp2 x sharding2 — the TP/ZeRO gradient+optimizer
+  layout story (pipeline off);
+- fwd over pp2 x mp2 x sharding2 — the pipeline layout story
+  (collective-permute handoffs, stage-resident weights). The pipeline
+  BACKWARD at 7B dims SIGABRTs XLA-CPU's backend_compile; its correctness
+  is pinned at small dims by tests/test_pipeline.py and exercised on the
+  device mesh by the driver's dryrun_multichip gate.
+
+The stacked depth is 4 layers, not 32: GSPMD layout decisions are
+per-layer. Matches BASELINE.json config 3 (LLaMA-2 7B Fleet hybrid).
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.parallel import mesh as mesh_mod
+
+
+def _reset_fleet(**degrees):
+    mesh_mod._STATE["mesh"] = None
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = degrees
+    fleet.init(is_collective=True, strategy=s)
+    return fleet.get_hybrid_communicate_group()
+
+
+# full-size decoder weight shapes that must never appear as an all-gather
+# result (materializing a whole layer's ffn/attn matrix on every device)
+_FORBIDDEN = [
+    (4096, 11008),   # gate/up full matrix
+    (11008, 4096),   # down full matrix
+    (4096, 4096),    # qkv/o full matrix
+]
+
+H, I, V, NH, HD = 4096, 11008, 32000, 32, 128
+L = 4  # 7B per-layer dims; depth shrunk for CPU compile viability
+
+
+def _params_sds(mesh):
+    dt = jnp.bfloat16
+
+    def sds(shape, spec):
+        return jax.ShapeDtypeStruct(shape, dt,
+                                    sharding=NamedSharding(mesh, spec))
+
+    return dict(
+        embed=sds((V, H), P("mp", None)),
+        wq=sds((L, H, NH * HD), P("pp", None, "mp")),
+        wk=sds((L, H, NH * HD), P("pp", None, "mp")),
+        wv=sds((L, H, NH * HD), P("pp", None, "mp")),
+        wo=sds((L, NH * HD, H), P("pp", "mp", None)),
+        w_gate=sds((L, H, I), P("pp", None, "mp")),
+        w_up=sds((L, H, I), P("pp", None, "mp")),
+        w_down=sds((L, I, H), P("pp", "mp", None)),
+        input_ln=sds((L, H), P("pp", None)),
+        post_ln=sds((L, H), P("pp", None)),
+        final_norm=sds((H,), P(None)),
+        lm_head=sds((H, V), P(None, "mp")),
+    )
+
+
+def _loss_fn(pipeline_microbatches):
+    from paddle_tpu.models.llama import _llama_forward
+
+    def loss_fn(params, ids):
+        return _llama_forward.raw_fn(
+            ids, ids, NH, NH, HD, 1e-5, 10000.0, True, False,
+            policy="full", pipeline_microbatches=pipeline_microbatches,
+            attention_layout="bhsd", loss_chunk=128, **params)
+
+    return loss_fn
+
+
+def _assert_no_full_weight_allgather(hlo):
+    bad = []
+    for line in hlo.splitlines():
+        if "all-gather(" not in line and " all-gather" not in line:
+            continue
+        shapes = re.findall(r"bf16\[([0-9,]+)\]", line.split("=")[0])
+        for sh in shapes:
+            dims = tuple(int(d) for d in sh.split(","))
+            for fb in _FORBIDDEN:
+                if len(dims) >= 2 and tuple(dims[-2:]) == fb:
+                    bad.append(line[:160])
+    assert not bad, "full-weight all-gathers found:\n" + "\n".join(bad)
+
+
+class TestLlama7BHybridCompile:
+    @pytest.mark.slow
+    def test_7b_fwd_bwd_tp_zero_layout(self):
+        """Train-step gradients at 7B dims over dp2 x mp2 x sharding2:
+        partitions without gathering any full decoder weight."""
+        hcg = _reset_fleet(dp_degree=2, mp_degree=2, sharding_degree=2)
+        mesh = hcg.mesh
+        params = _params_sds(mesh)
+        B, S = 4, 512
+        ids = jax.ShapeDtypeStruct(
+            (B, S), jnp.int32,
+            sharding=NamedSharding(mesh, P(("dp", "sharding"), None)))
+        loss_fn = _loss_fn(0)
+
+        def train_obj(params, ids):
+            return jax.value_and_grad(loss_fn)(params, ids)
+
+        compiled = jax.jit(train_obj).lower(params, ids).compile()
+        hlo = compiled.as_text()
+        assert "all-reduce" in hlo or "reduce-scatter" in hlo  # grad sync
+        _assert_no_full_weight_allgather(hlo)
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            arg_gb = mem.argument_size_in_bytes / 2**30
+            assert arg_gb < 12.0, f"{arg_gb:.1f} GiB args per device"
+
+    @pytest.mark.slow
+    def test_7b_fwd_pipeline_layout(self):
+        """Forward at 7B dims over pp2 x mp2 x sharding2 with the real
+        pipeline schedule: collective-permute handoffs present, no full
+        decoder weight gathered."""
+        hcg = _reset_fleet(pp_degree=2, mp_degree=2, sharding_degree=2,
+                           dp_degree=1)
+        mesh = hcg.mesh
+        params = _params_sds(mesh)
+        B, S = 4, 256
+        ids = jax.ShapeDtypeStruct(
+            (B, S), jnp.int32,
+            sharding=NamedSharding(mesh, P(("dp", "sharding"), None)))
+        compiled = jax.jit(_loss_fn(2)).lower(params, ids).compile()
+        hlo = compiled.as_text()
+        assert "collective-permute" in hlo  # pp handoffs
+        _assert_no_full_weight_allgather(hlo)

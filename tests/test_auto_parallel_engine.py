@@ -1,0 +1,96 @@
+"""Auto-parallel Engine semantics (VERDICT r2 item 10): fit/evaluate/predict
+driving a mesh-compiled TrainStep from shard_tensor annotations.
+Reference: ``python/paddle/distributed/auto_parallel/static/engine.py`` †.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.auto_parallel import (Engine, ProcessMesh, Replicate,
+                                               Shard, shard_tensor)
+
+
+class _XYDataset(Dataset):
+    def __init__(self, n=64, din=16, dout=4):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, din).astype(np.float32)
+        w = rng.randn(din, dout).astype(np.float32)
+        self.y = self.x @ w + 0.01 * rng.randn(n, dout).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class _MLP(nn.Layer):
+    def __init__(self, din=16, dh=32, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _mse(out, lab):
+    return ((out - lab) ** 2).mean()
+
+
+class TestAutoParallelEngine:
+    def setup_method(self, _m):
+        mesh_mod._STATE["mesh"] = None
+
+    def _build(self):
+        pm = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        paddle.seed(55)
+        model = _MLP()
+        # Megatron-style annotations: fc1 column-sharded, fc2 row-sharded
+        shard_tensor(model.fc1.weight, pm, [Replicate(), Shard(1)])
+        shard_tensor(model.fc2.weight, pm, [Shard(0), Replicate()])
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        return pm, model, Engine(model=model, loss=_mse, optimizer=opt,
+                                 mesh=pm)
+
+    def test_shard_tensor_annotates_parameter_in_place(self):
+        pm = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        lin = nn.Linear(8, 16)
+        w = shard_tensor(lin.weight, pm, [Replicate(), Shard(1)])
+        assert w is lin.weight  # in-place annotation, not a copy
+        assert tuple(lin.weight.dist_spec) == (None, "mp")
+        assert lin.weight.value.sharding.spec[1] in ("mp", ("mp",))
+
+    def test_fit_reduces_loss_and_places_params(self):
+        pm, model, engine = self._build()
+
+        def eval_loss():
+            ev = engine.evaluate(_XYDataset(), batch_size=16, verbose=0)
+            loss = ev["loss"] if isinstance(ev, dict) else ev
+            return float(np.ravel(loss)[0])
+
+        before = eval_loss()
+        engine.fit(_XYDataset(), epochs=5, batch_size=16, verbose=0)
+        after = eval_loss()
+        # the compiled step placed fc1.weight mp-sharded on the mesh
+        w1 = engine.train_step.params["fc1.weight"]
+        assert w1.sharding.spec[1] in ("mp", ("mp",))
+        assert w1.addressable_shards[0].data.shape[1] == 32 // 4
+        assert after < before * 0.6, (before, after)
+
+    def test_predict_returns_outputs(self):
+        class _XOnly(_XYDataset):
+            def __getitem__(self, i):
+                return self.x[i]
+
+        pm, model, engine = self._build()
+        engine.fit(_XYDataset(n=32), epochs=1, batch_size=16, verbose=0)
+        preds = engine.predict(_XOnly(n=32), batch_size=16, verbose=0)
+        arrs = [np.asarray(p) for p in np.atleast_1d(preds)] if not \
+            isinstance(preds, list) else [np.asarray(p) for p in preds]
+        total = sum(a.shape[0] if a.ndim else 1 for a in arrs)
+        assert total >= 2  # batches came back
