@@ -47,6 +47,9 @@ from . import static  # noqa: E402
 from . import incubate  # noqa: E402
 from . import metric  # noqa: E402
 from . import callbacks  # noqa: E402
+from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
 from .framework import io as _framework_io  # noqa: E402
 from .framework.io import save, load  # noqa: E402
 from .hapi.model import Model  # noqa: E402

@@ -1,0 +1,140 @@
+"""Native runtime components (reference: the C++ side of paddle's loader/
+memory stack — ``paddle/fluid/memory/allocation/mmap_allocator.cc`` †,
+``paddle/fluid/operators/reader/buffered_reader.cc`` †).
+
+Compiled on first use with the in-image g++ (no pybind11: plain C ABI +
+ctypes). Import never fails — ``available()`` reports whether the native
+path is usable, callers fall back to pure-Python transports.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_shm_ring.so")
+_SRC = os.path.join(_HERE, "shm_ring.cpp")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO) or
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int64]
+        lib.shm_ring_pop.restype = ctypes.c_int64
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int64]
+        lib.shm_ring_peek_len.restype = ctypes.c_int64
+        lib.shm_ring_peek_len.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_used.restype = ctypes.c_uint64
+        lib.shm_ring_used.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_mark_closed.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_is_closed.restype = ctypes.c_int
+        lib.shm_ring_is_closed.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ShmRing:
+    """SPSC shared-memory ring: create() on the consumer side, open() in
+    the producer process (by name)."""
+
+    def __init__(self, handle, lib, name, owner):
+        self._h = handle
+        self._lib = lib
+        self.name = name
+        self._owner = owner
+
+    @classmethod
+    def create(cls, name: str, capacity: int = 1 << 22):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shm_ring unavailable (no g++?)")
+        h = lib.shm_ring_create(name.encode(), capacity)
+        if not h:
+            raise OSError(f"shm_ring_create({name}) failed")
+        return cls(h, lib, name, owner=True)
+
+    @classmethod
+    def open(cls, name: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shm_ring unavailable")
+        h = lib.shm_ring_open(name.encode())
+        if not h:
+            raise OSError(f"shm_ring_open({name}) failed")
+        return cls(h, lib, name, owner=False)
+
+    def push(self, payload: bytes, timeout_ms: int = -1) -> bool:
+        rc = self._lib.shm_ring_push(self._h, payload, len(payload),
+                                     timeout_ms)
+        if rc == -2:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds ring capacity")
+        return rc == 0
+
+    def pop(self, timeout_ms: int = -1):
+        """Returns bytes, None on timeout, or raises EOFError when the
+        producer marked the ring closed and it drained."""
+        n = self._lib.shm_ring_peek_len(self._h)
+        size = max(int(n), 1 << 16)
+        buf = ctypes.create_string_buffer(size)
+        rc = self._lib.shm_ring_pop(self._h, buf, size, timeout_ms)
+        while rc == -2:  # raced a bigger message in: regrow
+            size *= 4
+            buf = ctypes.create_string_buffer(size)
+            rc = self._lib.shm_ring_pop(self._h, buf, size, timeout_ms)
+        if rc == -1:
+            return None
+        if rc == -3:
+            raise EOFError("producer closed")
+        return buf.raw[:rc]
+
+    def used(self) -> int:
+        return int(self._lib.shm_ring_used(self._h))
+
+    def mark_closed(self):
+        self._lib.shm_ring_mark_closed(self._h)
+
+    def close(self, unlink=None):
+        if self._h:
+            self._lib.shm_ring_close(
+                self._h, 1 if (self._owner if unlink is None else unlink)
+                else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close(unlink=False)
+        except Exception:
+            pass

@@ -1,0 +1,23 @@
+"""paddle.distribution — probability distributions (reference:
+``python/paddle/distribution/`` — Distribution base + Normal/Uniform/
+Categorical/Bernoulli/Beta/Dirichlet/Gamma/Exponential/Laplace/LogNormal/
+Multinomial/Gumbel + ``kl_divergence`` registry + transforms).
+
+TPU-native: sampling draws keys from the framework generator
+(``core.random.next_key``) and lowers to ``jax.random`` primitives —
+counter-based, reproducible under jit, vmap-safe — instead of the
+reference's stateful cuRAND ops. log_prob/entropy are pure jnp and fuse
+into surrounding programs.
+"""
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Distribution, Exponential, Gamma, Geometric,
+                            Gumbel, Laplace, LogNormal, Multinomial, Normal,
+                            Poisson, StudentT, Uniform)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
+    "Multinomial", "Gumbel", "Geometric", "Poisson", "StudentT",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,556 @@
+"""Distribution classes (reference: ``python/paddle/distribution/*.py`` —
+each class mirrors the reference's constructor/sample/rsample/log_prob/
+entropy/mean/variance surface; math is standard, implementation is pure
+jax.random/jnp)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype") else jnp.asarray(x)
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """Base class (reference ``distribution.py``)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        with jax.ensure_compile_time_eval():
+            pass
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_val(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return random_mod.next_key()
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        eps = jax.random.normal(self._key(), shp)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(out, self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2,
+                                      self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                      self._batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(self._key(), shp)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self._batch_shape))
+
+
+class Categorical(Distribution):
+    """Categorical over logits (reference accepts logits tensor)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _val(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_val(probs), 1e-38))
+        self._log_norm = self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jnp.exp(self._log_norm))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.categorical(self._key(), self.logits,
+                                            shape=shp))
+
+    rsample = sample  # discrete; kept for surface parity (not reparam'd)
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        ln = self._log_norm
+        if ln.ndim == 1:  # batchless dist queried with a batch of values
+            ln = jnp.broadcast_to(ln, v.shape + ln.shape[-1:])
+        return _wrap(jnp.take_along_axis(ln, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_norm)
+        return _wrap(-jnp.sum(p * self._log_norm, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_val(probs), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.bernoulli(self._key(), self.probs_,
+                                          shape=shp).astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(v * jnp.log(self.probs_) +
+                     (1 - v) * jnp.log1p(-self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.beta(self._key(), self.alpha, self.beta, shp))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha) +
+                 jax.scipy.special.gammaln(self.beta) -
+                 jax.scipy.special.gammaln(self.alpha + self.beta))
+        return _wrap((self.alpha - 1) * jnp.log(v) +
+                     (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return _wrap(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                     + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / jnp.sum(c, -1, keepdims=True))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.dirichlet(self._key(), self.concentration,
+                                          shp))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        c = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(c), -1) -
+                 jax.scipy.special.gammaln(jnp.sum(c, -1)))
+        return _wrap(jnp.sum((c - 1) * jnp.log(v), -1) - lnorm)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        g = jax.random.gamma(self._key(), self.concentration, shp)
+        return _wrap(g / self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        a, r = self.concentration, self.rate
+        return _wrap(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v -
+                     jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        dg = jax.scipy.special.digamma
+        return _wrap(a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                     + (1 - a) * dg(a))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.exponential(self._key(), shp) / self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale ** 2,
+                                      self._batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(self.loc + self.scale *
+                     jax.random.laplace(self._key(), shp))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale -
+                     jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                      self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_val(self._normal.rsample(shape))))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        lv = jnp.log(v)
+        return _wrap(_val(self._normal.log_prob(lv)) - lv)
+
+    def entropy(self):
+        return _wrap(_val(self._normal.entropy()) + self.loc)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _val(probs)
+        self.probs_ = self.probs_ / jnp.sum(self.probs_, -1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        logits = jnp.log(jnp.clip(self.probs_, 1e-38))
+        draws = jax.random.categorical(
+            self._key(), logits, shape=(self.total_count,) + shp)
+        K = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, K).sum(0)
+        return _wrap(counts)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        gl = jax.scipy.special.gammaln
+        return _wrap(gl(jnp.asarray(self.total_count + 1.0))
+                     - jnp.sum(gl(v + 1.0), -1)
+                     + jnp.sum(v * jnp.log(jnp.clip(self.probs_, 1e-38)), -1))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc + self.scale * 0.5772156649015329, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self._batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(self.loc + self.scale *
+                     jax.random.gumbel(self._key(), shp))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(self.scale) + 1.5772156649015329, self._batch_shape))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_val(probs), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs_) / self.probs_)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs_) / self.probs_ ** 2)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(self._key(), shp, minval=1e-7, maxval=1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return _wrap(jax.random.poisson(self._key(), self.rate, shp)
+                     .astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate -
+                     jax.scipy.special.gammaln(v + 1.0))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _val(df)
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.where(self.df > 1,
+                               jnp.broadcast_to(self.loc, self._batch_shape),
+                               jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.df / (self.df - 2), jnp.inf)
+        return _wrap(jnp.broadcast_to(self.scale ** 2 * v,
+                                      self._batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        t = jax.random.t(self._key(), self.df, shp)
+        return _wrap(self.loc + self.scale * t)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        gl = jax.scipy.special.gammaln
+        df = self.df
+        return _wrap(gl((df + 1) / 2) - gl(df / 2)
+                     - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                     - (df + 1) / 2 * jnp.log1p(z ** 2 / df))

@@ -1,0 +1,107 @@
+"""kl_divergence dispatch (reference: ``python/paddle/distribution/kl.py``
+— a (type, type) registry with closed-form KLs, falling back to
+Monte-Carlo)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Distribution, Exponential, Gamma, Laplace,
+                            Normal, Uniform)
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return Tensor(jnp.asarray(fn(p, q)))
+    # Monte-Carlo fallback: E_p[log p - log q]
+    x = p.sample((256,))
+    lp = p.log_prob(x).value
+    lq = q.log_prob(x).value
+    return Tensor(jnp.mean(lp - lq, axis=0))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pl = p._log_norm
+    ql = q._log_norm
+    return jnp.sum(jnp.exp(pl) * (pl - ql), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs_, q.probs_
+    return (a * (jnp.log(a) - jnp.log(b)) +
+            (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # finite only if support(p) ⊆ support(q)
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log(q.high - q.low) - jnp.log(p.high - p.low)
+    return jnp.where(inside, kl, jnp.inf)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    a1, r1, a2, r2 = p.concentration, p.rate, q.concentration, q.rate
+    return ((a1 - a2) * dg(a1) - gl(a1) + gl(a2)
+            + a2 * (jnp.log(r1) - jnp.log(r2)) + a1 * (r2 - r1) / r1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + (p.scale * jnp.exp(-d / p.scale) + d) / q.scale - 1.0)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    s1 = a1 + b1
+    return (gl(s1) - gl(a1) - gl(b1) - gl(a2 + b2) + gl(a2) + gl(b2)
+            + (a1 - a2) * (dg(a1) - dg(s1))
+            + (b1 - b2) * (dg(b1) - dg(s1)))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    c1, c2 = p.concentration, q.concentration
+    s1 = jnp.sum(c1, -1)
+    return (gl(s1) - jnp.sum(gl(c1), -1) - gl(jnp.sum(c2, -1))
+            + jnp.sum(gl(c2), -1)
+            + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))

@@ -41,10 +41,34 @@ def get_worker_info():
 
 
 def _mp_worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
-                    num_workers, worker_init_fn):
+                    num_workers, worker_init_fn, ring_name=None):
     """Worker-process main (reference ``worker.py::_worker_loop``): pull
-    (task_id, indices), fetch+collate, push (task_id, batch, error)."""
+    (task_id, indices), fetch+collate, push (task_id, batch, error).
+
+    With ``ring_name``, results travel through the native shared-memory
+    ring (paddle_tpu.csrc.ShmRing — one memcpy into the mmap'd segment)
+    instead of being pickled through the mp.Queue pipe."""
+    import pickle
+
     _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    ring = None
+    if ring_name is not None:
+        try:
+            from ..csrc import ShmRing
+            ring = ShmRing.open(ring_name)
+        except Exception:
+            ring = None  # fall back to the queue
+
+    def emit(rec):
+        if ring is not None:
+            try:
+                ring.push(pickle.dumps(rec,
+                                       protocol=pickle.HIGHEST_PROTOCOL))
+                return
+            except ValueError:
+                pass  # record larger than the ring: use the queue
+        result_queue.put(rec)
+
     try:
         if worker_init_fn is not None:
             worker_init_fn(wid)
@@ -55,13 +79,16 @@ def _mp_worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
             task_id, indices = task
             try:
                 batch = collate_fn([dataset[i] for i in indices])
-                result_queue.put((task_id, batch, None))
+                emit((task_id, batch, None))
             except Exception as e:  # noqa: BLE001 — propagated to parent
-                result_queue.put(
-                    (task_id, None,
-                     f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+                emit((task_id, None,
+                      f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
     except KeyboardInterrupt:
         pass
+    finally:
+        if ring is not None:
+            ring.mark_closed()
+            ring.close(unlink=False)
 
 
 def default_collate_fn(batch):
@@ -107,6 +134,7 @@ class DataLoader:
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
         self.timeout = float(timeout)
+        self.use_shared_memory = bool(use_shared_memory)
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be 'thread' or 'process', "
                              f"got {worker_mode!r}")
@@ -209,11 +237,29 @@ class DataLoader:
         index_q = ctx.Queue()
         result_q = ctx.Queue()
         nw = self.num_workers
+        # native shared-memory result transport (one ring per worker) when
+        # use_shared_memory and the csrc module builds; else the mp.Queue
+        rings = []
+        ring_names = [None] * nw
+        if self.use_shared_memory:
+            try:
+                from ..csrc import ShmRing, available
+                if available():
+                    import uuid
+                    tag = uuid.uuid4().hex[:12]
+                    for wid in range(nw):
+                        name = f"/pt_dl_{os.getpid()}_{tag}_{wid}"
+                        rings.append(ShmRing.create(name, 1 << 23))
+                        ring_names[wid] = name
+            except Exception:
+                for r in rings:
+                    r.close(unlink=True)
+                rings, ring_names = [], [None] * nw
         workers = [
             ctx.Process(
                 target=_mp_worker_loop,
                 args=(self.dataset, index_q, result_q, self.collate_fn, wid,
-                      nw, self.worker_init_fn),
+                      nw, self.worker_init_fn, ring_names[wid]),
                 daemon=True)
             for wid in range(nw)]
         # workers are host-side data producers: pin them to the CPU jax
@@ -237,6 +283,32 @@ class DataLoader:
         batches = list(self.batch_sampler)
         depth = min(nw * self.prefetch_factor, len(batches))
         poll_s = self.timeout if self.timeout > 0 else 5.0
+
+        def result_get():
+            """One (tid, batch, err) record; raises queue.Empty after
+            poll_s. With rings active the queue is polled too — a worker
+            falls back per-record when its ring can't take a message (open
+            failure, oversized batch)."""
+            if not rings:
+                return result_q.get(timeout=poll_s)
+            import pickle
+            import time as time_mod
+            deadline = time_mod.monotonic() + poll_s
+            while True:
+                for r in rings:
+                    try:
+                        data = r.pop(timeout_ms=20)
+                    except EOFError:
+                        continue  # that worker finished and hung up
+                    if data is not None:
+                        return pickle.loads(data)
+                try:
+                    return result_q.get_nowait()
+                except queue.Empty:
+                    pass
+                if time_mod.monotonic() > deadline:
+                    raise queue.Empty
+
         try:
             for i in range(depth):
                 index_q.put((i, batches[i]))
@@ -253,7 +325,7 @@ class DataLoader:
                     yield _to_tensor_batch(batch)
                     continue
                 try:
-                    tid, batch, err = result_q.get(timeout=poll_s)
+                    tid, batch, err = result_get()
                 except queue.Empty:
                     dead = [w.pid for w in workers if not w.is_alive()]
                     if dead:
@@ -279,3 +351,5 @@ class DataLoader:
                 w.join(timeout=2.0)
                 if w.is_alive():
                     w.terminate()
+            for r in rings:
+                r.close(unlink=True)

@@ -1,0 +1,241 @@
+"""paddle.quantization — QAT/PTQ (reference: ``python/paddle/quantization/``
+— QuantConfig + QAT.quantize (fake-quant insertion) + PTQ.quantize
+(observers) + convert).
+
+TPU-native: fake-quant is a pure jnp op with a straight-through-estimator
+custom VJP — it fuses into the surrounding XLA program (no special kernels;
+int8 inference on TPU is a matter of emitting int8 dots, which `convert`
+models by baking quantized-dequantized weights). Observers are functional
+state on the layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops._op import tensor_op
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quanted_linear",
+           "fake_quant", "FakeQuanterWithAbsMaxObserver", "QuantedLinear"]
+
+
+# ------------------------------------------------------------- fake quant
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8) / qmax
+    return jnp.clip(jnp.round(x / s), -qmax - 1, qmax) * s
+
+
+def _fq_fwd(x, scale, bits):
+    return _fake_quant_ste(x, scale, bits), (x, scale, bits)
+
+
+def _fq_bwd(res, g):
+    x, scale, bits = res
+    qmax = 2.0 ** (bits - 1) - 1
+    lim = jnp.maximum(scale, 1e-8)
+    # straight-through inside the clip range, zero outside
+    pass_thru = (jnp.abs(x) <= lim).astype(g.dtype)
+    return g * pass_thru, jnp.zeros_like(scale), None
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+@tensor_op
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with STE gradient (reference
+    FakeQuanterWithAbsMaxObserver forward)."""
+    return _fake_quant_ste(x, jnp.asarray(scale, jnp.float32), int(bits))
+
+
+class FakeQuanterWithAbsMaxObserver(nn.Layer):
+    """Activation quanter: tracks a running absmax, fake-quants with STE
+    (reference ``paddle.quantization.quanters.FakeQuanterWithAbsMaxObserver``)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = float(moving_rate)
+        self.bits = int(bit_length)
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self._seen = False
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x.value)).astype(jnp.float32)
+        if self.training:
+            m = self.moving_rate
+            prev = self.scale.value
+            new = jnp.where(jnp.asarray(self._seen), m * prev + (1 - m) * cur,
+                            cur)
+            self.scale.set_value(new)
+            self._seen = True
+        return fake_quant(x, self.scale.value, self.bits)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quanted weight + activation (QAT execution form)."""
+
+    def __init__(self, linear: nn.Linear, q_config):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.weight_bits = q_config.weight_bits
+        self.act_quanter = (FakeQuanterWithAbsMaxObserver(
+            bit_length=q_config.activation_bits)
+            if q_config.activation_bits else None)
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.weight
+        wq = fake_quant(w, jnp.max(jnp.abs(w.value)), self.weight_bits)
+        from ..nn import functional as F
+        return F.linear(x, wq, self.bias)
+
+
+# ------------------------------------------------------------- observers
+class AbsmaxObserver(nn.Layer):
+    """PTQ observer: records absmax over calibration batches (reference
+    ``paddle.quantization.observers.AbsmaxObserver``)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.bits = int(quant_bits)
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x.value)).astype(jnp.float32)
+        self.scale.set_value(jnp.maximum(self.scale.value, cur))
+        return x
+
+
+class ObservedLinear(nn.Layer):
+    def __init__(self, linear: nn.Linear, q_config):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.observer = AbsmaxObserver(q_config.activation_bits or 8)
+        self.weight_bits = q_config.weight_bits
+
+    def forward(self, x):
+        x = self.observer(x)
+        from ..nn import functional as F
+        return F.linear(x, self.weight, self.bias)
+
+
+class ConvertedLinear(nn.Layer):
+    """Inference form: weights stored int8 + scale, dequantized on the fly
+    (on TPU the int8 weight halves HBM traffic; XLA emits the dequant as a
+    fused convert on the way into the MXU)."""
+
+    def __init__(self, weight, bias, weight_bits=8):
+        super().__init__()
+        qmax = 2.0 ** (weight_bits - 1) - 1
+        w = weight.value
+        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
+        self.register_buffer("qweight",
+                             Tensor(jnp.clip(jnp.round(w / scale),
+                                             -qmax - 1, qmax)
+                                    .astype(jnp.int8)))
+        self.register_buffer("w_scale", Tensor(scale))
+        self.bias = bias
+
+    def forward(self, x):
+        w = self.qweight.value.astype(jnp.float32) * self.w_scale.value
+        from ..nn import functional as F
+        return F.linear(x, Tensor(w), self.bias)
+
+
+# ------------------------------------------------------------- config/API
+class QuantConfig:
+    """Reference ``paddle.quantization.QuantConfig`` (subset): global
+    weight/activation quanter settings."""
+
+    def __init__(self, activation=None, weight=None, weight_bits=8,
+                 activation_bits=8):
+        self.activation = activation
+        self.weight = weight
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits) if activation_bits else 0
+        self._types = (nn.Linear,)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types = tuple(layer_types)
+        return self
+
+
+def _swap_matching(model, match_fn, factory):
+    """Replace sublayers where match_fn(child); skips subtrees of already-
+    replaced layers (their old child paths no longer resolve)."""
+    replaced = []
+    for name, _ in list(model.named_sublayers()):
+        if any(name.startswith(r + ".") for r in replaced):
+            continue
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        leaf = parts[-1]
+        child = getattr(parent, leaf)
+        if match_fn(child):
+            setattr(parent, leaf, factory(child))
+            replaced.append(name)
+    return model
+
+
+def _swap_layers(model, cfg, factory):
+    return _swap_matching(
+        model,
+        lambda child: isinstance(child, nn.Linear) and not isinstance(
+            child, (QuantedLinear, ObservedLinear, ConvertedLinear)),
+        lambda child: factory(child, cfg))
+
+
+class QAT:
+    """Quantization-aware training driver (reference ``paddle.quantization.QAT``)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.cfg = q_config
+
+    def quantize(self, model, inplace=False):
+        return _swap_layers(model, self.cfg,
+                            lambda lin, cfg: QuantedLinear(lin, cfg))
+
+    def convert(self, model, inplace=False):
+        return _swap_layers(
+            model, self.cfg,
+            lambda lin, cfg: lin)  # QuantedLinear already executes quantized
+
+
+class PTQ:
+    """Post-training quantization driver (reference ``paddle.quantization.PTQ``)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.cfg = q_config
+
+    def quantize(self, model, inplace=False):
+        return _swap_layers(model, self.cfg,
+                            lambda lin, cfg: ObservedLinear(lin, cfg))
+
+    def convert(self, model, inplace=False):
+        return _swap_matching(
+            model,
+            lambda child: isinstance(child, ObservedLinear),
+            lambda child: ConvertedLinear(child.weight, child.bias,
+                                          self.cfg.weight_bits))
+
+
+def quanted_linear(x, weight, bias=None, w_bits=8, a_scale=None, a_bits=8):
+    """Functional QAT linear."""
+    if a_scale is not None:
+        x = fake_quant(x, a_scale, a_bits)
+    wq = fake_quant(weight, jnp.max(jnp.abs(weight.value)), w_bits)
+    from ..nn import functional as F
+    return F.linear(x, wq, bias)

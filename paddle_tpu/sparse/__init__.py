@@ -1,0 +1,263 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops (reference:
+``python/paddle/sparse/`` — SparseCooTensor/SparseCsrTensor creation,
+unary/binary ops, matmul, masked_matmul, nn.ReLU).
+
+TPU-native: backed by ``jax.experimental.sparse.BCOO`` — static-nnz batched
+COO, the formulation XLA can compile (gather/scatter/segment-sum on the
+MXU-adjacent VPU) — rather than the reference's cuSPARSE handles. CSR
+creation converts to BCOO internally; ``crows/cols/values`` views are
+recomputed on demand.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._op import tensor_op
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "relu", "abs", "sin", "tanh",
+    "sqrt", "pow", "neg", "cast", "transpose", "sum", "nn",
+]
+
+
+def _val(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over a BCOO core. ``indices`` is [ndim, nnz]
+    (paddle layout), ``values`` [nnz, ...]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    # ------------------------------------------------------------ conversion
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(self._bcoo)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other):
+        return add(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (reference SparseCsrTensor): same BCOO core, crows/cols
+    recomputed on demand for 2D (or batched-2D) tensors."""
+
+    def crows(self):
+        idx = self._bcoo.indices  # [nnz, 2]
+        rows = idx[:, 0]
+        n_rows = self.shape[-2]
+        counts = jnp.bincount(rows, length=n_rows)
+        return Tensor(jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                                       jnp.cumsum(counts)]))
+
+    def cols(self):
+        order = jnp.lexsort((self._bcoo.indices[:, 1],
+                             self._bcoo.indices[:, 0]))
+        return Tensor(self._bcoo.indices[order, 1])
+
+    def values(self):
+        order = jnp.lexsort((self._bcoo.indices[:, 1],
+                             self._bcoo.indices[:, 0]))
+        return Tensor(self._bcoo.data[order])
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = np.asarray(_val(indices))          # [ndim, nnz]
+    vals = jnp.asarray(_val(values))
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        vals = vals.astype(dtype_mod.to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = np.asarray(_val(crows))
+    cols = np.asarray(_val(cols))
+    vals = jnp.asarray(_val(values))
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        vals = vals.astype(dtype_mod.to_jax_dtype(dtype))
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.stack([jnp.asarray(rows), jnp.asarray(cols)], axis=1)
+    bcoo = jsparse.BCOO((vals, idx), shape=tuple(shape))
+    return SparseCsrTensor(bcoo)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _to_sparse(t: Tensor, kind="coo"):
+    bcoo = jsparse.BCOO.fromdense(_val(t))
+    return SparseCooTensor(bcoo) if kind == "coo" else SparseCsrTensor(bcoo)
+
+
+# patched onto dense Tensor by paddle parity: paddle.Tensor.to_sparse_coo
+def to_sparse_coo(t, sparse_dim=None):
+    return _to_sparse(t, "coo")
+
+
+def to_sparse_csr(t):
+    return _to_sparse(t, "csr")
+
+
+# ---------------------------------------------------------------- elementwise
+def _unary(fn, keep_zero=True):
+    def op(x: SparseCooTensor):
+        b = x._bcoo
+        return type(x)(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+abs = _unary(jnp.abs)  # noqa: A001 — paddle name
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as dtype_mod
+    b = x._bcoo
+    data = b.data if value_dtype is None else \
+        b.data.astype(dtype_mod.to_jax_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtype_mod.to_jax_dtype(index_dtype))
+    return type(x)(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def _binary(fn):
+    def op(x, y):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            out = fn(x._bcoo.todense(), y._bcoo.todense())
+            return type(x)(jsparse.BCOO.fromdense(out))
+        if isinstance(x, SparseCooTensor):
+            return Tensor(fn(x._bcoo.todense(), _val(y)))
+        return Tensor(fn(_val(x), y._bcoo.todense()))
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    out = jnp.sum(x._bcoo.todense(), axis=axis, keepdims=keepdim)
+    return Tensor(out)
+
+
+def transpose(x, perm):
+    b = x._bcoo
+    # BCOO transpose: permute index columns + shape
+    idx = b.indices[:, jnp.asarray(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return type(x)(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+# ------------------------------------------------------------------ matmul
+def matmul(x, y):
+    """sparse @ dense (and dense @ sparse) — lowers to XLA gather/
+    segment-sum via bcoo_dot_general (the TPU answer to cuSPARSE spmm)."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        out = jsparse.bcoo_dot_general(
+            x._bcoo, _val(y),
+            dimension_numbers=(((x._bcoo.ndim - 1,), (0,)), ((), ())))
+        return Tensor(out)
+    if isinstance(y, SparseCooTensor) and not isinstance(x, SparseCooTensor):
+        # dense @ sparse = (sparse.T @ dense.T).T
+        yt = transpose(y, list(range(y._bcoo.ndim - 2)) +
+                       [y._bcoo.ndim - 1, y._bcoo.ndim - 2])
+        xt = jnp.swapaxes(_val(x), -1, -2)
+        out = jsparse.bcoo_dot_general(
+            yt._bcoo, xt,
+            dimension_numbers=(((yt._bcoo.ndim - 1,), (0,)), ((), ())))
+        return Tensor(jnp.swapaxes(out, -1, -2))
+    # sparse @ sparse: densify the smaller operand
+    return Tensor(x._bcoo.todense() @ y._bcoo.todense())
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """(x @ y) sampled at mask's sparsity pattern (reference SDDMM)."""
+    xv, yv = _val(x), _val(y)
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = xv[idx[:, 0]]          # [nnz, K]
+    cols = yv[:, idx[:, 1]].T     # [nnz, K]
+    vals = jnp.sum(rows * cols, axis=-1)
+    return type(mask)(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _nn:
+    ReLU = _SparseReLU
+
+
+nn = _nn()

@@ -59,3 +59,13 @@ class WorkerIdDataset(Dataset):
         from paddle_tpu.io.dataloader import get_worker_info
         info = get_worker_info()
         return np.asarray([i, -1 if info is None else info.id], np.float32)
+
+
+def _ring_producer(name):
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.csrc import ShmRing
+    w = ShmRing.open(name)
+    for i in range(10):
+        w.push(bytes([i]) * 1000)
+    w.close(unlink=False)

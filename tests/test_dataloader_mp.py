@@ -8,7 +8,7 @@ import pytest
 from paddle_tpu.io import DataLoader
 
 from _dl_helpers import (CrashingDataset, RaisingDataset, RangeSquareDataset,
-                         WorkerIdDataset)
+                         WorkerIdDataset, _ring_producer)
 
 
 class TestMultiprocessDataLoader:
@@ -51,3 +51,87 @@ class TestMultiprocessDataLoader:
         flat = np.concatenate([b.numpy() for b in dl])
         np.testing.assert_allclose(
             flat, np.stack([[i, i * i] for i in range(16)]).astype(np.float32))
+
+    def test_shared_memory_ring_transport(self):
+        """Results travel via the native shm ring when available; values and
+        order must be identical to the queue path."""
+        from paddle_tpu.csrc import available
+        if not available():
+            pytest.skip("no native toolchain")
+        ds = RangeSquareDataset(32)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        worker_mode="process", use_shared_memory=True)
+        flat = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_allclose(
+            flat, np.stack([[i, i * i] for i in range(32)]).astype(np.float32))
+
+    def test_queue_fallback_when_shm_disabled(self):
+        ds = RangeSquareDataset(16)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        worker_mode="process", use_shared_memory=False)
+        flat = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_allclose(
+            flat, np.stack([[i, i * i] for i in range(16)]).astype(np.float32))
+
+
+class TestShmRing:
+    """Direct tests of the native SPSC ring (paddle_tpu/csrc/shm_ring.cpp)."""
+
+    def test_roundtrip_and_wraparound(self):
+        from paddle_tpu.csrc import ShmRing, available
+        if not available():
+            pytest.skip("no native toolchain")
+        r = ShmRing.create("/pt_ring_t1", 1 << 16)
+        w = ShmRing.open("/pt_ring_t1")
+        try:
+            for i in range(64):  # total bytes >> capacity: exercises wrap
+                w.push(bytes([i % 256]) * 2900)
+                assert r.pop(2000) == bytes([i % 256]) * 2900
+        finally:
+            w.close(unlink=False)
+            r.close(unlink=True)
+
+    def test_eof_and_timeout(self):
+        from paddle_tpu.csrc import ShmRing, available
+        if not available():
+            pytest.skip("no native toolchain")
+        r = ShmRing.create("/pt_ring_t2", 1 << 14)
+        w = ShmRing.open("/pt_ring_t2")
+        try:
+            assert r.pop(timeout_ms=50) is None  # empty -> timeout
+            w.push(b"last")
+            w.mark_closed()
+            assert r.pop(1000) == b"last"
+            with pytest.raises(EOFError):
+                r.pop(1000)
+        finally:
+            w.close(unlink=False)
+            r.close(unlink=True)
+
+    def test_oversize_message_rejected(self):
+        from paddle_tpu.csrc import ShmRing, available
+        if not available():
+            pytest.skip("no native toolchain")
+        r = ShmRing.create("/pt_ring_t3", 1 << 12)
+        try:
+            with pytest.raises(ValueError):
+                r.push(b"x" * (1 << 13))
+        finally:
+            r.close(unlink=True)
+
+    def test_cross_process(self):
+        """Producer in a real spawned process."""
+        import multiprocessing as mp
+        from paddle_tpu.csrc import ShmRing, available
+        if not available():
+            pytest.skip("no native toolchain")
+        r = ShmRing.create("/pt_ring_t4", 1 << 16)
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_ring_producer, args=("/pt_ring_t4",))
+        p.start()
+        try:
+            got = [r.pop(10000) for _ in range(10)]
+            assert got == [bytes([i]) * 1000 for i in range(10)]
+        finally:
+            p.join(timeout=10)
+            r.close(unlink=True)

@@ -1,0 +1,154 @@
+"""paddle.sparse + paddle.quantization tests (SURVEY §2.2 row 26 — both
+packages were absent). Reference surfaces: ``python/paddle/sparse/`` †,
+``python/paddle/quantization/`` †.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, sparse
+from paddle_tpu.quantization import PTQ, QAT, QuantConfig, fake_quant
+
+
+class TestSparseCoo:
+    def _coo(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+    def test_create_and_dense_roundtrip(self):
+        s = self._coo()
+        d = s.to_dense().numpy()
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(d, expect)
+        assert s.nnz == 3
+        np.testing.assert_array_equal(s.indices().numpy(),
+                                      [[0, 1, 2], [1, 2, 0]])
+
+    def test_csr_views(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+        d = s.to_dense().numpy()
+        expect = np.zeros((3, 4), np.float32)
+        expect[0, 1], expect[0, 3], expect[1, 2] = 1, 2, 3
+        expect[2, 0], expect[2, 1] = 4, 5
+        np.testing.assert_allclose(d, expect)
+        np.testing.assert_array_equal(s.crows().numpy(), crows)
+        np.testing.assert_array_equal(s.cols().numpy(), cols)
+
+    def test_unary_preserves_pattern(self):
+        s = self._coo()
+        r = sparse.relu(sparse.neg(s))
+        assert r.nnz == 3
+        np.testing.assert_allclose(r.to_dense().numpy(), 0.0)
+
+    def test_spmm_matches_dense(self):
+        rng = np.random.RandomState(0)
+        dense = rng.randn(4, 5).astype(np.float32)
+        dense[dense < 0.3] = 0.0
+        s = sparse.to_sparse_coo(paddle.to_tensor(dense))
+        y = rng.randn(5, 6).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(out, dense @ y, rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        mask = sparse.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]],
+                                        [1.0, 1.0, 1.0], shape=[3, 3])
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        d = out.to_dense().numpy()
+        np.testing.assert_allclose(d[0, 1], full[0, 1], rtol=1e-5)
+        np.testing.assert_allclose(d[1, 2], full[1, 2], rtol=1e-5)
+        assert d[0, 0] == 0.0
+
+    def test_add_and_transpose(self):
+        s = self._coo()
+        two = sparse.add(s, s)
+        np.testing.assert_allclose(two.to_dense().numpy(),
+                                   2 * s.to_dense().numpy())
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   s.to_dense().numpy().T)
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        """STE: gradient passes through inside the clip range, zero outside."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.quantization import _fake_quant_ste
+
+        def f(x):
+            return jnp.sum(_fake_quant_ste(x, jnp.float32(1.0), 8))
+
+        x = jnp.asarray([0.5, -0.3, 2.0, -1.5])
+        g = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0, 0.0])
+
+    def test_fake_quant_error_bounded(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(64).astype(np.float32))
+        y = fake_quant(x, float(np.abs(x.numpy()).max()), 8)
+        step = np.abs(x.numpy()).max() / 127
+        assert np.max(np.abs(y.numpy() - x.numpy())) <= step * 0.5 + 1e-6
+
+    def _model(self):
+        paddle.seed(99)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+
+        return M()
+
+    def test_qat_quantize_swaps_and_stays_close(self):
+        from paddle_tpu.quantization import QuantedLinear
+        m = self._model()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 8).astype(np.float32))
+        ref = m(x).numpy()
+        qat = QAT(QuantConfig(weight_bits=8, activation_bits=8))
+        qm = qat.quantize(m)
+        assert isinstance(qm.fc1, QuantedLinear)
+        out = qm(x).numpy()
+        # 8-bit fake quant stays close to the float forward
+        assert np.max(np.abs(out - ref)) < 0.15, np.max(np.abs(out - ref))
+
+    def test_qat_gradients_flow(self):
+        m = self._model()
+        qm = QAT(QuantConfig()).quantize(m)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(4, 8).astype(np.float32))
+        loss = (qm(x) ** 2).mean()
+        loss.backward()
+        assert qm.fc1.weight.grad is not None
+        assert np.any(np.abs(qm.fc1.weight.grad.numpy()) > 0)
+
+    def test_ptq_observe_convert_int8(self):
+        from paddle_tpu.quantization import ConvertedLinear, ObservedLinear
+        m = self._model()
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(16, 8).astype(np.float32))
+        ref = m(x).numpy()
+        ptq = PTQ(QuantConfig(weight_bits=8, activation_bits=8))
+        om = ptq.quantize(m)
+        assert isinstance(om.fc1, ObservedLinear)
+        om(x)  # calibration pass populates observers
+        assert float(om.fc1.observer.scale.numpy()) > 0
+        cm = ptq.convert(om)
+        assert isinstance(cm.fc1, ConvertedLinear)
+        assert cm.fc1.qweight.numpy().dtype == np.int8
+        out = cm(x).numpy()
+        assert np.max(np.abs(out - ref)) < 0.15
