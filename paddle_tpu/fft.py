@@ -1,0 +1,73 @@
+"""paddle.fft — spectral ops (reference: ``python/paddle/fft.py`` wrapping
+the cuFFT/onednn kernels). TPU-native: jnp.fft, which XLA lowers to its
+native FFT HLO on TPU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._op import tensor_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def _mk1(jfn):
+    @tensor_op(name=f"fft.{jfn.__name__}")
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return jfn(x, n=n, axis=axis, norm=_norm(norm))
+    return op
+
+
+def _mk2(jfn):
+    @tensor_op(name=f"fft.{jfn.__name__}")
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+    return op
+
+
+def _mkn(jfn):
+    @tensor_op(name=f"fft.{jfn.__name__}")
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+    return op
+
+
+fft = _mk1(jnp.fft.fft)
+ifft = _mk1(jnp.fft.ifft)
+rfft = _mk1(jnp.fft.rfft)
+irfft = _mk1(jnp.fft.irfft)
+hfft = _mk1(jnp.fft.hfft)
+ihfft = _mk1(jnp.fft.ihfft)
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+@tensor_op(name="fft.fftfreq")
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return jnp.fft.fftfreq(int(n), d=d)
+
+
+@tensor_op(name="fft.rfftfreq")
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return jnp.fft.rfftfreq(int(n), d=d)
+
+
+@tensor_op(name="fft.fftshift")
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@tensor_op(name="fft.ifftshift")
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)

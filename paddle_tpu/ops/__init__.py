@@ -149,5 +149,6 @@ def _coerce_index(idx):
 
 
 from .math import (bitwise_and, bitwise_not, bitwise_or, bitwise_xor, lerp)  # noqa: E402
+from .extra import *  # noqa: E402,F401,F403
 
 _install_tensor_methods()

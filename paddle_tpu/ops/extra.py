@@ -1,0 +1,293 @@
+"""Extra tensor ops closing reference-surface gaps (reference: the long
+tail of ``python/paddle/tensor/{math,linalg,stat,search}.py`` — each op
+here mirrors the reference's signature; bodies are one jnp/lax expression
+so XLA fuses them like any other framework op)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import tensor_op
+
+__all__ = [
+    "inv", "bucketize", "mode", "logaddexp", "copysign", "heaviside",
+    "hypot", "angle", "sinc", "logcumsumexp", "renorm", "diagonal",
+    "nanmean", "nansum", "quantile", "nanquantile", "polar", "deg2rad",
+    "rad2deg", "gcd", "lcm", "vander", "trapezoid", "cdist", "pdist",
+    "cholesky_solve", "multi_dot", "lu", "eigvals", "householder_product",
+    "ldexp", "frexp", "nextafter", "isneginf", "isposinf",
+    "signbit", "combinations", "diag_embed",
+]
+
+
+from .linalg import inverse as inv  # same op, reference exposes both names
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    from .math import searchsorted
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@tensor_op(differentiable=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    # most frequent value along axis (ties -> smallest); run lengths come
+    # from two vmapped searchsorteds over the sorted slices: O(n log n),
+    # no unrolled per-element graph
+    n = x.shape[axis]
+    sx = jnp.moveaxis(jnp.sort(x, axis=axis), axis, -1)
+    flat = sx.reshape(-1, n)
+    hi = jax.vmap(lambda row: jnp.searchsorted(row, row, side="right"))(flat)
+    lo = jax.vmap(lambda row: jnp.searchsorted(row, row, side="left"))(flat)
+    counts = (hi - lo).reshape(sx.shape)
+    best = jnp.argmax(counts, axis=-1)
+    values = jnp.take_along_axis(sx, best[..., None], axis=-1)[..., 0]
+    # index of the LAST occurrence in the original tensor (paddle)
+    eq = jnp.moveaxis(x, axis, -1) == values[..., None]
+    ar = jnp.arange(n)
+    indices = jnp.max(jnp.where(eq, ar, -1), axis=-1)
+    if keepdim:
+        values = jnp.expand_dims(values, axis)
+        indices = jnp.expand_dims(indices, axis)
+    return values, indices
+
+
+@tensor_op
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@tensor_op
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@tensor_op
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@tensor_op
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@tensor_op
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+@tensor_op
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+@tensor_op
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        x = x.astype(dtype_mod.to_jax_dtype(dtype))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    # logaddexp is associative and stable: exact streaming logsumexp
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@tensor_op
+def renorm(x, p, axis, max_norm, name=None):
+    # per-slice p-norm along all dims except `axis`, clipped to max_norm
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@tensor_op
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@tensor_op
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    if (dim1, dim2) not in ((-2, -1), (x.ndim - 1, x.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@tensor_op
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+@tensor_op
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.nansum(x, axis=axis, keepdims=keepdim)
+    from ..core import dtype as dtype_mod
+    if dtype is not None:
+        out = out.astype(dtype_mod.to_jax_dtype(dtype))
+    return out
+
+
+@tensor_op
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@tensor_op
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@tensor_op
+def polar(abs_, angle_, name=None):
+    return abs_ * jnp.exp(1j * angle_.astype(jnp.complex64))
+
+
+@tensor_op
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@tensor_op
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@tensor_op(differentiable=False)
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@tensor_op(differentiable=False)
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+@tensor_op
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@tensor_op
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=1.0 if dx is None else dx,
+                                         axis=axis)
+
+
+@tensor_op
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@tensor_op
+def pdist(x, p=2.0, name=None):
+    n = x.shape[0]
+    i, j = jnp.triu_indices(n, k=1)
+    diff = x[i] - x[j]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@tensor_op
+def cholesky_solve(x, y, upper=False, name=None):
+    # reference: solve A z = x given y = chol(A)
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@tensor_op
+def multi_dot(xs, name=None):
+    out = xs[0]
+    for m in xs[1:]:
+        out = out @ m
+    return out
+
+
+@tensor_op
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1  # LAPACK getrf contract: 1-based pivots
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+@tensor_op
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+@tensor_op
+def householder_product(x, tau, name=None):
+    """Q from LAPACK-style elementary reflectors (geqrf output):
+    H_k = I - tau_k v_k v_k^H (conjugated for complex inputs)."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(q, x.shape[:-2] + (m, m)).copy() \
+        if x.ndim > 2 else q
+    for k in range(n):
+        v = jnp.zeros(x.shape[:-1], x.dtype).at[..., k].set(1.0)
+        v = v.at[..., k + 1:].set(x[..., k + 1:, k])
+        t = tau[..., k]
+        outer = v[..., :, None] * jnp.conj(v)[..., None, :]
+        h = jnp.eye(m, dtype=x.dtype) - t[..., None, None] * outer
+        q = q @ h
+    return q[..., :, :n] if m > n else q
+
+
+@tensor_op
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+@tensor_op
+def frexp(x, name=None):
+    return jnp.frexp(x)
+
+
+@tensor_op
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@tensor_op(differentiable=False)
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+@tensor_op(differentiable=False)
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+@tensor_op(differentiable=False)
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@tensor_op(differentiable=False)
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    import numpy as np
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+    return x[jnp.asarray(idx)]

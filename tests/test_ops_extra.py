@@ -1,0 +1,196 @@
+"""Tests for the extra tensor-op batch + paddle.fft (reference tail of
+``python/paddle/tensor/*`` and ``python/paddle/fft.py``). Oracles: numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestExtraMath:
+    def test_elementwise_pairs(self):
+        x = np.array([-1.5, 2.0, 3.0], np.float32)
+        y = np.array([2.0, -0.5, 4.0], np.float32)
+        np.testing.assert_allclose(paddle.logaddexp(_t(x), _t(y)).numpy(),
+                                   np.logaddexp(x, y), rtol=1e-6)
+        np.testing.assert_allclose(paddle.copysign(_t(x), _t(y)).numpy(),
+                                   np.copysign(x, y))
+        np.testing.assert_allclose(paddle.hypot(_t(x), _t(y)).numpy(),
+                                   np.hypot(x, y), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.heaviside(_t(x), _t(y)).numpy(), np.heaviside(x, y))
+        np.testing.assert_allclose(paddle.sinc(_t(x)).numpy(), np.sinc(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_deg_rad_gcd_lcm(self):
+        np.testing.assert_allclose(paddle.deg2rad(_t([180.0])).numpy(),
+                                   [np.pi], rtol=1e-6)
+        np.testing.assert_allclose(paddle.rad2deg(_t([np.pi])).numpy(),
+                                   [180.0], rtol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.gcd(_t([12, 18]), _t([8, 24])).numpy(), [4, 6])
+        np.testing.assert_array_equal(
+            paddle.lcm(_t([4, 6]), _t([6, 8])).numpy(), [12, 24])
+
+    def test_nan_reductions_and_quantile(self):
+        x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+        np.testing.assert_allclose(paddle.nanmean(_t(x)).numpy(),
+                                   np.nanmean(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.nansum(_t(x), axis=1).numpy(),
+                                   np.nansum(x, 1), rtol=1e-6)
+        y = np.random.RandomState(0).randn(100).astype(np.float32)
+        np.testing.assert_allclose(paddle.quantile(_t(y), 0.25).numpy(),
+                                   np.quantile(y, 0.25), rtol=1e-5)
+        yn = y.copy()
+        yn[::7] = np.nan
+        np.testing.assert_allclose(paddle.nanquantile(_t(yn), 0.5).numpy(),
+                                   np.nanquantile(yn, 0.5), rtol=1e-5)
+
+    def test_logcumsumexp_matches_naive(self):
+        x = np.random.RandomState(1).randn(16).astype(np.float32)
+        got = paddle.logcumsumexp(_t(x), axis=0).numpy()
+        expect = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_renorm_clips_norms(self):
+        x = np.random.RandomState(2).randn(4, 8).astype(np.float32) * 5
+        out = paddle.renorm(_t(x), p=2.0, axis=0, max_norm=1.0).numpy()
+        norms = np.linalg.norm(out, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_misc_float_ops(self):
+        x = np.array([1.5, -2.25], np.float32)
+        m, e = paddle.frexp(_t(x))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x)
+        np.testing.assert_allclose(
+            paddle.ldexp(_t(x), _t([2, 1])).numpy(), [6.0, -4.5])
+        assert paddle.signbit(_t(x)).numpy().tolist() == [False, True]
+        assert paddle.count_nonzero(_t([[0, 1], [2, 0]])).numpy() == 2
+        inf = np.array([np.inf, -np.inf, 1.0], np.float32)
+        assert paddle.isposinf(_t(inf)).numpy().tolist() == [True, False, False]
+        assert paddle.isneginf(_t(inf)).numpy().tolist() == [False, True, False]
+
+
+class TestExtraLinalgSearch:
+    def test_inv_and_cholesky_solve(self):
+        rng = np.random.RandomState(3)
+        a = rng.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(paddle.inv(_t(spd)).numpy(),
+                                   np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+        chol = np.linalg.cholesky(spd).astype(np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        z = paddle.cholesky_solve(_t(b), _t(chol)).numpy()
+        np.testing.assert_allclose(spd @ z, b, rtol=1e-3, atol=1e-3)
+
+    def test_lu_and_eigvals(self):
+        rng = np.random.RandomState(4)
+        a = rng.randn(4, 4).astype(np.float32)
+        lu_mat, piv = paddle.lu(_t(a))
+        assert lu_mat.shape == [4, 4] and piv.shape == [4]
+        # LAPACK getrf contract: pivots are 1-based
+        assert piv.numpy().min() >= 1 and piv.numpy().max() <= 4
+        # reconstruct A = P L U from 1-based pivots
+        l = np.tril(lu_mat.numpy(), -1) + np.eye(4, dtype=np.float32)
+        u = np.triu(lu_mat.numpy())
+        rec = l @ u
+        for i in reversed(range(4)):
+            j = int(piv.numpy()[i]) - 1
+            rec[[i, j]] = rec[[j, i]]
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+        ev = paddle.eigvals(_t(a)).numpy()
+        np.testing.assert_allclose(np.sort(ev.real),
+                                   np.sort(np.linalg.eigvals(a).real),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_multi_dot_and_vander(self):
+        rng = np.random.RandomState(5)
+        ms = [rng.randn(3, 4), rng.randn(4, 5), rng.randn(5, 2)]
+        ms = [m.astype(np.float32) for m in ms]
+        got = paddle.multi_dot([_t(m) for m in ms]).numpy()
+        np.testing.assert_allclose(got, ms[0] @ ms[1] @ ms[2], rtol=1e-5)
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.vander(_t(x), 3).numpy(),
+                                   np.vander(x, 3))
+
+    def test_cdist_pdist(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(5, 3).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        got = paddle.cdist(_t(x), _t(y)).numpy()
+        expect = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+        pd = paddle.pdist(_t(x)).numpy()
+        assert pd.shape == (10,)
+        np.testing.assert_allclose(pd[0], np.linalg.norm(x[0] - x[1]),
+                                   rtol=1e-5)
+
+    def test_bucketize_mode_diagonal(self):
+        edges = np.array([1.0, 3.0, 5.0], np.float32)
+        x = np.array([0.5, 1.0, 4.0, 9.0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.bucketize(_t(x), _t(edges)).numpy(),
+            np.searchsorted(edges, x, side="left"))
+        v, i = paddle.mode(_t(np.array([[1.0, 2.0, 2.0, 3.0]])))
+        assert v.numpy().tolist() == [2.0]
+        assert i.numpy().tolist() == [2]  # last occurrence
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_array_equal(paddle.diagonal(_t(a)).numpy(),
+                                      np.diagonal(a))
+
+    def test_diag_embed_and_trapezoid(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        d = paddle.diag_embed(_t(x)).numpy()
+        np.testing.assert_allclose(d, np.diag(x))
+        y = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        np.testing.assert_allclose(paddle.trapezoid(_t(y)).numpy(),
+                                   np.trapezoid(y) if hasattr(np, "trapezoid")
+                                   else np.trapz(y), rtol=1e-6)
+
+    def test_combinations(self):
+        x = np.array([10.0, 20.0, 30.0], np.float32)
+        c = paddle.combinations(_t(x), 2).numpy()
+        np.testing.assert_allclose(c, [[10, 20], [10, 30], [20, 30]])
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(7).randn(16).astype(np.float32)
+        back = paddle.fft.ifft(paddle.fft.fft(_t(x))).numpy()
+        np.testing.assert_allclose(back.real, x, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.RandomState(8).randn(32).astype(np.float32)
+        got = paddle.fft.rfft(_t(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfft(x).astype(np.complex64),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(9).randn(8, 8).astype(np.float32)
+        got = paddle.fft.fft2(_t(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.fft2(x).astype(np.complex64),
+                                   rtol=1e-3, atol=1e-3)
+        sh = paddle.fft.fftshift(_t(x)).numpy()
+        np.testing.assert_allclose(sh, np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+
+    def test_fft_grad_flows(self):
+        """Spectral loss is differentiable w.r.t. the real input."""
+        import jax
+        import jax.numpy as jnp
+
+        def loss(v):
+            return jnp.sum(jnp.abs(paddle.fft.rfft(
+                paddle.to_tensor(v)).value) ** 2)
+
+        x = np.random.RandomState(10).randn(16).astype(np.float32)
+        g = jax.grad(loss)(x)
+        # Parseval: d/dx sum|X|^2 ~ 2*N*x-ish; just require nonzero finite
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert np.any(np.abs(np.asarray(g)) > 0)
