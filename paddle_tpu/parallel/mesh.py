@@ -127,13 +127,20 @@ def new_group(ranks=None, backend=None, timeout=None) -> Group:
     mesh = ensure_mesh()
     if ranks is None or len(ranks) == jax.device_count():
         return Group(mesh, tuple(mesh.axis_names), ranks=ranks, pg_name="world")
-    # axis-aligned subgroup: find an axis whose size matches and assume
-    # alignment (fleet topology always produces aligned groups)
-    for a in mesh.axis_names:
-        if mesh.shape[a] == len(ranks):
-            return Group(mesh, (a,), ranks=list(ranks))
+    # axis-aligned subgroup: bind to the axis whose SLICES actually contain
+    # this rank set (size alone mis-binds when two axes share a size)
+    rank_of = {d.id: i for i, d in enumerate(jax.devices())}
+    rank_arr = np.vectorize(lambda d: rank_of[d.id])(mesh.devices)
+    want = set(int(r) for r in ranks)
+    for ai, a in enumerate(mesh.axis_names):
+        if mesh.shape[a] != len(ranks):
+            continue
+        cols = np.moveaxis(rank_arr, ai, 0).reshape(mesh.shape[a], -1)
+        for c in range(cols.shape[1]):
+            if set(cols[:, c].tolist()) == want:
+                return Group(mesh, (a,), ranks=list(ranks))
     raise ValueError(
-        f"new_group: rank set {ranks} is not axis-aligned with mesh "
+        f"new_group: rank set {ranks} is not an axis-aligned slice of mesh "
         f"{dict(mesh.shape)}; build the hybrid mesh via fleet.init with "
         f"matching degrees")
 

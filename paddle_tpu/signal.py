@@ -1,0 +1,143 @@
+"""paddle.signal — STFT/ISTFT (reference: ``python/paddle/signal.py`` over
+the frame/overlap_add ops). TPU-native: framing is a gather, the FFT is
+XLA's native HLO; everything fuses under jit."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops._op import tensor_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+@tensor_op
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference paddle.signal.frame):
+    axis=-1 -> [..., frame_length, num_frames];
+    axis=0  -> [num_frames, frame_length, ...]."""
+    last = axis in (-1, x.ndim - 1)
+    if not last:
+        if axis not in (0,):
+            raise ValueError("frame: axis must be 0 or -1 (paddle contract)")
+        x = jnp.moveaxis(x, 0, -1)
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = x[..., idx]  # [..., num_frames, frame_length]
+    if last:
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+    return jnp.moveaxis(out, (-2, -1), (0, 1))  # [num, frame_length, ...]
+
+
+@tensor_op
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference paddle.signal.overlap_add):
+    axis=-1: [..., frame_length, num_frames] -> [..., out_len];
+    axis=0:  [num_frames, frame_length, ...] -> [out_len, ...]."""
+    last = axis in (-1, x.ndim - 1)
+    if not last:
+        if axis != 0:
+            raise ValueError("overlap_add: axis must be 0 or -1")
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))  # -> [..., fl, num]
+    fl, num = x.shape[-2], x.shape[-1]
+    out_len = (num - 1) * hop_length + fl
+    frames = jnp.swapaxes(x, -1, -2)  # [..., num, fl]
+
+    def body(i, acc):
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc,
+            jax.lax.dynamic_slice_in_dim(
+                acc, i * hop_length, fl, -1) + frames[..., i, :],
+            i * hop_length, -1)
+
+    acc = jnp.zeros(frames.shape[:-2] + (out_len,), x.dtype)
+    out = jax.lax.fori_loop(0, num, body, acc)
+    return out if last else jnp.moveaxis(out, -1, 0)
+
+
+def _window_arr(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    from .core.tensor import Tensor
+    w = window.value if isinstance(window, Tensor) else jnp.asarray(window)
+    return w.astype(dtype)
+
+
+@tensor_op
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference paddle.signal.stft):
+    returns [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    w = _window_arr(window, wl, jnp.float32)
+    if wl < n_fft:  # center-pad the window to n_fft
+        pad = (n_fft - wl) // 2
+        w = jnp.pad(w, (pad, n_fft - wl - pad))
+    if center:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                    mode=pad_mode)
+    n = x.shape[-1]
+    num = 1 + (n - n_fft) // hop
+    starts = jnp.arange(num) * hop
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = x[..., idx] * w  # [..., num, n_fft]
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.float32(n_fft))
+    return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+
+@tensor_op
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with windowed overlap-add and COLA normalization."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    w = _window_arr(window, wl, jnp.float32)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        w = jnp.pad(w, (pad, n_fft - wl - pad))
+    if return_complex and onesided:
+        raise ValueError("istft: return_complex=True requires "
+                         "onesided=False (a complex signal has no "
+                         "conjugate-symmetric spectrum)")
+    spec = jnp.swapaxes(x, -1, -2)  # [..., frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.float32(n_fft))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * w
+    num = frames.shape[-2]
+    out_len = (num - 1) * hop + n_fft
+
+    def ola(sig_frames):
+        acc = jnp.zeros(sig_frames.shape[:-2] + (out_len,), sig_frames.dtype)
+
+        def body(i, a):
+            cur = jax.lax.dynamic_slice_in_dim(a, i * hop, n_fft, -1)
+            return jax.lax.dynamic_update_slice_in_dim(
+                a, cur + sig_frames[..., i, :], i * hop, -1)
+
+        return jax.lax.fori_loop(0, num, body, acc)
+
+    sig = ola(frames)
+    # COLA normalization: divide by the summed squared window envelope
+    wsq = jnp.broadcast_to(w * w, (num, n_fft))
+    env = ola(wsq.reshape((1,) * (frames.ndim - 2) + (num, n_fft))
+              if frames.ndim > 2 else wsq)
+    sig = sig / jnp.maximum(env, 1e-8)
+    if center:
+        sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

@@ -194,3 +194,53 @@ class TestFFT:
         # Parseval: d/dx sum|X|^2 ~ 2*N*x-ish; just require nonzero finite
         assert np.all(np.isfinite(np.asarray(g)))
         assert np.any(np.abs(np.asarray(g)) > 0)
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_hop_eq_len(self):
+        x = np.arange(16, dtype=np.float32)
+        f = paddle.signal.frame(_t(x), frame_length=4, hop_length=4)
+        assert f.shape == [4, 4]
+        back = paddle.signal.overlap_add(f, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 256).astype(np.float32)
+        win = np.hanning(64).astype(np.float32)
+        spec = paddle.signal.stft(_t(x), n_fft=64, hop_length=16,
+                                  window=_t(win))
+        assert spec.shape == [2, 33, 256 // 16 + 1]
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   window=_t(win), length=256)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
+    def test_stft_matches_manual_dft(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(128).astype(np.float32)
+        spec = paddle.signal.stft(_t(x), n_fft=32, hop_length=8,
+                                  center=False).numpy()
+        # frame 0 == rfft of the first 32 samples (rect window)
+        np.testing.assert_allclose(spec[:, 0], np.fft.rfft(x[:32]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_frame_overlap_add_axis0(self):
+        x = np.arange(32, dtype=np.float32).reshape(16, 2)
+        f = paddle.signal.frame(_t(x), frame_length=4, hop_length=4, axis=0)
+        assert f.shape == [4, 4, 2]  # [num_frames, frame_length, ...]
+        back = paddle.signal.overlap_add(f, hop_length=4, axis=0)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_istft_return_complex_contract(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(128).astype(np.float32)
+        spec = paddle.signal.stft(_t(x), n_fft=32, hop_length=8,
+                                  onesided=False)
+        out = paddle.signal.istft(spec, n_fft=32, hop_length=8,
+                                  onesided=False, return_complex=True,
+                                  length=128)
+        assert np.iscomplexobj(out.numpy())
+        np.testing.assert_allclose(out.numpy().real, x, rtol=1e-3, atol=1e-4)
+        with pytest.raises(ValueError, match="onesided"):
+            paddle.signal.istft(spec, n_fft=32, onesided=True,
+                                return_complex=True)

@@ -457,3 +457,37 @@ class TestPipelineTrainBatch:
                                      opt)
             losses.append(float(loss.value))
         assert losses[-1] < losses[0]
+
+
+class TestNewGroupAxisBinding:
+    """r2 weak 7: new_group must bind to the axis whose SLICES contain the
+    rank set, not just any axis of matching size."""
+
+    def test_same_size_axes_disambiguated(self):
+        import jax
+        from paddle_tpu.parallel import mesh as mesh_mod
+        from paddle_tpu.parallel.mesh import new_group
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        mesh = mesh_mod.get_mesh()
+        rank_of = {d.id: i for i, d in enumerate(jax.devices())}
+        rank_arr = np.vectorize(lambda d: rank_of[d.id])(mesh.devices)
+        flat = rank_arr.squeeze()
+        dp_slice = [int(v) for v in np.moveaxis(flat, 0, 0).reshape(2, -1)[:, 0]]
+        mp_slice = [int(v) for v in np.moveaxis(flat, -1, 0).reshape(2, -1)[:, 0]]
+        assert new_group(dp_slice).axis_names == ("dp",)
+        assert new_group(mp_slice).axis_names == ("mp",)
+
+    def test_non_aligned_set_rejected(self):
+        from paddle_tpu.parallel import mesh as mesh_mod
+        from paddle_tpu.parallel.mesh import new_group
+        mesh_mod._STATE["mesh"] = None
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        with pytest.raises(ValueError, match="axis-aligned"):
+            new_group([0, 7])
