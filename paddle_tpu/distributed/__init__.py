@@ -17,3 +17,5 @@ from ..parallel import launch  # noqa: F401
 from ..parallel.auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from ..parallel import auto_parallel  # noqa: F401
 from . import utils  # noqa: F401
+
+from ..parallel import communication_stream as stream  # noqa: E402

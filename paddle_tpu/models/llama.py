@@ -64,9 +64,11 @@ class LlamaConfig:
     # PipelineParallelWithInterleave). Requires microbatches <= pp degree.
     pipeline_virtual_stages: int = 1
     # "" | "ring" | "ulysses": context parallelism over the 'sep' mesh axis
-    # (parallel.sp_attention). Requires sep>1 in the mesh and (for now)
-    # pp degree 1 — nesting the sep shard_map inside the pipeline's manual
-    # 'pp' region is unsupported.
+    # (parallel.sp_attention). "ring" composes with the pipeline schedule
+    # (the sep shard_map nests inside the manual 'pp' region via the
+    # context AbstractMesh; training that combination needs the legacy
+    # partitioner — see _llama_forward). "ulysses" cannot nest in the
+    # pipeline: its all_to_all can't partition inside a manual region.
     context_parallel: str = ""
     # "bshd" ([B,S,H,D], paddle layout) | "bhsd" (head-major: the qkv
     # projections emit [B,H,S,D] directly and the o-projection consumes it,
@@ -358,10 +360,26 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
     stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
     pp_deg = (int(mesh.shape["pp"]) if mesh is not None and
               "pp" in mesh.axis_names else 1)
+    # CP composes inside the pipeline: the ring/ulysses shard_map re-binds
+    # to the context AbstractMesh when it runs inside the schedule's
+    # manual 'pp' region (sp_attention.ring_attention). Caveat: the Shardy
+    # partitioner cannot yet TRANSPOSE nested partial-manual regions
+    # ("axis already bound by parent"), so training this combination needs
+    # jax.config.update("jax_use_shardy_partitioner", False).
     if use_cp and pp_deg > 1 and pipeline_microbatches > 0:
-        raise ValueError("context_parallel cannot be combined with the "
-                         "pipeline schedule (nested shard_map regions); "
-                         "set pipeline_microbatches=0 or sep_degree=1")
+        if context_parallel == "ulysses":
+            raise ValueError(
+                "context_parallel='ulysses' cannot run inside the pipeline "
+                "schedule: XLA cannot partition the head-scatter all_to_all "
+                "inside a nested manual region (GSPMD CHECK "
+                "IsManualSubgroup); use context_parallel='ring'")
+        if jax.config.jax_use_shardy_partitioner:
+            import warnings
+            warnings.warn(
+                "context_parallel inside the pipeline schedule: backward "
+                "requires the legacy partitioner — set jax.config.update("
+                "'jax_use_shardy_partitioner', False) before compiling, or "
+                "the grad lowering fails with 'axis already bound'")
     if pipeline_microbatches > 0 and pp_deg > 1:
         # real pipeline: stage-resident weight slices + ppermute handoffs
         from ..parallel.pp import pipeline_interleaved, pipeline_spmd
@@ -492,7 +510,8 @@ def _llama_generate_fn(ids, max_new, s_max, nh, nkv, hd, eps, theta, tied,
             return jnp.argmax(logits, axis=-1).astype(ids.dtype)
         lg = logits / temperature
         if top_k > 0:
-            kth = jnp.sort(lg, axis=-1)[..., -top_k][..., None]
+            k_eff = min(top_k, lg.shape[-1])  # HF/paddle convention: clamp
+            kth = jnp.sort(lg, axis=-1)[..., -k_eff][..., None]
             lg = jnp.where(lg < kth, -1e30, lg)
         return jax.random.categorical(k, lg, axis=-1).astype(ids.dtype)
 
@@ -552,49 +571,48 @@ def _llama_generate_fn(ids, max_new, s_max, nh, nkv, hd, eps, theta, tied,
     return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
 
-class _GenerateMixin:
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, max_cache_len=None, seed=None):
-        """Autoregressive generation with a jit-compiled KV-cache decode
-        loop (greedy by default; temperature>0 enables top-k sampling)."""
-        from ..core import random as _random_mod
-        from ..core.tensor import Tensor as _T
+def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+         top_k=0, max_cache_len=None, seed=None):
+    """Autoregressive generation with a jit-compiled KV-cache decode
+    loop (greedy by default; temperature>0 enables top-k sampling)."""
+    from ..core import random as _random_mod
+    from ..core.tensor import Tensor as _T
 
-        c = self.config
-        ids = input_ids.value if isinstance(input_ids, _T) else \
-            jnp.asarray(input_ids)
-        B, S = ids.shape
-        s_max = int(max_cache_len or min(c.max_position_embeddings,
-                                         S + max_new_tokens))
-        if S + int(max_new_tokens) > s_max:
-            raise ValueError(
-                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"the KV cache length ({s_max}); raise max_cache_len / "
-                f"max_position_embeddings or generate fewer tokens")
-        key = (jax.random.PRNGKey(seed) if seed is not None
-               else _random_mod.next_key())
-        params = dict(
-            embed=self.embed_tokens.value, wq=self.wq.value,
-            wk=self.wk.value, wv=self.wv.value, wo=self.wo.value,
-            w_gate=self.w_gate.value, w_up=self.w_up.value,
-            w_down=self.w_down.value, input_ln=self.input_ln.value,
-            post_ln=self.post_ln.value, final_norm=self.final_norm.value,
-            lm_head=(self.embed_tokens.value if self.lm_head is None
-                     else self.lm_head.value))
-        cache_key = (int(max_new_tokens), s_max, float(temperature),
-                     int(top_k))
-        jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-        fn = jit_cache.get(cache_key)
-        if fn is None:
-            fn = jax.jit(functools.partial(
-                _llama_generate_fn, max_new=int(max_new_tokens), s_max=s_max,
-                nh=c.num_attention_heads, nkv=c.num_key_value_heads,
-                hd=c.head_dim, eps=float(c.rms_norm_eps),
-                theta=float(c.rope_theta), tied=self.lm_head is None,
-                temperature=float(temperature), top_k=int(top_k)))
-            jit_cache[cache_key] = fn
-        out = fn(ids, key=key, **params)
-        return _T(out)
+    c = self.config
+    ids = input_ids.value if isinstance(input_ids, _T) else \
+        jnp.asarray(input_ids)
+    B, S = ids.shape
+    s_max = int(max_cache_len or min(c.max_position_embeddings,
+                                     S + max_new_tokens))
+    if S + int(max_new_tokens) > s_max:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the KV cache length ({s_max}); raise max_cache_len / "
+            f"max_position_embeddings or generate fewer tokens")
+    key = (jax.random.PRNGKey(seed) if seed is not None
+           else _random_mod.next_key())
+    params = dict(
+        embed=self.embed_tokens.value, wq=self.wq.value,
+        wk=self.wk.value, wv=self.wv.value, wo=self.wo.value,
+        w_gate=self.w_gate.value, w_up=self.w_up.value,
+        w_down=self.w_down.value, input_ln=self.input_ln.value,
+        post_ln=self.post_ln.value, final_norm=self.final_norm.value,
+        lm_head=(self.embed_tokens.value if self.lm_head is None
+                 else self.lm_head.value))
+    cache_key = (int(max_new_tokens), s_max, float(temperature),
+                 int(top_k))
+    jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
+    fn = jit_cache.get(cache_key)
+    if fn is None:
+        fn = jax.jit(functools.partial(
+            _llama_generate_fn, max_new=int(max_new_tokens), s_max=s_max,
+            nh=c.num_attention_heads, nkv=c.num_key_value_heads,
+            hd=c.head_dim, eps=float(c.rms_norm_eps),
+            theta=float(c.rope_theta), tied=self.lm_head is None,
+            temperature=float(temperature), top_k=int(top_k)))
+        jit_cache[cache_key] = fn
+    out = fn(ids, key=key, **params)
+    return _T(out)
 
 
-LlamaForCausalLM.generate = _GenerateMixin.generate
+LlamaForCausalLM.generate = generate

@@ -1,0 +1,64 @@
+"""paddle.distributed.communication.stream — stream-variant collectives
+(reference: ``python/paddle/distributed/communication/stream/*.py``:
+same collectives with ``sync_op``/``use_calc_stream`` control).
+
+TPU-native semantics: XLA programs execute on a single ordered stream per
+device, and jax dispatch is already asynchronous — ``use_calc_stream=True``
+(run on the compute stream, synchronously ordered) is therefore the only
+behavior that exists; ``sync_op=False`` returns the usual Task whose
+``wait()`` is ``block_until_ready``. The wrappers exist for API parity so
+reference training code ports unchanged.
+"""
+from __future__ import annotations
+
+from . import communication as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "reduce", "scatter", "alltoall", "send", "recv"]
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_list, tensor, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_list, op=_c.ReduceOp.SUM, group=None,
+                   sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_list, op=op, group=group,
+                             sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    # base signature is (in_tensor_list, out_tensor_list); the stream API
+    # takes outputs first (paddle stream convention)
+    return _c.alltoall(in_tensor_list, out_tensor_list, group=group,
+                       sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group, sync_op=sync_op)

@@ -84,6 +84,18 @@ def _ring_local(q, k, v, *, axis_name, causal, scale):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+
+
+def _nesting_mesh(mesh, axis_name):
+    """The mesh the sep shard_map must bind: inside an enclosing manual
+    region (e.g. the pipeline's 'pp' shard_map) that is the context
+    AbstractMesh, not the concrete mesh."""
+    ctx = jax.sharding.get_abstract_mesh()
+    if (ctx is not None and axis_name in getattr(ctx, "axis_names", ())
+            and getattr(ctx, "manual_axes", ())):
+        return ctx
+    return mesh
+
 def ring_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
     """Global [B, H, S, D] arrays, S sharded over the sep ring."""
     mesh = mesh or mesh_mod.get_mesh()
@@ -99,7 +111,8 @@ def ring_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
     spec = P(None, None, axis_name, None)
     fn = functools.partial(_ring_local, axis_name=axis_name, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, axis_names={axis_name},
+    return jax.shard_map(fn, mesh=_nesting_mesh(mesh, axis_name),
+                         axis_names={axis_name},
                          in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
@@ -139,7 +152,8 @@ def ulysses_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
     spec = P(None, None, axis_name, None)
     fn = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, axis_names={axis_name},
+    return jax.shard_map(fn, mesh=_nesting_mesh(mesh, axis_name),
+                         axis_names={axis_name},
                          in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 

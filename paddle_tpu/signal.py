@@ -11,6 +11,33 @@ from .ops._op import tensor_op
 __all__ = ["stft", "istft", "frame", "overlap_add"]
 
 
+def _frame_raw(x, frame_length, hop_length):
+    """[..., n] -> [..., num_frames, frame_length] (shared gather core)."""
+    n = x.shape[-1]
+    if n < frame_length:
+        raise ValueError(
+            f"signal length {n} is shorter than frame_length {frame_length}")
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def _ola_raw(frames, hop_length):
+    """[..., num_frames, frame_length] -> [..., out_len] (shared
+    overlap-add core)."""
+    fl, num = frames.shape[-1], frames.shape[-2]
+    out_len = (num - 1) * hop_length + fl
+
+    def body(i, acc):
+        cur = jax.lax.dynamic_slice_in_dim(acc, i * hop_length, fl, -1)
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc, cur + frames[..., i, :], i * hop_length, -1)
+
+    acc = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    return jax.lax.fori_loop(0, num, body, acc)
+
+
 @tensor_op
 def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice overlapping frames (reference paddle.signal.frame):
@@ -21,11 +48,7 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
         if axis not in (0,):
             raise ValueError("frame: axis must be 0 or -1 (paddle contract)")
         x = jnp.moveaxis(x, 0, -1)
-    n = x.shape[-1]
-    num = 1 + (n - frame_length) // hop_length
-    starts = jnp.arange(num) * hop_length
-    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-    out = x[..., idx]  # [..., num_frames, frame_length]
+    out = _frame_raw(x, frame_length, hop_length)
     if last:
         return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
     return jnp.moveaxis(out, (-2, -1), (0, 1))  # [num, frame_length, ...]
@@ -41,19 +64,7 @@ def overlap_add(x, hop_length, axis=-1, name=None):
         if axis != 0:
             raise ValueError("overlap_add: axis must be 0 or -1")
         x = jnp.moveaxis(x, (0, 1), (-1, -2))  # -> [..., fl, num]
-    fl, num = x.shape[-2], x.shape[-1]
-    out_len = (num - 1) * hop_length + fl
-    frames = jnp.swapaxes(x, -1, -2)  # [..., num, fl]
-
-    def body(i, acc):
-        return jax.lax.dynamic_update_slice_in_dim(
-            acc,
-            jax.lax.dynamic_slice_in_dim(
-                acc, i * hop_length, fl, -1) + frames[..., i, :],
-            i * hop_length, -1)
-
-    acc = jnp.zeros(frames.shape[:-2] + (out_len,), x.dtype)
-    out = jax.lax.fori_loop(0, num, body, acc)
+    out = _ola_raw(jnp.swapaxes(x, -1, -2), hop_length)
     return out if last else jnp.moveaxis(out, -1, 0)
 
 
@@ -80,11 +91,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     if center:
         x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)],
                     mode=pad_mode)
-    n = x.shape[-1]
-    num = 1 + (n - n_fft) // hop
-    starts = jnp.arange(num) * hop
-    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
-    frames = x[..., idx] * w  # [..., num, n_fft]
+    frames = _frame_raw(x, n_fft, hop) * w  # [..., num, n_fft]
     spec = (jnp.fft.rfft(frames, axis=-1) if onesided
             else jnp.fft.fft(frames, axis=-1))
     if normalized:
@@ -119,22 +126,11 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     frames = frames * w
     num = frames.shape[-2]
     out_len = (num - 1) * hop + n_fft
-
-    def ola(sig_frames):
-        acc = jnp.zeros(sig_frames.shape[:-2] + (out_len,), sig_frames.dtype)
-
-        def body(i, a):
-            cur = jax.lax.dynamic_slice_in_dim(a, i * hop, n_fft, -1)
-            return jax.lax.dynamic_update_slice_in_dim(
-                a, cur + sig_frames[..., i, :], i * hop, -1)
-
-        return jax.lax.fori_loop(0, num, body, acc)
-
-    sig = ola(frames)
+    sig = _ola_raw(frames, hop)
     # COLA normalization: divide by the summed squared window envelope
     wsq = jnp.broadcast_to(w * w, (num, n_fft))
-    env = ola(wsq.reshape((1,) * (frames.ndim - 2) + (num, n_fft))
-              if frames.ndim > 2 else wsq)
+    env = _ola_raw(wsq.reshape((1,) * (frames.ndim - 2) + (num, n_fft))
+                   if frames.ndim > 2 else wsq, hop)
     sig = sig / jnp.maximum(env, 1e-8)
     if center:
         sig = sig[..., n_fft // 2: out_len - n_fft // 2]
