@@ -88,14 +88,31 @@ def _measure_config(name, overrides, iters=10):
             "loss": final_loss, "n_params": n_params, "peak": peak}
 
 
+class _ConfigTimeout(Exception):
+    pass
+
+
 def main():
+    import signal as _signal
+
+    def _alarm(_sig, _frm):
+        raise _ConfigTimeout()
+
+    _signal.signal(_signal.SIGALRM, _alarm)
     results = []
     for name, overrides in CONFIGS:
         try:
+            # per-config watchdog: a wedged compile/OOM-hang on one config
+            # must not eat the whole child's budget
+            _signal.alarm(240)
             results.append(_measure_config(name, overrides))
+        except _ConfigTimeout:
+            print(f"# config {name} timed out (240s)", file=sys.stderr)
         except Exception as e:  # one bad config must not kill the bench
             print(f"# config {name} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+        finally:
+            _signal.alarm(0)
     if not results:
         _fail_line("all bench configs failed")
         return 0
@@ -105,15 +122,18 @@ def main():
     # dims through the same scan body; reported in the unit string
     layer7b = ""
     try:
+        _signal.alarm(240)
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "scripts"))
         from bench_7b_layer import measure as measure_7b
         r7 = measure_7b(iters=6)
         layer7b = (f", 7b-layer {r7['layer7b_tok_s']} tok/s "
                    f"{r7['layer7b_mfu']:.3f} MFU")
-    except Exception as e:
+    except (_ConfigTimeout, Exception) as e:  # noqa: B014
         print(f"# 7b layer bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    finally:
+        _signal.alarm(0)
 
     mfu = best["mfu"]
     print(json.dumps({
