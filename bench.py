@@ -26,11 +26,11 @@ import numpy as np
 
 METRIC = "llama_350m_train_mfu_bf16"
 PROBE_TIMEOUT_S = 90
-BENCH_TIMEOUT_S = 900
+CONFIG_TIMEOUT_S = 300  # per-config child budget (compile ~30-60s + 13 steps)
 BACKOFFS_S = (5, 15, 30)
 
 
-# Candidate configs measured in ONE child, best MFU reported. The r3
+# Candidate configs, one child subprocess each, best MFU reported. The r3
 # variants: head-major attention layout (projection-fused head fold, no HBM
 # transpose pass) and chunked lm-head+CE (one [B,chunk,V] f32 block live
 # instead of the full [B,S,V]). Measured rather than assumed: each is timed
@@ -88,63 +88,21 @@ def _measure_config(name, overrides, iters=10):
             "loss": final_loss, "n_params": n_params, "peak": peak}
 
 
-class _ConfigTimeout(Exception):
-    pass
+def main_one_config(idx):
+    """Child: measure ONE config, print its result dict as JSON. Each
+    config gets its own OS process because a wedged compile / device hang
+    blocks in C and no in-process watchdog (signal/alarm) can preempt it —
+    only the parent's subprocess timeout bounds it."""
+    name, overrides = CONFIGS[idx]
+    print(json.dumps(_measure_config(name, overrides)))
+    return 0
 
 
-def main():
-    import signal as _signal
-
-    def _alarm(_sig, _frm):
-        raise _ConfigTimeout()
-
-    _signal.signal(_signal.SIGALRM, _alarm)
-    results = []
-    for name, overrides in CONFIGS:
-        try:
-            # per-config watchdog: a wedged compile/OOM-hang on one config
-            # must not eat the whole child's budget
-            _signal.alarm(240)
-            results.append(_measure_config(name, overrides))
-        except _ConfigTimeout:
-            print(f"# config {name} timed out (240s)", file=sys.stderr)
-        except Exception as e:  # one bad config must not kill the bench
-            print(f"# config {name} failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-        finally:
-            _signal.alarm(0)
-    if not results:
-        _fail_line("all bench configs failed")
-        return 0
-    best = max(results, key=lambda r: r["mfu"])
-
-    # 7B-shaped evidence (VERDICT r3 item 3): one decoder layer at exact 7B
-    # dims through the same scan body; reported in the unit string
-    layer7b = ""
-    try:
-        _signal.alarm(240)
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "scripts"))
-        from bench_7b_layer import measure as measure_7b
-        r7 = measure_7b(iters=6)
-        layer7b = (f", 7b-layer {r7['layer7b_tok_s']} tok/s "
-                   f"{r7['layer7b_mfu']:.3f} MFU")
-    except (_ConfigTimeout, Exception) as e:  # noqa: B014
-        print(f"# 7b layer bench failed: {type(e).__name__}: {e}",
-              file=sys.stderr)
-    finally:
-        _signal.alarm(0)
-
-    mfu = best["mfu"]
-    print(json.dumps({
-        "metric": METRIC,
-        "value": round(mfu, 4),
-        "unit": f"MFU (6N formula, N={best['n_params']/1e6:.0f}M, "
-                f"{best['tok_s']:.0f} tok/s/chip, "
-                f"peak={best['peak']/1e12:.0f}TF, loss={best['loss']:.3f}, "
-                f"cfg={best['name']}{layer7b})",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+def main_7b_layer():
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    from bench_7b_layer import measure as measure_7b
+    print(json.dumps(measure_7b(iters=6)))
     return 0
 
 
@@ -172,6 +130,20 @@ def _run(args, timeout):
         return 124, _text(e.stdout), _text(e.stderr)
 
 
+def _parse_result(rc, out):
+    """Last {-prefixed stdout line parsed as JSON, or None. rc is ignored
+    for parsing: a child that printed its result and then wedged in
+    teardown (flaky tunnel atexit) still yields its measurement."""
+    line = next((ln for ln in reversed(out.splitlines())
+                 if ln.startswith("{")), None)
+    if line is None:
+        return None
+    try:
+        return json.loads(line)
+    except ValueError:
+        return None
+
+
 def watchdog():
     last_err = "unknown"
     for attempt, backoff in enumerate(BACKOFFS_S + (None,)):
@@ -188,21 +160,48 @@ def watchdog():
             return 0  # a parsed JSON line IS the success contract
         time.sleep(backoff)
 
-    for attempt in (1, 2):
-        rc, out, err = _run([os.path.abspath(__file__), "--child"],
-                            BENCH_TIMEOUT_S)
-        line = next((ln for ln in reversed(out.splitlines())
-                     if ln.startswith("{")), None)
-        if rc == 0 and line:
-            print(line)
-            return 0
-        last_err = f"bench child rc={rc}; stderr tail: {err.strip()[-300:]}"
-        time.sleep(5)
-    _fail_line(last_err)
+    # one subprocess per config: a hang in one config costs only its own
+    # timeout, and a successful measurement is never discarded
+    me = os.path.abspath(__file__)
+    results = []
+    for i, (name, _) in enumerate(CONFIGS):
+        rc, out, err = _run([me, "--config", str(i)], CONFIG_TIMEOUT_S)
+        parsed = _parse_result(rc, out)
+        if parsed is not None:
+            results.append(parsed)
+            continue
+        last_err = (f"config {name} rc={rc}"
+                    + (" (hang killed)" if rc == 124 else "")
+                    + f"; stderr tail: {err.strip()[-200:]}")
+        print(f"# {last_err}", file=sys.stderr)
+    if not results:
+        _fail_line(f"all bench configs failed; last: {last_err}")
+        return 0
+    best = max(results, key=lambda r: r["mfu"])
+
+    layer7b = ""
+    rc, out, err = _run([me, "--layer7b"], CONFIG_TIMEOUT_S)
+    r7 = _parse_result(rc, out)
+    if r7 is not None:
+        layer7b = (f", 7b-layer {r7['layer7b_tok_s']} tok/s "
+                   f"{r7['layer7b_mfu']:.3f} MFU")
+
+    mfu = best["mfu"]
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(mfu, 4),
+        "unit": f"MFU (6N formula, N={best['n_params']/1e6:.0f}M, "
+                f"{best['tok_s']:.0f} tok/s/chip, "
+                f"peak={best['peak']/1e12:.0f}TF, loss={best['loss']:.3f}, "
+                f"cfg={best['name']}{layer7b})",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
     return 0
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
-        sys.exit(main())
+    if "--config" in sys.argv:
+        sys.exit(main_one_config(int(sys.argv[sys.argv.index("--config") + 1])))
+    if "--layer7b" in sys.argv:
+        sys.exit(main_7b_layer())
     sys.exit(watchdog())
