@@ -165,15 +165,17 @@ def watchdog():
     me = os.path.abspath(__file__)
     results = []
     for i, (name, _) in enumerate(CONFIGS):
-        rc, out, err = _run([me, "--config", str(i)], CONFIG_TIMEOUT_S)
-        parsed = _parse_result(rc, out)
-        if parsed is not None:
-            results.append(parsed)
-            continue
-        last_err = (f"config {name} rc={rc}"
-                    + (" (hang killed)" if rc == 124 else "")
-                    + f"; stderr tail: {err.strip()[-200:]}")
-        print(f"# {last_err}", file=sys.stderr)
+        for attempt in (1, 2):  # one retry for transient tunnel flakes
+            rc, out, err = _run([me, "--config", str(i)], CONFIG_TIMEOUT_S)
+            parsed = _parse_result(rc, out)
+            if parsed is not None:
+                results.append(parsed)
+                break
+            last_err = (f"config {name} attempt {attempt} rc={rc}"
+                        + (" (hang killed)" if rc == 124 else "")
+                        + f"; stderr tail: {err.strip()[-200:]}")
+            print(f"# {last_err}", file=sys.stderr)
+            time.sleep(5)
     if not results:
         _fail_line(f"all bench configs failed; last: {last_err}")
         return 0

@@ -16,7 +16,7 @@ __all__ = [
     "rad2deg", "gcd", "lcm", "vander", "trapezoid", "cdist", "pdist",
     "cholesky_solve", "multi_dot", "lu", "eigvals", "householder_product",
     "ldexp", "frexp", "nextafter", "isneginf", "isposinf",
-    "signbit", "combinations", "diag_embed",
+    "signbit", "combinations", "diag_embed", "lu_unpack",
 ]
 
 
@@ -291,3 +291,51 @@ def combinations(x, r=2, with_replacement=False, name=None):
            if with_replacement else itertools.combinations(range(n), r))
     idx = np.asarray(list(gen), np.int32).reshape(-1, r)
     return x[jnp.asarray(idx)]
+
+
+@tensor_op(name="lu_unpack_lu")
+def _lu_unpack_lu(lu_data):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    eye = jnp.broadcast_to(jnp.eye(m, k, dtype=lu_data.dtype),
+                           lu_data.shape[:-2] + (m, k))
+    L = jnp.tril(lu_data[..., :, :k], -1) + eye
+    U = jnp.triu(lu_data[..., :k, :])
+    return L, U
+
+
+@tensor_op(name="lu_unpack_p")
+def _lu_unpack_p(lu_data, lu_pivots):
+    m = lu_data.shape[-2]
+    npiv = lu_pivots.shape[-1]
+
+    def one(piv):
+        # getrf: swaps applied i = 0..k-1 to A, so A = S_0 ... S_{k-1} (LU);
+        # build P by applying the row swaps to I innermost-first
+        def swap(t, P):
+            i = npiv - 1 - t
+            j = piv[i] - 1
+            ri, rj = P[i], P[j]
+            return P.at[i].set(rj).at[j].set(ri)
+
+        return jax.lax.fori_loop(0, npiv, swap,
+                                 jnp.eye(m, dtype=lu_data.dtype))
+
+    if lu_pivots.ndim == 1:
+        return one(lu_pivots)
+    flat = lu_pivots.reshape(-1, npiv)
+    P = jax.vmap(one)(flat)
+    return P.reshape(lu_pivots.shape[:-1] + (m, m))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack lu() output into (P, L, U) — reference paddle.linalg.lu_unpack;
+    pivots are 1-based (LAPACK getrf contract). Batched inputs supported;
+    skipped outputs (flags False) are None and cost nothing."""
+    L = U = P = None
+    if unpack_ludata:
+        L, U = _lu_unpack_lu(lu_data)
+    if unpack_pivots:
+        P = _lu_unpack_p(lu_data, lu_pivots)
+    return P, L, U

@@ -1,0 +1,184 @@
+"""paddle.vision.ops — detection primitives (reference:
+``python/paddle/vision/ops.py`` over the CUDA nms/roi_align/box kernels).
+
+TPU-native: static-shape formulations — NMS is an O(N^2) mask + fixed-
+iteration suppression scan (no dynamic output shapes: returns keep indices
+padded with -1 when ``top_k`` is given, or a boolean keep mask), roi_align
+is a bilinear gather; everything compiles under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._op import tensor_op
+
+__all__ = ["nms", "box_iou", "box_area", "roi_align", "box_coder",
+           "distribute_fpn_proposals"]
+
+
+def _iou_matrix(boxes_a, boxes_b):
+    area_a = ((boxes_a[:, 2] - boxes_a[:, 0]) *
+              (boxes_a[:, 3] - boxes_a[:, 1]))
+    area_b = ((boxes_b[:, 2] - boxes_b[:, 0]) *
+              (boxes_b[:, 3] - boxes_b[:, 1]))
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-9)
+
+
+@tensor_op(differentiable=False)
+def box_iou(boxes1, boxes2, name=None):
+    return _iou_matrix(boxes1, boxes2)
+
+
+@tensor_op
+def box_area(boxes, name=None):
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+@tensor_op(differentiable=False)
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference paddle.vision.ops.nms): returns kept box indices
+    sorted by descending score. Static-shape: the suppression runs as a
+    fixed-length scan over all N candidates; with ``top_k`` the result is
+    exactly top_k indices padded with -1."""
+    n = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-scores)
+    sorted_boxes = boxes[order]
+    iou = _iou_matrix(sorted_boxes, sorted_boxes)
+    if category_idxs is not None:
+        # multiclass: suppress only within the same category
+        cats = category_idxs[order]
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(keep, i):
+        # suppressed if any higher-ranked KEPT box overlaps > threshold
+        over = (iou[i] > iou_threshold) & (jnp.arange(n) < i) & keep
+        keep = keep.at[i].set(~jnp.any(over))
+        return keep, None
+
+    keep, _ = jax.lax.scan(body, jnp.ones((n,), bool), jnp.arange(n))
+    kept_sorted = jnp.where(keep, jnp.arange(n), n)  # suppressed -> sentinel
+    ranked = jnp.sort(kept_sorted)  # kept positions in score order
+    idx = jnp.where(ranked < n, order[jnp.clip(ranked, 0, n - 1)], -1)
+    if top_k is not None:
+        if top_k > n:  # keep the static [top_k] contract
+            idx = jnp.concatenate(
+                [idx, jnp.full((top_k - n,), -1, idx.dtype)])
+        return idx[:top_k]
+    # dynamic count is not jit-able; outside jit trim the -1 tail
+    return idx[idx >= 0] if not isinstance(idx, jax.core.Tracer) else idx
+
+
+@tensor_op
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference roi_align): x [N,C,H,W], boxes [R,4] in
+    (x1,y1,x2,y2); boxes_num [N] maps rois to images. Bilinear sampling at
+    output_size^2 cells x sampling_ratio^2 points."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if boxes_num is None:
+        if N != 1:
+            raise ValueError(
+                f"roi_align: boxes_num is required when the batch has "
+                f"{N} images (otherwise every roi would read image 0)")
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of = jnp.repeat(jnp.arange(len(boxes_num)),
+                            jnp.asarray(boxes_num), total_repeat_length=R)
+    offset = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(box, img):
+        x1, y1, x2, y2 = (box * spatial_scale) - offset
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        bin_w, bin_h = rw / ow, rh / oh
+        # sample grid: [oh, sr] x [ow, sr]
+        gy = y1 + (jnp.arange(oh)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * bin_h
+        gx = x1 + (jnp.arange(ow)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * bin_w
+        gy = gy.reshape(-1)  # [oh*sr]
+        gx = gx.reshape(-1)  # [ow*sr]
+
+        def bilinear(c_map):
+            y0 = jnp.clip(jnp.floor(gy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(gx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            ly = jnp.clip(gy - y0, 0, 1)[:, None]
+            lx = jnp.clip(gx - x0, 0, 1)[None, :]
+            v00 = c_map[y0i][:, x0i]
+            v01 = c_map[y0i][:, x1i]
+            v10 = c_map[y1i][:, x0i]
+            v11 = c_map[y1i][:, x1i]
+            val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                   v10 * ly * (1 - lx) + v11 * ly * lx)  # [oh*sr, ow*sr]
+            val = val.reshape(oh, sr, ow, sr)
+            return val.mean(axis=(1, 3))
+
+        return jax.vmap(bilinear)(x[img])  # [C, oh, ow]
+
+    return jax.vmap(one_roi)(boxes, img_of)  # [R, C, oh, ow]
+
+
+@tensor_op
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder, the SSD/
+    Faster-RCNN transform). 2-D target_box aligned 1:1 with priors only;
+    the reference's 3-D [N,M,4] + axis broadcast is not implemented."""
+    if target_box.ndim != 2 or axis != 0:
+        raise NotImplementedError(
+            "box_coder: only 2-D target_box with axis=0 is supported")
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    var = prior_box_var if prior_box_var is not None else jnp.ones((4,))
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+        return out / var
+    # decode
+    t = target_box * var
+    cx = t[..., 0] * pw + pcx
+    cy = t[..., 1] * ph + pcy
+    w = jnp.exp(t[..., 2]) * pw
+    h = jnp.exp(t[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+@tensor_op(differentiable=False)
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, name=None):
+    """FPN level assignment (reference distribute_fpn_proposals): returns
+    the target level per roi (static-shape variant of the scatter)."""
+    off = 1.0 if pixel_offset else 0.0
+    w = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    h = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-9))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-9)) + refer_level
+    return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)

@@ -1,0 +1,143 @@
+"""paddle.vision.ops detection primitives + lu_unpack (reference:
+``python/paddle/vision/ops.py`` CUDA nms/roi_align kernels,
+``paddle.linalg.lu_unpack``). Oracles: brute-force numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _nms_oracle(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        w = np.maximum(0, xx2 - xx1)
+        h = np.maximum(0, yy2 - yy1)
+        inter = w * h
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_r = ((boxes[rest, 2] - boxes[rest, 0]) *
+               (boxes[rest, 3] - boxes[rest, 1]))
+        iou = inter / (a_i + a_r - inter)
+        order = rest[iou <= thr]
+    return keep
+
+
+class TestNMS:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(30, 2) * 60
+        wh = rng.rand(30, 2) * 30 + 2
+        boxes = np.concatenate([xy, xy + wh], -1).astype(np.float32)
+        scores = rng.rand(30).astype(np.float32)
+        got = vops.nms(_t(boxes), 0.4, _t(scores)).numpy()
+        expect = _nms_oracle(boxes, scores, 0.4)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_top_k_padding(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        got = vops.nms(_t(boxes), 0.5, _t(scores), top_k=3).numpy()
+        np.testing.assert_array_equal(got, [0, 2, -1])  # 1 suppressed by 0
+
+    def test_multiclass_suppresses_per_category(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        got = vops.nms(_t(boxes), 0.5, _t(scores), category_idxs=_t(cats),
+                       top_k=2).numpy()
+        np.testing.assert_array_equal(got, [0, 1])  # different class: kept
+
+    def test_box_iou_and_area(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+        iou = vops.box_iou(_t(a), _t(b)).numpy()
+        np.testing.assert_allclose(iou, [[25.0 / 175.0, 0.0]], rtol=1e-5)
+        np.testing.assert_allclose(vops.box_area(_t(b)).numpy(), [100, 100])
+
+
+class TestRoiAlign:
+    def test_constant_map_returns_constant(self):
+        x = np.full((1, 3, 16, 16), 7.0, np.float32)
+        rois = np.array([[2, 2, 10, 10]], np.float32)
+        out = vops.roi_align(_t(x), _t(rois), output_size=4).numpy()
+        assert out.shape == (1, 3, 4, 4)
+        np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+    def test_gradient_ramp(self):
+        # linear ramp in x: averaged samples reproduce the ramp center
+        H = W = 16
+        ramp = np.tile(np.arange(W, dtype=np.float32), (H, 1))
+        x = ramp[None, None]
+        rois = np.array([[4.0, 4.0, 12.0, 12.0]], np.float32)
+        out = vops.roi_align(_t(x), _t(rois), output_size=2,
+                             aligned=False).numpy()[0, 0]
+        # columns centered at x = 4 + {1, 3}/4 * 8 -> 6, 10
+        np.testing.assert_allclose(out[:, 0], 6.0, atol=0.3)
+        np.testing.assert_allclose(out[:, 1], 10.0, atol=0.3)
+
+    def test_multi_image_batch(self):
+        x = np.stack([np.full((1, 8, 8), 1.0), np.full((1, 8, 8), 2.0)]) \
+            .astype(np.float32)
+        rois = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = vops.roi_align(_t(x), _t(rois), boxes_num=_t(np.array([1, 1])),
+                             output_size=2).numpy()
+        np.testing.assert_allclose(out[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[1], 2.0, rtol=1e-5)
+
+
+class TestBoxCoderFpn:
+    def test_encode_decode_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        targets = np.array([[1, 1, 9, 11], [6, 4, 18, 22]], np.float32)
+        var = np.ones((4,), np.float32)
+        enc = vops.box_coder(_t(priors), _t(var), _t(targets),
+                             code_type="encode_center_size")
+        dec = vops.box_coder(_t(priors), _t(var), enc,
+                             code_type="decode_center_size").numpy()
+        np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-4)
+
+    def test_fpn_levels(self):
+        rois = np.array([[0, 0, 56, 56], [0, 0, 224, 224], [0, 0, 448, 448]],
+                        np.float32)
+        lvl = vops.distribute_fpn_proposals(_t(rois), 2, 5, 4, 224).numpy()
+        np.testing.assert_array_equal(lvl, [2, 4, 5])
+
+
+class TestLuUnpack:
+    def test_reconstructs_input(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(5, 5).astype(np.float32)
+        lu_mat, piv = paddle.lu(_t(a))
+        P, L, U = paddle.lu_unpack(lu_mat, piv)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+
+    def test_batched(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(3, 4, 4).astype(np.float32)
+        lu_mat, piv = paddle.lu(_t(a))
+        P, L, U = paddle.lu_unpack(lu_mat, piv)
+        rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+
+    def test_flags_return_none(self):
+        rng = np.random.RandomState(3)
+        a = rng.randn(4, 4).astype(np.float32)
+        lu_mat, piv = paddle.lu(_t(a))
+        P, L, U = paddle.lu_unpack(lu_mat, piv, unpack_ludata=False)
+        assert L is None and U is None and P is not None
+        P2, L2, U2 = paddle.lu_unpack(lu_mat, piv, unpack_pivots=False)
+        assert P2 is None and L2 is not None
