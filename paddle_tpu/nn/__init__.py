@@ -11,9 +11,10 @@ from .layers.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                 LogSoftmax, Mish, PReLU, ReLU, ReLU6, RReLU,
                                 Sigmoid, Silu, Softmax, Softplus, Softshrink,
                                 Softsign, Swish, Tanh, Tanhshrink)
-from .layers.common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
-                            Dropout2D, Embedding, Flatten, Identity, Linear,
-                            Pad2D, PixelShuffle, Upsample)
+from .layers.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                            CosineSimilarity, Dropout, Dropout2D, Embedding,
+                            Flatten, Identity, Linear, Pad2D, PixelShuffle,
+                            Upsample)
 from .layers.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
 from .layers.loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
                           KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,

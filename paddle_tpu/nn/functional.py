@@ -887,6 +887,29 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
 
 
 @tensor_op
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """paddle.nn.functional.channel_shuffle (reference:
+    ``python/paddle/nn/functional/vision.py``): interleave channels across
+    groups — the ShuffleNet mixing op."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            f"data_format must be 'NCHW' or 'NHWC', got {data_format!r}")
+    channels = x.shape[1] if data_format == "NCHW" else x.shape[3]
+    if groups <= 0 or channels % groups != 0:
+        raise ValueError(
+            f"channels ({channels}) must be divisible by groups ({groups})")
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        out = jnp.reshape(x, (N, groups, C // groups, H, W))
+        out = jnp.swapaxes(out, 1, 2)
+        return jnp.reshape(out, (N, C, H, W))
+    N, H, W, C = x.shape
+    out = jnp.reshape(x, (N, H, W, groups, C // groups))
+    out = jnp.swapaxes(out, 3, 4)
+    return jnp.reshape(out, (N, H, W, C))
+
+
+@tensor_op
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
     k = _pair(kernel_sizes)
     s = _pair(strides)

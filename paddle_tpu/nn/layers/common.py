@@ -129,6 +129,16 @@ class PixelShuffle(Layer):
         return F.pixel_shuffle(x, self.upscale_factor)
 
 
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
 class Pad2D(Layer):
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW",
                  name=None):

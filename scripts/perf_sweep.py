@@ -11,9 +11,6 @@ import json
 import os
 import subprocess
 import sys
-import time
-
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -29,58 +26,60 @@ VARIANTS = [
                          "recompute_policy": "dots"}),
     ("bhsd+chunk+norematt", {"attention_layout": "bhsd", "loss_chunk": 512,
                              "use_recompute": False}),
+    # no-remat via grad accumulation: fwd+bwd per microbatch inside a scan
+    # keeps only one microbatch's activations live, so the full-layer remat
+    # (its ~2N extra FLOP/token) can be dropped without OOM
+    ("noremat+accum2", {"use_recompute": False, "_accum": 2}),
+    ("noremat+accum2+chunk", {"use_recompute": False, "loss_chunk": 512,
+                              "_accum": 2}),
+    ("noremat+accum4+chunk", {"use_recompute": False, "loss_chunk": 512,
+                              "_accum": 4}),
+    ("bhsd+noremat+accum2+chunk", {"attention_layout": "bhsd",
+                                   "use_recompute": False,
+                                   "loss_chunk": 512, "_accum": 2}),
+    ("v2:bhsd+noremat+accum4+chunk", {"attention_layout": "bhsd",
+                                      "use_recompute": False,
+                                      "loss_chunk": 512, "_accum": 4}),
+    # hd=128: same H=1024 / params, 8 heads x 128 — the attention
+    # contractions fill the 128-wide MXU instead of running at 50% (hd=64)
+    ("v2:hd128+noremat+accum4+chunk", {"num_attention_heads": 8,
+                                       "num_key_value_heads": 8,
+                                       "use_recompute": False,
+                                       "loss_chunk": 512, "_accum": 4}),
+    ("v2:bhsd+hd128+noremat+accum4+chunk", {"attention_layout": "bhsd",
+                                            "num_attention_heads": 8,
+                                            "num_key_value_heads": 8,
+                                            "use_recompute": False,
+                                            "loss_chunk": 512, "_accum": 4}),
+    # larger global batch amortizes the optimizer update + accum epilogue
+    ("v2:hd128+noremat+accum8+chunk+B16", {"num_attention_heads": 8,
+                                           "num_key_value_heads": 8,
+                                           "use_recompute": False,
+                                           "loss_chunk": 512, "_accum": 8,
+                                           "_B": 16}),
 ]
 
 
 def child(overrides):
-    import jax
-    import paddle_tpu as paddle
-    from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.optimizer import AdamW
-    from paddle_tpu.profiler.metrics import peak_flops_per_chip
-
-    paddle.seed(0)
-    kw = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-              num_hidden_layers=24, num_attention_heads=16,
-              num_key_value_heads=16, max_position_embeddings=2048,
-              use_recompute=True, dtype="bfloat16")
-    kw.update(overrides)
-    cfg = LlamaConfig(**kw)
-    model = LlamaForCausalLM(cfg)
-    n_params = model.num_params()
-    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
-    step = TrainStep(model, lambda loss, _lab: loss, opt)
-
-    B, S = 8, 2048
-    rng = np.random.RandomState(0)
-    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
-
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(step.step((ids, ids), (ids,)).value)
-    compile_s = time.perf_counter() - t0
-
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step.step((ids, ids), (ids,))
-    final_loss = float(loss.value)
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = iters * B * S / dt
-    mfu = tokens_per_sec * 6.0 * n_params / peak_flops_per_chip()
-    print(json.dumps({"mfu": round(float(mfu), 4),
-                      "tok_s": round(tokens_per_sec),
-                      "step_ms": round(dt / iters * 1000, 1),
-                      "warm_s": round(compile_s, 1),
-                      "loss": round(final_loss, 3)}))
+    """Thin wrapper over bench._measure_config — ONE measurement harness
+    (same model, token accounting, and MFU formula as the driver bench)."""
+    import bench
+    r = bench._measure_config("sweep", dict(overrides))
+    print(json.dumps({"mfu": round(r["mfu"], 4),
+                      "tok_s": round(r["tok_s"]),
+                      "step_ms": round(r["step_ms"], 1),
+                      "warm_s": round(r["warm_s"], 1),
+                      "loss": round(r["loss"], 3)}))
     return 0
 
 
 def main():
+    only = None
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1].split(",")
     for name, overrides in VARIANTS:
+        if only is not None and not any(s in name for s in only):
+            continue
         try:
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child",

@@ -1,0 +1,85 @@
+"""Vision model zoo shape/train tests (reference pattern:
+``test/legacy_test/test_vision_models.py`` — forward-shape smoke over the
+model zoo, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, c=3, hw=64):
+    rng = np.random.RandomState(0)
+    return paddle.to_tensor(rng.rand(n, c, hw, hw).astype("float32"))
+
+
+class TestNewZooForwardShapes:
+    @pytest.mark.parametrize("ctor", [
+        M.densenet121, M.squeezenet1_0, M.squeezenet1_1, M.mobilenet_v1,
+        M.mobilenet_v3_small, M.mobilenet_v3_large, M.shufflenet_v2_x0_25,
+        M.shufflenet_v2_x0_5, M.shufflenet_v2_swish,
+    ], ids=lambda f: f.__name__)
+    def test_forward_shape(self, ctor):
+        m = ctor(num_classes=7)
+        m.eval()
+        out = m(_img())
+        assert out.shape == [1, 7]
+
+    def test_googlenet_aux_heads(self):
+        m = M.googlenet(num_classes=5)
+        m.eval()
+        out, aux1, aux2 = m(_img(hw=128))
+        assert out.shape == [1, 5]
+        assert aux1.shape == [1, 5]
+        assert aux2.shape == [1, 5]
+
+    def test_inception_v3_shape(self):
+        m = M.inception_v3(num_classes=4)
+        m.eval()
+        assert m(_img(hw=299)).shape == [1, 4]
+
+    def test_densenet_variant_widths(self):
+        # densenet161 uses growth 48 / init 96 — distinct trunk widths
+        m = M.densenet161(num_classes=3, with_pool=True)
+        m.eval()
+        assert m(_img()).shape == [1, 3]
+
+    def test_feature_mode_no_head(self):
+        m = M.mobilenet_v3_small(num_classes=0, with_pool=False)
+        m.eval()
+        out = m(_img())
+        assert len(out.shape) == 4 and out.shape[1] == 576
+
+
+class TestChannelShuffle:
+    def test_matches_manual(self):
+        from paddle_tpu.nn import functional as F
+        x = np.arange(1 * 6 * 2 * 2, dtype=np.float32).reshape(1, 6, 2, 2)
+        out = np.asarray(F.channel_shuffle(paddle.to_tensor(x), 3).value)
+        ref = x.reshape(1, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(1, 6, 2, 2)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_layer_and_roundtrip(self):
+        import paddle_tpu.nn as nn
+        x = paddle.to_tensor(np.random.rand(2, 8, 4, 4).astype("float32"))
+        y = nn.ChannelShuffle(2)(x)
+        # shuffle with groups g then with C//g is the identity permutation
+        z = nn.ChannelShuffle(4)(y)
+        np.testing.assert_allclose(np.asarray(z.value), np.asarray(x.value))
+
+
+class TestNewZooTrains:
+    def test_squeezenet_train_step(self):
+        paddle.seed(0)
+        m = M.squeezenet1_1(num_classes=4)
+        m.train()
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.optimizer import SGD
+        step = TrainStep(m, paddle.nn.CrossEntropyLoss(),
+                         SGD(learning_rate=0.05, parameters=m.parameters()))
+        rng = np.random.RandomState(1)
+        imgs = paddle.to_tensor(rng.rand(4, 3, 64, 64).astype("float32"))
+        labels = paddle.to_tensor(rng.randint(0, 4, (4,)).astype("int64"))
+        losses = [float(step.step((imgs,), (labels,)).value) for _ in range(5)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
