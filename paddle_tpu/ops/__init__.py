@@ -91,6 +91,15 @@ def _install_tensor_methods():
         "scatter": scatter, "index_add": index_add, "kron": kron,
         "outer": outer, "inner": inner, "trace": trace, "diff": diff,
         "lerp": lerp, "nan_to_num": nan_to_num, "logit": logit,
+        # r3 long-tail batch (defined in .extra)
+        "tolist": tolist, "take": take, "mv": mv, "sgn": sgn,
+        "unflatten": unflatten, "view_as": view_as,
+        "index_sample": index_sample, "index_fill": index_fill,
+        "masked_scatter": masked_scatter, "select_scatter": select_scatter,
+        "tensor_split": tensor_split, "nanmedian": nanmedian,
+        "unique_consecutive": unique_consecutive, "rank": rank,
+        "is_complex": is_complex, "is_floating_point": is_floating_point,
+        "is_integer": is_integer, "is_empty": is_empty,
     }
     for name, fn in methods.items():
         if not hasattr(Tensor, name):

@@ -339,3 +339,304 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
     if unpack_pivots:
         P = _lu_unpack_p(lu_data, lu_pivots)
     return P, L, U
+
+
+# ------------------------------------------------------------------ r3 batch
+# Long-tail surface ops (reference: python/paddle/tensor/{math,manipulation,
+# creation,search,attribute}.py). Shape-static ops are one jnp expression
+# (jit/vmap-safe); data-dependent-shape ops (unique_consecutive) are
+# host-synchronizing eager ops exactly like the reference's.
+
+__all__ += [
+    "broadcast_shape", "complex", "dsplit", "hsplit", "vsplit",
+    "tensor_split", "i0", "i0e", "i1", "i1e", "index_fill", "index_sample",
+    "is_complex", "is_empty", "is_floating_point", "is_integer", "is_tensor",
+    "masked_scatter", "multiplex", "mv", "nanmedian", "poisson", "polygamma",
+    "randint_like", "rank", "select_scatter", "sgn", "shard_index",
+    "strided_slice", "take", "tolist", "tril_indices", "triu_indices",
+    "unflatten", "unique_consecutive", "view_as",
+]
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@tensor_op
+def complex(real, imag, name=None):
+    return jax.lax.complex(real, imag)
+
+
+@tensor_op
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@tensor_op
+def sgn(x, name=None):
+    if jnp.issubdtype(jnp.result_type(x), jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+    return jnp.sign(x)
+
+
+@tensor_op
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+@tensor_op
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@tensor_op
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@tensor_op
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@tensor_op
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@tensor_op
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@tensor_op
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, x.shape[:axis] + shape + x.shape[axis + 1:])
+
+
+@tensor_op
+def view_as(x, other, name=None):
+    return jnp.reshape(x, other.shape)
+
+
+@tensor_op
+def take(x, index, mode="raise", name=None):
+    # paddle.take: flattened-x gather; mode governs out-of-range indices.
+    # 'raise' cannot raise inside traced code — clamps like the reference's
+    # GPU kernel (device asserts are not portable to XLA).
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        # numpy/paddle clip semantics: raw indices clamped to [0, n-1]
+        # (negatives go to 0, NOT python-style last-element)
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # "raise": python-style negatives, then clamp
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return jnp.take(flat, idx)
+
+
+@tensor_op
+def index_sample(x, index):
+    # out[i, j] = x[i, index[i, j]] (reference index_sample op)
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@tensor_op
+def index_fill(x, index, axis, value, name=None):
+    index = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    filled = moved.at[index].set(value)
+    return jnp.moveaxis(filled, 0, axis)
+
+
+@tensor_op
+def select_scatter(x, values, axis, index, name=None):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(values)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@tensor_op
+def masked_scatter(x, mask, value, name=None):
+    # positions where mask is True take consecutive elements of value
+    # (row-major), matching the reference; static-shape formulation via
+    # cumsum so it stays jittable
+    mask_b = jnp.broadcast_to(mask.astype(bool), x.shape)
+    pos = jnp.cumsum(mask_b.ravel()) - 1
+    vflat = jnp.ravel(value)
+    picked = jnp.take(vflat, jnp.clip(pos, 0, vflat.shape[0] - 1))
+    return jnp.where(mask_b, picked.reshape(x.shape), x)
+
+
+@tensor_op
+def multiplex(inputs, index, name=None):
+    # out[i] = inputs[index[i]][i] — row-wise selection among candidates
+    stacked = jnp.stack(list(inputs), axis=0)  # [K, N, ...]
+    idx = jnp.reshape(index, (-1,)).astype(jnp.int32)  # [N]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@tensor_op
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    # PS-style vocab sharding (reference shard_index op): indices owned by
+    # this shard map to local ids, the rest to ignore_value
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id ({shard_id}) must be in [0, {nshards})")
+    shard_size = (index_num + nshards - 1) // nshards
+    owned = (input // shard_size) == shard_id
+    return jnp.where(owned, input % shard_size, ignore_value)
+
+
+@tensor_op
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    slices = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        slices[ax] = slice(int(s), int(e), int(st))
+    return x[tuple(slices)]
+
+
+def _split_impl(x, num_or_indices, axis):
+    from ..core.tensor import Tensor as _T
+    from ._op import unwrap
+    v = unwrap(x)
+    if isinstance(num_or_indices, int):
+        parts = jnp.array_split(v, num_or_indices, axis=axis)
+    else:
+        parts = jnp.split(v, [int(i) for i in num_or_indices], axis=axis)
+    return [_T(p) for p in parts]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return _split_impl(x, num_or_indices, axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    from ._op import unwrap
+    return _split_impl(x, num_or_indices, 1 if unwrap(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_impl(x, num_or_indices, 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_impl(x, num_or_indices, 2)
+
+
+@tensor_op(differentiable=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, name=None):
+    # data-dependent output shape — host-synchronizing eager op, like the
+    # reference's unique_consecutive kernel (and our `unique`)
+    import numpy as np
+    v = np.asarray(x)
+    if axis is None:
+        v = v.ravel()
+        ax = 0
+    else:
+        ax = axis
+    moved = np.moveaxis(v, ax, 0)
+    if moved.shape[0] == 0:
+        keep = np.zeros(0, dtype=bool)
+    else:
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    out = np.moveaxis(moved[keep], 0, ax)
+    results = [jnp.asarray(out)]
+    if return_inverse:
+        results.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        starts = np.flatnonzero(keep)
+        counts = np.diff(np.append(starts, moved.shape[0]))
+        results.append(jnp.asarray(counts))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def tolist(x):
+    import numpy as np
+    from ._op import unwrap
+    return np.asarray(unwrap(x)).tolist()
+
+
+def rank(x):
+    from ..core.tensor import Tensor as _T
+    from ._op import unwrap
+    return _T(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor as _T
+    return isinstance(x, _T)
+
+
+def _dtype_of(x):
+    from ._op import unwrap
+    return jnp.result_type(unwrap(x))
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(_dtype_of(x), jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_dtype_of(x), jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(_dtype_of(x), jnp.integer))
+
+
+def is_empty(x):
+    from ..core.tensor import Tensor as _T
+    from ._op import unwrap
+    return _T(jnp.asarray(unwrap(x).size == 0))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    import numpy as np
+    from ..core import dtype as dtype_mod
+    from ..core.tensor import Tensor as _T
+    col = row if col is None else col
+    idx = np.tril_indices(row, k=offset, m=col)
+    return _T(jnp.asarray(np.stack(idx), dtype_mod.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    import numpy as np
+    from ..core import dtype as dtype_mod
+    from ..core.tensor import Tensor as _T
+    col = row if col is None else col
+    idx = np.triu_indices(row, k=offset, m=col)
+    return _T(jnp.asarray(np.stack(idx), dtype_mod.to_jax_dtype(dtype)))
+
+
+def poisson(x, name=None):
+    from ..core import random as random_mod
+    from ..core.tensor import Tensor as _T
+    from ._op import unwrap
+    v = unwrap(x)
+    out = jax.random.poisson(random_mod.next_key(), v, shape=v.shape)
+    return _T(out.astype(v.dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    from ..core import dtype as dtype_mod
+    from ..core import random as random_mod
+    from ..core.tensor import Tensor as _T
+    from ._op import unwrap
+    v = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    dt = dtype_mod.to_jax_dtype(dtype) if dtype is not None else v.dtype
+    out = jax.random.randint(random_mod.next_key(), v.shape, int(low),
+                             int(high))
+    return _T(out.astype(dt))

@@ -76,7 +76,12 @@ def child(overrides):
 def main():
     only = None
     if "--only" in sys.argv:
-        only = sys.argv[sys.argv.index("--only") + 1].split(",")
+        pos = sys.argv.index("--only") + 1
+        if pos >= len(sys.argv):
+            print("usage: perf_sweep.py [--only substr[,substr...]]",
+                  file=sys.stderr)
+            return 2
+        only = sys.argv[pos].split(",")
     for name, overrides in VARIANTS:
         if only is not None and not any(s in name for s in only):
             continue

@@ -244,3 +244,140 @@ class TestSignal:
         with pytest.raises(ValueError, match="onesided"):
             paddle.signal.istft(spec, n_fft=32, onesided=True,
                                 return_complex=True)
+
+
+class TestR3LongTail:
+    """The r3 long-tail batch (broadcast_shape..randint_like), numpy oracles."""
+
+    def test_shapes_and_views(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert paddle.unflatten(_t(x), 1, (2, 2)).shape == [3, 2, 2]
+        assert paddle.view_as(_t(x), _t(np.zeros((4, 3)))).shape == [4, 3]
+        assert int(paddle.rank(_t(x)).numpy()) == 2
+        np.testing.assert_allclose(paddle.mv(_t(x), _t(np.ones(4, np.float32))).numpy(),
+                                   x @ np.ones(4, np.float32))
+
+    def test_predicates(self):
+        x = _t(np.zeros((2, 2), np.float32))
+        assert paddle.is_tensor(x) and not paddle.is_tensor(0)
+        assert paddle.is_floating_point(x)
+        assert paddle.is_integer(_t(np.array([1])))
+        assert paddle.is_complex(_t(np.array([1 + 2j], np.complex64)))
+        assert bool(paddle.is_empty(_t(np.zeros((0, 3)))).numpy())
+        assert not bool(paddle.is_empty(x).numpy())
+
+    def test_complex_and_sgn(self):
+        re = np.array([1.0, 0.0, -3.0], np.float32)
+        im = np.array([0.0, 2.0, 4.0], np.float32)
+        c = paddle.complex(_t(re), _t(im))
+        np.testing.assert_allclose(c.numpy(), re + 1j * im)
+        s = paddle.sgn(c).numpy()
+        z = re + 1j * im
+        expect = np.where(np.abs(z) == 0, 0, z / np.where(np.abs(z) == 0, 1, np.abs(z)))
+        np.testing.assert_allclose(s, expect, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.sgn(_t(np.array([-2.0, 0.0, 5.0], np.float32))).numpy(),
+            [-1.0, 0.0, 1.0])
+
+    def test_bessel_polygamma(self):
+        from scipy import special
+        x = np.linspace(0.1, 3.0, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.i0(_t(x)).numpy(), special.i0(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i0e(_t(x)).numpy(), special.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1(_t(x)).numpy(), special.i1(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(_t(x)).numpy(), special.i1e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.polygamma(_t(x), 1).numpy(),
+                                   special.polygamma(1, x), rtol=1e-4)
+
+    def test_take_modes(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 13, -1])
+        np.testing.assert_allclose(paddle.take(_t(x), _t(idx), mode="wrap").numpy(),
+                                   np.take(x, idx, mode="wrap"))
+        np.testing.assert_allclose(paddle.take(_t(x), _t(np.array([-3, 0, 11, 20])),
+                                               mode="clip").numpy(),
+                                   np.take(x, [-3, 0, 11, 20], mode="clip"))
+        np.testing.assert_allclose(paddle.take(_t(x), _t(np.array([-1, 2]))).numpy(),
+                                   x.ravel()[[-1, 2]])
+
+    def test_index_ops(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([[0, 1], [2, 3], [0, 0]])
+        np.testing.assert_allclose(paddle.index_sample(_t(x), _t(idx)).numpy(),
+                                   np.take_along_axis(x, idx, axis=1))
+        out = paddle.index_fill(_t(x), _t(np.array([1])), 0, -1.0).numpy()
+        assert (out[1] == -1.0).all() and (out[0] == x[0]).all()
+        ss = paddle.select_scatter(_t(x), _t(np.zeros(3, np.float32)), 1, 2).numpy()
+        assert (ss[:, 2] == 0).all() and (ss[:, 0] == x[:, 0]).all()
+
+    def test_masked_scatter_and_multiplex(self):
+        x = np.zeros((2, 3), np.float32)
+        mask = np.array([[True, False, True], [False, True, False]])
+        vals = np.arange(10, 16, dtype=np.float32)
+        out = paddle.masked_scatter(_t(x), _t(mask), _t(vals)).numpy()
+        expect = x.copy()
+        expect[mask] = vals[: mask.sum()]
+        np.testing.assert_allclose(out, expect)
+        a = np.arange(6, dtype=np.float32).reshape(3, 2)
+        b = a + 100
+        sel = paddle.multiplex([_t(a), _t(b)], _t(np.array([[0], [1], [0]]))).numpy()
+        np.testing.assert_allclose(sel, np.stack([a[0], b[1], a[2]]))
+
+    def test_shard_index(self):
+        out = paddle.shard_index(_t(np.array([0, 5, 9, 15])), 20, 2, 0).numpy()
+        np.testing.assert_array_equal(out, [0, 5, 9, -1])
+        out1 = paddle.shard_index(_t(np.array([0, 5, 9, 15])), 20, 2, 1).numpy()
+        np.testing.assert_array_equal(out1, [-1, -1, -1, 5])
+
+    def test_splits(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
+        for ours, ref in [(paddle.tensor_split(_t(x), 4, axis=1),
+                           np.array_split(x, 4, axis=1)),
+                          (paddle.hsplit(_t(x), 2), np.array_split(x, 2, 1)),
+                          (paddle.vsplit(_t(x), 2), np.array_split(x, 2, 0)),
+                          (paddle.dsplit(_t(x), 2), np.array_split(x, 2, 2))]:
+            assert len(ours) == len(ref)
+            for o, r in zip(ours, ref):
+                np.testing.assert_allclose(o.numpy(), r)
+        parts = paddle.tensor_split(_t(x), [1, 4], axis=1)
+        assert [p.shape[1] for p in parts] == [1, 3, 2]
+
+    def test_strided_slice(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        out = paddle.strided_slice(_t(x), [0, 1], [0, 1], [4, 6], [2, 2]).numpy()
+        np.testing.assert_allclose(out, x[0:4:2, 1:6:2])
+
+    def test_unique_consecutive(self):
+        x = np.array([1, 1, 2, 2, 2, 3, 1])
+        u, inv, cnt = paddle.unique_consecutive(_t(x), return_inverse=True,
+                                                return_counts=True)
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3, 1])
+        np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3])
+        np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 1])
+
+    def test_tri_indices_and_tolist(self):
+        np.testing.assert_array_equal(paddle.tril_indices(3).numpy(),
+                                      np.stack(np.tril_indices(3)))
+        np.testing.assert_array_equal(paddle.triu_indices(3, offset=1).numpy(),
+                                      np.stack(np.triu_indices(3, k=1)))
+        x = np.arange(4, dtype=np.float32).reshape(2, 2)
+        assert _t(x).tolist() == [[0.0, 1.0], [2.0, 3.0]]
+        assert paddle.tolist(_t(x)) == [[0.0, 1.0], [2.0, 3.0]]
+
+    def test_nanmedian(self):
+        x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+        np.testing.assert_allclose(paddle.nanmedian(_t(x)).numpy(),
+                                   np.nanmedian(x))
+        np.testing.assert_allclose(paddle.nanmedian(_t(x), axis=1).numpy(),
+                                   np.nanmedian(x, axis=1))
+
+    def test_random_ops(self):
+        paddle.seed(123)
+        p = paddle.poisson(_t(np.full((2000,), 4.0, np.float32))).numpy()
+        assert abs(p.mean() - 4.0) < 0.3  # Poisson(4): se(mean) ~ 0.045
+        assert p.dtype == np.float32
+        r = paddle.randint_like(_t(np.zeros((100,), np.float32)), 2, 7).numpy()
+        assert r.min() >= 2 and r.max() < 7
+        r2 = paddle.randint_like(_t(np.zeros((10,), np.float32)), 5).numpy()
+        assert r2.min() >= 0 and r2.max() < 5
