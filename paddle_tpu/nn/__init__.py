@@ -16,6 +16,8 @@ from .layers.common import (AlphaDropout, Bilinear, ChannelShuffle,
                             Flatten, Identity, Linear, Pad2D, PixelShuffle,
                             Upsample)
 from .layers.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
+                         SimpleRNNCell)
 from .layers.loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
                           KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
                           NLLLoss, SmoothL1Loss)

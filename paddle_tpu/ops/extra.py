@@ -469,8 +469,14 @@ def masked_scatter(x, mask, value, name=None):
     # (row-major), matching the reference; static-shape formulation via
     # cumsum so it stays jittable
     mask_b = jnp.broadcast_to(mask.astype(bool), x.shape)
-    pos = jnp.cumsum(mask_b.ravel()) - 1
     vflat = jnp.ravel(value)
+    if not isinstance(mask_b, jax.core.Tracer):
+        needed = int(jnp.sum(mask_b))
+        if needed > vflat.shape[0]:
+            raise ValueError(
+                f"masked_scatter: value supplies {vflat.shape[0]} elements "
+                f"but mask selects {needed}")
+    pos = jnp.cumsum(mask_b.ravel()) - 1
     picked = jnp.take(vflat, jnp.clip(pos, 0, vflat.shape[0] - 1))
     return jnp.where(mask_b, picked.reshape(x.shape), x)
 

@@ -381,3 +381,45 @@ class TestR3LongTail:
         assert r.min() >= 2 and r.max() < 7
         r2 = paddle.randint_like(_t(np.zeros((10,), np.float32)), 5).numpy()
         assert r2.min() >= 0 and r2.max() < 5
+
+
+class TestLinalgGaps:
+    def test_norms_and_cond(self):
+        x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.vector_norm(_t(x.ravel()), p=3).numpy(),
+                                   np.linalg.norm(x.ravel(), ord=3), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.matrix_norm(_t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.cond(_t(x)).numpy(),
+                                   np.linalg.cond(x), rtol=1e-4)
+
+    def test_svd_lowrank_reconstructs(self):
+        rng = np.random.RandomState(1)
+        # exactly rank-2 matrix: rank-2 truncation must reconstruct it
+        a = (rng.randn(6, 2) @ rng.randn(2, 5)).astype(np.float32)
+        u, s, v = paddle.linalg.svd_lowrank(_t(a), q=2)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_linalg_namespace_complete(self):
+        for n in ["cholesky_solve", "eigvals", "householder_product", "inv",
+                  "lu", "lu_unpack", "multi_dot", "vector_norm",
+                  "matrix_norm", "cond", "svd_lowrank"]:
+            assert hasattr(paddle.linalg, n), n
+
+
+class TestReviewRegressions:
+    def test_vector_norm_flattens(self):
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.vector_norm(_t(x)).numpy(),
+                                   np.linalg.norm(x.ravel()), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.vector_norm(_t(x), p=3).numpy(),
+            np.linalg.norm(x.ravel(), ord=3), rtol=1e-5)
+
+    def test_masked_scatter_undersupply_raises(self):
+        x = np.zeros(4, np.float32)
+        mask = np.array([True, True, True, True])
+        with pytest.raises(ValueError):
+            paddle.masked_scatter(_t(x), _t(mask),
+                                  _t(np.array([1.0, 2.0], np.float32)))

@@ -1,0 +1,196 @@
+"""Recurrent layer tests (reference: ``test/legacy_test/test_rnn_*.py`` —
+cell/stack correctness vs an independent oracle). Oracle: torch.nn (cpu),
+whose LSTM/GRU gate conventions match paddle's (i,f,g,o / r,u,c)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def _copy_cell_from_torch(cell, t_mod, layer=0, direction=0, torch_is_cell=False):
+    sfx = "" if torch_is_cell else f"_l{layer}{'_reverse' if direction else ''}"
+    cell.weight_ih.set_value(getattr(t_mod, f"weight_ih{sfx}").detach().numpy())
+    cell.weight_hh.set_value(getattr(t_mod, f"weight_hh{sfx}").detach().numpy())
+    cell.bias_ih.set_value(getattr(t_mod, f"bias_ih{sfx}").detach().numpy())
+    cell.bias_hh.set_value(getattr(t_mod, f"bias_hh{sfx}").detach().numpy())
+
+
+B, T, I, H = 2, 6, 3, 5
+
+
+def _x(seed=0):
+    return np.random.RandomState(seed).randn(B, T, I).astype(np.float32)
+
+
+class TestCellsVsTorch:
+    def test_lstm_cell(self):
+        tc = torch.nn.LSTMCell(I, H)
+        c = nn.LSTMCell(I, H)
+        _copy_cell_from_torch(c, tc, torch_is_cell=True)
+        x = _x()[:, 0]
+        th, tcc = tc(torch.tensor(x))
+        h, (h2, cc) = c(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(h), th.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(_np(cc), tcc.detach().numpy(), atol=1e-5)
+
+    def test_gru_cell(self):
+        tc = torch.nn.GRUCell(I, H)
+        c = nn.GRUCell(I, H)
+        _copy_cell_from_torch(c, tc, torch_is_cell=True)
+        x = _x()[:, 0]
+        th = tc(torch.tensor(x))
+        h, _ = c(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(h), th.detach().numpy(), atol=1e-5)
+
+    def test_simple_cell(self):
+        tc = torch.nn.RNNCell(I, H)
+        c = nn.SimpleRNNCell(I, H)
+        _copy_cell_from_torch(c, tc, torch_is_cell=True)
+        x = _x()[:, 0]
+        np.testing.assert_allclose(_np(c(paddle.to_tensor(x))[0]),
+                                   tc(torch.tensor(x)).detach().numpy(),
+                                   atol=1e-5)
+
+
+class TestStacksVsTorch:
+    @pytest.mark.parametrize("mode,ours,theirs", [
+        ("lstm", nn.LSTM, torch.nn.LSTM),
+        ("gru", nn.GRU, torch.nn.GRU),
+        ("simple", nn.SimpleRNN, torch.nn.RNN),
+    ])
+    def test_single_layer(self, mode, ours, theirs):
+        tm = theirs(I, H, num_layers=1, batch_first=True)
+        m = ours(I, H, num_layers=1)
+        _copy_cell_from_torch(m.cells[0], tm)
+        x = _x(1)
+        ty = tm(torch.tensor(x))[0].detach().numpy()
+        y, _ = m(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), ty, atol=1e-5)
+
+    def test_bidirectional_two_layer_lstm(self):
+        tm = torch.nn.LSTM(I, H, num_layers=2, batch_first=True,
+                           bidirectional=True)
+        m = nn.LSTM(I, H, num_layers=2, direction="bidirect")
+        for li in range(2):
+            for di in range(2):
+                _copy_cell_from_torch(m.cells[li * 2 + di], tm, li, di)
+        x = _x(2)
+        ty, (thn, tcn) = tm(torch.tensor(x))
+        y, (hn, cn) = m(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(y), ty.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(_np(hn), thn.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(_np(cn), tcn.detach().numpy(), atol=1e-5)
+
+    def test_initial_states_roundtrip(self):
+        tm = torch.nn.GRU(I, H, num_layers=1, batch_first=True)
+        m = nn.GRU(I, H)
+        _copy_cell_from_torch(m.cells[0], tm)
+        h0 = np.random.RandomState(3).randn(1, B, H).astype(np.float32)
+        x = _x(3)
+        ty, thn = tm(torch.tensor(x), torch.tensor(h0))
+        y, hn = m(paddle.to_tensor(x), paddle.to_tensor(h0))
+        np.testing.assert_allclose(_np(y), ty.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(_np(hn), thn.detach().numpy(), atol=1e-5)
+
+
+class TestRNNWrapperAndTraining:
+    def test_rnn_matches_manual_cell_loop(self):
+        paddle.seed(7)
+        cell = nn.SimpleRNNCell(I, H)
+        y, hT = nn.RNN(cell)(paddle.to_tensor(_x(4)))
+        st, outs = None, []
+        for t in range(T):
+            o, st = cell(paddle.to_tensor(_x(4)[:, t]), st)
+            outs.append(_np(o))
+        np.testing.assert_allclose(_np(y), np.stack(outs, 1), rtol=1e-5)
+        np.testing.assert_allclose(_np(hT), outs[-1], rtol=1e-5)
+
+    def test_reverse_direction(self):
+        paddle.seed(8)
+        cell = nn.GRUCell(I, H)
+        y_fwd, _ = nn.RNN(cell)(paddle.to_tensor(_x(5)[:, ::-1].copy()))
+        y_rev, _ = nn.RNN(cell, is_reverse=True)(paddle.to_tensor(_x(5)))
+        np.testing.assert_allclose(_np(y_rev), _np(y_fwd)[:, ::-1], rtol=1e-5)
+
+    def test_time_major(self):
+        paddle.seed(9)
+        cell = nn.LSTMCell(I, H)
+        x = _x(6)
+        y_bm, _ = nn.RNN(cell)(paddle.to_tensor(x))
+        y_tm, _ = nn.RNN(cell, time_major=True)(
+            paddle.to_tensor(x.transpose(1, 0, 2).copy()))
+        np.testing.assert_allclose(_np(y_tm), _np(y_bm).transpose(1, 0, 2),
+                                   rtol=1e-5)
+
+    def test_lstm_trains(self):
+        paddle.seed(10)
+        m = nn.LSTM(I, H)
+        head = nn.Linear(H, 1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2,
+            parameters=list(m.parameters()) + list(head.parameters()))
+        x = paddle.to_tensor(_x(7))
+        tgt = paddle.to_tensor(np.ones((B, 1), np.float32))
+        losses = []
+        for _ in range(8):
+            y, (hn, cn) = m(x)
+            pred = head(hn[-1])
+            loss = paddle.mean((pred - tgt) * (pred - tgt))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.value))
+        assert losses[-1] < losses[0]
+
+    def test_jit_train_step(self):
+        paddle.seed(11)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.rnn = nn.GRU(I, H)
+                self.fc = nn.Linear(H, 2)
+
+            def forward(self, x):
+                y, hn = self.rnn(x)
+                return self.fc(hn[-1])
+
+        from paddle_tpu.jit import TrainStep
+        net = Net()
+        step = TrainStep(net, nn.CrossEntropyLoss(),
+                         paddle.optimizer.Adam(learning_rate=1e-2,
+                                               parameters=net.parameters()))
+        x = paddle.to_tensor(_x(8))
+        lab = paddle.to_tensor(np.array([0, 1], np.int64))
+        losses = [float(step.step((x,), (lab,)).value) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+
+class TestCustomCell:
+    def test_rnn_accepts_user_cell(self):
+        paddle.seed(12)
+
+        class MyCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(I, H)
+                self.hh = nn.Linear(H, H, bias_attr=False)
+
+            def forward(self, x, states=None):
+                import paddle_tpu as p
+                pre = self.fc(x) if states is None else \
+                    self.fc(x) + self.hh(states)
+                h = p.tanh(pre)
+                return h, h
+
+        x = paddle.to_tensor(_x(13))
+        y, hT = nn.RNN(MyCell())(x)
+        assert y.shape == [B, T, H]
+        np.testing.assert_allclose(_np(hT), _np(y)[:, -1], rtol=1e-6)
