@@ -13,21 +13,29 @@ from .layers.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                 Softsign, Swish, Tanh, Tanhshrink)
 from .layers.common import (AlphaDropout, Bilinear, ChannelShuffle,
                             CosineSimilarity, Dropout, Dropout2D, Embedding,
-                            Flatten, Identity, Linear, Pad2D, PixelShuffle,
-                            Upsample)
-from .layers.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+                            Flatten, Fold, Identity, Linear, Maxout, Pad1D,
+                            Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
+                            Softmax2D, Unfold, Upsample, ZeroPad2D)
+from .layers.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                          Conv3D, Conv3DTranspose)
 from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
                          SimpleRNNCell)
-from .layers.loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
-                          KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
-                          NLLLoss, SmoothL1Loss)
+from .layers.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
+                          HingeEmbeddingLoss, KLDivLoss, L1Loss,
+                          MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,
+                          MultiMarginLoss, NLLLoss, PoissonNLLLoss,
+                          SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+                          TripletMarginWithDistanceLoss)
 from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                           GroupNorm, InstanceNorm2D, LayerNorm,
                           LocalResponseNorm, RMSNorm, SpectralNorm,
                           SyncBatchNorm)
 from .layers.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                             AdaptiveAvgPool3D, AdaptiveMaxPool1D,
                              AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
-                             MaxPool1D, MaxPool2D)
+                             AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+                             MaxUnPool2D)
 from .layers.transformer import (MultiHeadAttention, Transformer,
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)

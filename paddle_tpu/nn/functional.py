@@ -933,3 +933,386 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     m = int(maxlen) if maxlen is not None else int(np.asarray(ln).max())
     out = jnp.arange(m)[None, :] < jnp.reshape(ln, (-1, 1))
     return Tensor(out.astype(dtype_mod.to_jax_dtype(dtype)))
+
+
+# ------------------------------------------------------------- r3 nn batch
+# 3-D pooling, 1-D/3-D transposed conv, fold/maxout, and the loss zoo
+# (reference: python/paddle/nn/functional/{pooling,conv,common,loss}.py).
+
+
+@tensor_op
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        raise NotImplementedError("max_pool3d return_mask")
+    k = _pair(kernel_size, 3)
+    s = _pair(stride, 3) if stride is not None else k
+    pads = _conv_padding(padding, 3)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    if isinstance(pads, str):
+        return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
+                                     (1, 1) + s, padding=pads)
+    extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], pads[i][0])
+              if ceil_mode else 0) for i in range(3)]
+    pad_cfg = [(0, 0), (0, 0)] + [(pads[i][0], pads[i][1] + extra[i])
+                                  for i in range(3)]
+    return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
+                                 padding=pad_cfg)
+
+
+@tensor_op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    k = _pair(kernel_size, 3)
+    s = _pair(stride, 3) if stride is not None else k
+    pads = _conv_padding(padding, 3)
+    if isinstance(pads, str):
+        pad_cfg = pads
+    else:
+        extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], pads[i][0])
+                  if ceil_mode else 0) for i in range(3)]
+        pad_cfg = [(0, 0), (0, 0)] + [(pads[i][0], pads[i][1] + extra[i])
+                                      for i in range(3)]
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, padding=pad_cfg)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive and not isinstance(pad_cfg, str):
+        ones = jnp.ones((1, 1) + x.shape[-3:], x.dtype)
+        count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1) + k,
+                                      (1, 1) + s, padding=pad_cfg)
+        return summed / count
+    return summed / (k[0] * k[1] * k[2])
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d return_mask")
+    from ..ops import squeeze, unsqueeze
+    out = adaptive_max_pool2d(unsqueeze(x, -1), (int(output_size), 1))
+    return squeeze(out, -1)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    from ..ops import squeeze, unsqueeze
+    out = adaptive_avg_pool2d(unsqueeze(x, -1), (int(output_size), 1))
+    return squeeze(out, -1)
+
+
+@tensor_op
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    od, oh, ow = _pair(output_size, 3)
+    D, H, W = x.shape[-3:]
+    if od == 1 and oh == 1 and ow == 1:
+        return jnp.mean(x, axis=(-3, -2, -1), keepdims=True)
+    if D % od == 0 and H % oh == 0 and W % ow == 0:
+        xr = jnp.reshape(x, x.shape[:-3] + (od, D // od, oh, H // oh,
+                                            ow, W // ow))
+        return jnp.mean(xr, axis=(-5, -3, -1))
+    outs = [jnp.mean(x[..., (i * D) // od:-(-(i + 1) * D // od), :, :],
+                     axis=-3, keepdims=True) for i in range(od)]
+    xd = jnp.concatenate(outs, axis=-3)
+    rows = [jnp.mean(xd[..., :, (i * H) // oh:-(-(i + 1) * H // oh), :],
+                     axis=-2, keepdims=True) for i in range(oh)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.mean(xh[..., :, :, (j * W) // ow:-(-(j + 1) * W // ow)],
+                     axis=-1, keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@tensor_op
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Inverse of max_pool2d(return_mask=True): scatters pooled values back
+    to their argmax positions (indices are flattened H*W, paddle layout)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    N, C, IH, IW = x.shape
+    if output_size is None:
+        OH = (IH - 1) * s[0] - 2 * p[0] + k[0]
+        OW = (IW - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        OH, OW = output_size[-2], output_size[-1]
+    flat = jnp.zeros((N, C, OH * OW), x.dtype)
+    idx = indices.reshape(N, C, IH * IW).astype(jnp.int32)
+    out = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+                  idx].set(x.reshape(N, C, IH * IW))
+    return out.reshape(N, C, OH, OW)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCL"):
+    from ..ops import squeeze, unsqueeze
+    out = conv2d_transpose(
+        unsqueeze(x, -1), unsqueeze(weight, -1), bias,
+        (_pair(stride, 1)[0], 1), (_pair(padding, 1)[0], 0),
+        (_pair(output_padding, 1)[0], 0), (_pair(dilation, 1)[0], 1), groups)
+    return squeeze(out, -1)
+
+
+@tensor_op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    opad = _pair(output_padding, 3)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _conv_padding(padding, 3)
+    ks = weight.shape[2:]
+    pad_t = [(dilation[i] * (ks[i] - 1) - pads[i][0],
+              dilation[i] * (ks[i] - 1) - pads[i][1] + opad[i])
+             for i in range(3)]
+    if groups > 1:
+        ic = weight.shape[0]
+        w = jnp.reshape(weight, (groups, ic // groups) + tuple(weight.shape[1:]))
+        w = jnp.flip(w, axis=(-3, -2, -1))
+        w = jnp.swapaxes(w, 1, 2)
+        w = jnp.reshape(w, (w.shape[0] * w.shape[1],) + tuple(w.shape[2:]))
+    else:
+        w = jnp.swapaxes(jnp.flip(weight, axis=(-3, -2, -1)), 0, 1)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad_t, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+@tensor_op
+def maxout(x, groups, axis=1, name=None):
+    axis = axis % x.ndim
+    C = x.shape[axis]
+    if C % groups != 0:
+        raise ValueError(f"channels ({C}) not divisible by groups ({groups})")
+    shape = x.shape[:axis] + (C // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+@tensor_op
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold: [N, C*kh*kw, L] -> [N, C, H, W], summing
+    overlapping patches (reference F.fold / col2im)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xr = jnp.reshape(x, (N, C, kh, kw, nh, nw))
+    padded = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    rows = jnp.arange(nh) * sh
+    cols = jnp.arange(nw) * sw
+    for i in range(kh):
+        for j in range(kw):
+            padded = padded.at[:, :, (rows + i * dh)[:, None],
+                               (cols + j * dw)[None, :]].add(xr[:, :, i, j])
+    return padded[:, :, ph:ph + oh, pw:pw + ow]
+
+
+# ------------------------------------------------------------- loss zoo
+@tensor_op
+def square_error_cost(input, label):
+    d = input - label
+    return d * d
+
+
+@tensor_op
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+@tensor_op
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    l = -jax.nn.log_sigmoid(label * input)  # stable log1p(exp(-yx))
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    if log_input:
+        l = jnp.exp(input) - label * input
+    else:
+        l = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for the label! term, applied where label > 1
+        stirling = (label * jnp.log(label + (label <= 1)) - label
+                    + 0.5 * jnp.log(2.0 * jnp.pi * (label + (label <= 1))))
+        l = l + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    var = jnp.maximum(variance, epsilon)
+    l = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        l = l + 0.5 * jnp.log(2.0 * jnp.pi)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+@tensor_op
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    num = jnp.sum(input1 * input2, axis=-1)
+    den = (jnp.linalg.norm(input1, axis=-1) *
+           jnp.linalg.norm(input2, axis=-1))
+    cos = num / jnp.maximum(den, 1e-12)
+    l = jnp.where(label > 0, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(l, reduction)
+
+
+def _triplet_core(dp, dn, margin, reduction):
+    l = jnp.maximum(0.0, dp - dn + margin)
+    return _reduce(l, reduction)
+
+
+@tensor_op
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1) ** (1.0 / p)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _triplet_core(dp, dn, margin, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        from ..ops import minimum
+        dn = minimum(dn, distance_function(positive, negative))
+    from ..core.tensor import Tensor as _T
+    from ..ops import maximum as _max
+    l = _max(dp - dn + margin, _T(jnp.zeros((), jnp.float32)))
+    return _T(_reduce(l.value, reduction))
+
+
+@tensor_op
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    l = -(label * jax.nn.log_sigmoid(input)
+          + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        l = l * weight
+    return _reduce(jnp.mean(l, axis=-1), reduction)
+
+
+@tensor_op
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    N, C = input.shape
+    picked = jnp.take_along_axis(input, label[:, None].astype(jnp.int32),
+                                 axis=1)
+    m = jnp.maximum(0.0, margin - picked + input) ** p
+    if weight is not None:
+        m = m * jnp.take(weight, label.astype(jnp.int32))[:, None]
+    onehot = jax.nn.one_hot(label, C, dtype=m.dtype)
+    return _reduce(jnp.sum(m * (1.0 - onehot), axis=1) / C, reduction)
+
+
+@tensor_op
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    # input [N, ..., C] softmax probs; label [N, ..., 1] int
+    lab = jax.nn.one_hot(label[..., 0], input.shape[-1], dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(lab, axis=red)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@tensor_op
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = anchor @ positive.T  # [N, N]
+    lab = labels.reshape(-1)
+    tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    lse = jax.scipy.special.logsumexp(sim, axis=1, keepdims=True)
+    ce = jnp.mean(jnp.sum(-tgt * (sim - lse), axis=1))
+    reg = 0.25 * l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                           + jnp.mean(jnp.sum(positive * positive, axis=1)))
+    return ce + reg
+
+
+@tensor_op
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference F.ctc_loss / warpctc): takes UNNORMALIZED logits
+    [Tmax, B, C] like the reference (softmax applied internally), labels
+    [B, Lmax], per-sample input/label lengths. Forward algorithm in the
+    log semiring over the extended blank-interleaved label sequence, as
+    one lax.scan over time — O(T·B·S) with S = 2·Lmax+1."""
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    Tmax, B, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = -1e30
+    lab = jnp.clip(labels.astype(jnp.int32), 0, C - 1)
+    z = jnp.full((B, S), blank, jnp.int32).at[:, 1::2].set(lab)
+    lab_len = label_lengths.astype(jnp.int32)
+    in_len = input_lengths.astype(jnp.int32)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx[None, :] < (2 * lab_len[:, None] + 1)
+    z_prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), z[:, :-2]], axis=1)
+    can_skip = (z != blank) & (z != z_prev2) & (s_idx[None, :] >= 2)
+
+    def lse3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m) + jnp.exp(c - m))
+
+    emit0 = jnp.take_along_axis(lp[0], z, axis=1)
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, emit0[:, 1], NEG))
+
+    def body(alpha, lpt):
+        a1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a2raw = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a2raw, NEG)
+        emit = jnp.take_along_axis(lpt, z, axis=1)
+        new = jnp.where(valid_s, lse3(alpha, a1, a2) + emit, NEG)
+        return new, new
+
+    _, rest = jax.lax.scan(body, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], rest], axis=0)  # [T, B, S]
+    aT = alphas[jnp.clip(in_len - 1, 0, Tmax - 1), jnp.arange(B)]  # [B, S]
+    e1 = jnp.take_along_axis(aT, (2 * lab_len)[:, None], axis=1)[:, 0]
+    e2 = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(aT, jnp.maximum(2 * lab_len - 1, 0)[:, None],
+                            axis=1)[:, 0], NEG)
+    m = jnp.maximum(e1, e2)
+    loss = -(m + jnp.log(jnp.exp(e1 - m) + jnp.exp(e2 - m)))
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # reference divides per-sample loss by its label length first
+        return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+    return _reduce(loss, reduction)

@@ -109,3 +109,53 @@ class Conv2DTranspose(Layer):
         return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
                                   self.padding, self.output_padding,
                                   self.dilation, self.groups, output_size)
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        k = _pair(kernel_size, 1)[0]
+        fan_in = in_channels // groups * k
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, k], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(shape=[out_channels],
+                                           attr=bias_attr, is_bias=True))
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, output_size)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        ks = _pair(kernel_size, 3)
+        fan_in = in_channels // groups * int(np.prod(ks))
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, *ks], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(shape=[out_channels],
+                                           attr=bias_attr, is_bias=True))
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, output_size)

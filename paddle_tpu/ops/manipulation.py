@@ -323,8 +323,12 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     """paddle.nn.functional.pad semantics: ``pad`` is either len-2*ndim
     (all dims, paddle "int list" form) or the last-dims-first torch-style list
     applied to spatial dims of NCHW/NHWC/NCL/NCDHW layouts."""
-    pad = [int(unwrap(p)) for p in pad]
     nd = x.ndim
+    if isinstance(pad, int):
+        # scalar form (Pad1D/2D/3D accept one int): same pad on every side
+        # of every spatial dim
+        pad = [pad] * (2 * (nd - 2))
+    pad = [int(unwrap(p)) for p in pad]
     if len(pad) == 2 * nd:
         width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
         return _pad_nd(x, width, mode, value)

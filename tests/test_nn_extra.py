@@ -1,0 +1,255 @@
+"""Tests for the r3 nn batch: 3-D pooling, transposed convs, fold/maxout,
+pads, and the loss zoo incl. CTC (reference:
+``test/legacy_test/test_{pool3d,conv*transpose,fold,ctc_loss,...}_op.py``).
+Oracles: torch (cpu) and closed-form numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+class TestPool3D:
+    def test_max_pool3d_vs_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8, 8).astype(np.float32)
+        ours = _np(F.max_pool3d(_t(x), 2, stride=2))
+        ref = torch.nn.functional.max_pool3d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_avg_pool3d_with_padding(self):
+        x = np.random.RandomState(1).randn(1, 2, 6, 6, 6).astype(np.float32)
+        ours = _np(F.avg_pool3d(_t(x), 3, stride=2, padding=1))
+        ref = torch.nn.functional.avg_pool3d(
+            torch.tensor(x), 3, 2, 1, count_include_pad=False).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_adaptive_avg_pool3d(self):
+        x = np.random.RandomState(2).randn(1, 2, 8, 6, 4).astype(np.float32)
+        ours = _np(nn.AdaptiveAvgPool3D((2, 3, 2))(_t(x)))
+        ref = torch.nn.functional.adaptive_avg_pool3d(
+            torch.tensor(x), (2, 3, 2)).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_adaptive_max_pool1d(self):
+        x = np.random.RandomState(3).randn(2, 3, 12).astype(np.float32)
+        ours = _np(nn.AdaptiveMaxPool1D(4)(_t(x)))
+        ref = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = np.random.RandomState(4).randn(1, 2, 8, 8).astype(np.float32)
+        pooled, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+        unpooled = _np(F.max_unpool2d(pooled, mask, 2, stride=2))
+        # scattered values sit at the argmax positions; re-pooling recovers
+        repooled = _np(F.max_pool2d(_t(unpooled), 2, stride=2))
+        np.testing.assert_allclose(repooled, _np(pooled), atol=1e-6)
+        assert unpooled.shape == x.shape
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_vs_torch(self):
+        x = np.random.RandomState(5).randn(2, 3, 10).astype(np.float32)
+        w = np.random.RandomState(6).randn(3, 4, 5).astype(np.float32)
+        ours = _np(F.conv1d_transpose(_t(x), _t(w), stride=2, padding=1))
+        ref = torch.nn.functional.conv_transpose1d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_conv3d_transpose_vs_torch(self):
+        x = np.random.RandomState(7).randn(1, 3, 4, 4, 4).astype(np.float32)
+        w = np.random.RandomState(8).randn(3, 2, 3, 3, 3).astype(np.float32)
+        ours = _np(F.conv3d_transpose(_t(x), _t(w), stride=2, padding=1,
+                                      output_padding=1))
+        ref = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            output_padding=1).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_layer_shapes(self):
+        y = nn.Conv1DTranspose(3, 5, 4, stride=2)(
+            _t(np.zeros((2, 3, 8), np.float32)))
+        assert y.shape == [2, 5, 18]
+        y3 = nn.Conv3DTranspose(2, 4, 3)(
+            _t(np.zeros((1, 2, 4, 4, 4), np.float32)))
+        assert y3.shape == [1, 4, 6, 6, 6]
+
+
+class TestFoldMaxout:
+    def test_fold_inverts_unfold_ones(self):
+        # fold(unfold(x)) multiplies each pixel by its window-coverage count;
+        # verify against torch's fold on the same unfolded input
+        x = np.random.RandomState(9).randn(1, 2, 6, 6).astype(np.float32)
+        cols = F.unfold(_t(x), 3, strides=1, paddings=1)
+        ours = _np(F.fold(cols, (6, 6), 3, strides=1, paddings=1))
+        tcols = torch.nn.functional.unfold(torch.tensor(x), 3, padding=1)
+        ref = torch.nn.functional.fold(tcols, (6, 6), 3, padding=1).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_maxout(self):
+        x = np.random.RandomState(10).randn(2, 6, 4, 4).astype(np.float32)
+        ours = _np(nn.Maxout(3)(_t(x)))
+        ref = x.reshape(2, 2, 3, 4, 4).max(axis=2)
+        np.testing.assert_allclose(ours, ref)
+
+    def test_pads(self):
+        x = np.zeros((1, 2, 4), np.float32)
+        assert nn.Pad1D([1, 2])(_t(x)).shape == [1, 2, 7]
+        x3 = np.zeros((1, 2, 3, 4, 5), np.float32)
+        assert nn.Pad3D(1)(_t(x3)).shape == [1, 2, 5, 6, 7]
+        x2 = np.ones((1, 1, 2, 2), np.float32)
+        z = _np(nn.ZeroPad2D(1)(_t(x2)))
+        assert z.shape == (1, 1, 4, 4) and z[0, 0, 0, 0] == 0
+
+    def test_softmax2d(self):
+        x = np.random.RandomState(11).randn(2, 3, 4, 4).astype(np.float32)
+        out = _np(nn.Softmax2D()(_t(x)))
+        np.testing.assert_allclose(out.sum(axis=1), np.ones((2, 4, 4)),
+                                   atol=1e-5)
+
+
+class TestLossZoo:
+    def test_ctc_loss_vs_torch(self):
+        rng = np.random.RandomState(12)
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.array([12, 10, 8], np.int64)
+        lab_len = np.array([4, 3, 2], np.int64)
+        ours = _np(F.ctc_loss(_t(logits), _t(labels), _t(in_len), _t(lab_len),
+                              blank=0, reduction="none"))
+        ref = torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1), torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="none").numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_grad_flows(self):
+        rng = np.random.RandomState(13)
+        logits = _t(rng.randn(8, 2, 5).astype(np.float32))
+        logits.stop_gradient = False
+        loss = F.ctc_loss(logits, _t(rng.randint(1, 5, (2, 3)).astype(np.int32)),
+                          _t(np.array([8, 8], np.int64)),
+                          _t(np.array([3, 2], np.int64)))
+        loss.backward()
+        g = _np(logits.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_ctc_mean_divides_by_label_len(self):
+        rng = np.random.RandomState(18)
+        T, B, C = 10, 2, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, 4)).astype(np.int32)
+        il = np.array([10, 9], np.int64)
+        ll = np.array([4, 2], np.int64)
+        ours = float(_np(F.ctc_loss(_t(logits), _t(labels), _t(il), _t(ll),
+                                    reduction="mean")))
+        ref = torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1),
+            torch.tensor(labels.astype(np.int64)), torch.tensor(il),
+            torch.tensor(ll), reduction="mean").item()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_soft_margin_stable_at_large_logits(self):
+        out = _np(F.soft_margin_loss(_t(np.array([100.0], np.float32)),
+                                     _t(np.array([-1.0], np.float32)),
+                                     reduction="none"))
+        np.testing.assert_allclose(out, [100.0], rtol=1e-5)
+
+    def test_ctc_layer_reduction(self):
+        rng = np.random.RandomState(14)
+        logits = _t(rng.randn(8, 2, 5).astype(np.float32))
+        crit = nn.CTCLoss(blank=0, reduction="mean")
+        out = crit(logits, _t(rng.randint(1, 5, (2, 3)).astype(np.int32)),
+                   _t(np.array([8, 8], np.int64)),
+                   _t(np.array([3, 3], np.int64)))
+        assert np.isfinite(float(out.value))
+
+    def test_simple_losses_vs_torch(self):
+        rng = np.random.RandomState(15)
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.choice([-1.0, 1.0], (4, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(F.soft_margin_loss(_t(x), _t(y))),
+            torch.nn.functional.soft_margin_loss(
+                torch.tensor(x), torch.tensor(y)).numpy(), rtol=1e-5)
+        lab01 = (y > 0).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(F.multi_label_soft_margin_loss(_t(x), _t(lab01))),
+            torch.nn.functional.multilabel_soft_margin_loss(
+                torch.tensor(x), torch.tensor(lab01)).numpy(), rtol=1e-5)
+        tgt = rng.rand(4, 5).astype(np.float32) + 0.1
+        np.testing.assert_allclose(
+            _np(F.poisson_nll_loss(_t(x), _t(tgt))),
+            torch.nn.functional.poisson_nll_loss(
+                torch.tensor(x), torch.tensor(tgt)).numpy(), rtol=1e-5)
+        var = rng.rand(4, 5).astype(np.float32) + 0.1
+        np.testing.assert_allclose(
+            _np(F.gaussian_nll_loss(_t(x), _t(tgt), _t(var))),
+            torch.nn.functional.gaussian_nll_loss(
+                torch.tensor(x), torch.tensor(tgt), torch.tensor(var)).numpy(),
+            rtol=1e-4)
+
+    def test_margin_family_vs_torch(self):
+        rng = np.random.RandomState(16)
+        a = rng.randn(4, 8).astype(np.float32)
+        p = rng.randn(4, 8).astype(np.float32)
+        n = rng.randn(4, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(F.triplet_margin_loss(_t(a), _t(p), _t(n))),
+            torch.nn.functional.triplet_margin_loss(
+                torch.tensor(a), torch.tensor(p), torch.tensor(n),
+                eps=1e-6).numpy(), rtol=1e-4)
+        lab = np.array([1.0, -1.0, 1.0, -1.0], np.float32)
+        np.testing.assert_allclose(
+            _np(F.cosine_embedding_loss(_t(a), _t(p), _t(lab), margin=0.2)),
+            torch.nn.functional.cosine_embedding_loss(
+                torch.tensor(a), torch.tensor(p), torch.tensor(lab),
+                margin=0.2).numpy(), rtol=1e-5)
+        cls = np.array([0, 2, 1, 3], np.int64)
+        np.testing.assert_allclose(
+            _np(F.multi_margin_loss(_t(a[:, :4]), _t(cls))),
+            torch.nn.functional.multi_margin_loss(
+                torch.tensor(a[:, :4]), torch.tensor(cls)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(F.pairwise_distance(_t(a), _t(p))),
+            torch.nn.functional.pairwise_distance(
+                torch.tensor(a), torch.tensor(p), eps=1e-6).numpy(), rtol=1e-5)
+
+    def test_misc_losses(self):
+        rng = np.random.RandomState(17)
+        probs = rng.rand(4, 3).astype(np.float32) * 0.8 + 0.1
+        lab = rng.rand(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(F.square_error_cost(_t(probs), _t(lab))), (probs - lab) ** 2,
+            rtol=1e-6)
+        ll = _np(F.log_loss(_t(probs[:, :1]), _t((lab[:, :1] > 0.5).astype(np.float32))))
+        assert ll.shape == (4, 1) and (ll > 0).all()
+        soft = np.exp(rng.randn(4, 6, 5).astype(np.float32))
+        soft = (soft / soft.sum(-1, keepdims=True)).astype(np.float32)
+        dl = float(_np(F.dice_loss(_t(soft), _t(rng.randint(0, 5, (4, 6, 1))))))
+        assert 0.0 < dl < 1.0
+        anchor = rng.randn(4, 8).astype(np.float32)
+        pos = rng.randn(4, 8).astype(np.float32)
+        lab = np.array([0, 1, 0, 1], np.int64)
+        npl = float(_np(F.npair_loss(_t(anchor), _t(pos), _t(lab),
+                                     l2_reg=0.002)))
+        sim = anchor @ pos.T
+        tgt = (lab[:, None] == lab[None, :]).astype(np.float32)
+        tgt /= tgt.sum(1, keepdims=True)
+        lse = np.log(np.exp(sim).sum(1, keepdims=True))
+        ce = np.mean(np.sum(-tgt * (sim - lse), axis=1))
+        reg = 0.25 * 0.002 * ((anchor ** 2).sum(1).mean()
+                              + (pos ** 2).sum(1).mean())
+        np.testing.assert_allclose(npl, ce + reg, rtol=1e-4)
