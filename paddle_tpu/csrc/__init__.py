@@ -21,9 +21,21 @@ _lib = None
 _tried = False
 
 
-def _build():
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+def _load_native(src, so, extra_flags=()):
+    """Build-if-stale + dlopen for one native component. Returns the CDLL
+    or None. A prebuilt .so without its source loads as-is (no staleness
+    check possible); build failures degrade to the pure-Python path."""
+    try:
+        have_src = os.path.exists(src)
+        if have_src and (not os.path.exists(so) or
+                         os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 *extra_flags, src, "-o", so],
+                check=True, capture_output=True, timeout=120)
+        return ctypes.CDLL(so)
+    except Exception:
+        return None
 
 
 def _load():
@@ -32,12 +44,8 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        try:
-            if (not os.path.exists(_SO) or
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception:
+        lib = _load_native(_SRC, _SO)
+        if lib is None:
             return None
         lib.shm_ring_create.restype = ctypes.c_void_p
         lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
@@ -138,3 +146,54 @@ class ShmRing:
             self.close(unlink=False)
         except Exception:
             pass
+
+
+# --------------------------------------------------------------- tcp store
+_TCP_SO = os.path.join(_HERE, "_tcp_store.so")
+_TCP_SRC = os.path.join(_HERE, "tcp_store.cpp")
+_tcp_lib = None
+_tcp_tried = False
+
+
+def _load_tcp():
+    global _tcp_lib, _tcp_tried
+    with _lock:
+        if _tcp_lib is not None or _tcp_tried:
+            return _tcp_lib
+        _tcp_tried = True
+        lib = _load_native(_TCP_SRC, _TCP_SO, extra_flags=("-pthread",))
+        if lib is None:
+            return None
+        c = ctypes
+        lib.tcp_store_server_start.restype = c.c_void_p
+        lib.tcp_store_server_start.argtypes = [c.c_char_p, c.c_int]
+        lib.tcp_store_server_port.restype = c.c_int
+        lib.tcp_store_server_port.argtypes = [c.c_void_p]
+        lib.tcp_store_server_clear.argtypes = [c.c_void_p]
+        lib.tcp_store_server_stop.argtypes = [c.c_void_p]
+        lib.tcp_store_connect.restype = c.c_void_p
+        lib.tcp_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.tcp_store_set.restype = c.c_int
+        lib.tcp_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                      c.c_int64]
+        lib.tcp_store_get.restype = c.c_int64
+        lib.tcp_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                      c.c_int64]
+        lib.tcp_store_add.restype = c.c_int64
+        lib.tcp_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.tcp_store_del.restype = c.c_int64
+        lib.tcp_store_del.argtypes = [c.c_void_p, c.c_char_p]
+        lib.tcp_store_prefix.restype = c.c_int64
+        lib.tcp_store_prefix.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                         c.c_int64]
+        lib.tcp_store_wait.restype = c.c_int64
+        lib.tcp_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.tcp_store_clear.restype = c.c_int64
+        lib.tcp_store_clear.argtypes = [c.c_void_p]
+        lib.tcp_store_close.argtypes = [c.c_void_p]
+        _tcp_lib = lib
+        return _tcp_lib
+
+
+def tcp_store_available() -> bool:
+    return _load_tcp() is not None

@@ -1,0 +1,449 @@
+// Native TCP key-value store for multi-host rendezvous — the TPU build's
+// equivalent of the reference's C++ TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.cc †): rank-0 runs the
+// server; every rank connects as a client for set/get/add/wait/barrier
+// during bootstrap. Plain C ABI (ctypes-bound, no pybind11).
+//
+// Wire protocol, length-prefixed, little-endian:
+//   request:  u8 cmd | u32 klen | key | u32 vlen | val
+//   reply:    i64 status | u32 plen | payload
+// cmds: 1=SET 2=GET 3=ADD(val=i64 delta) 4=DEL 5=PREFIX 6=WAIT(val=i64
+// timeout_ms; server-side blocking via the pending-wait list) 7=CLEAR
+//
+// The server is one select() loop on a detached thread: no thread per
+// connection, WAITs park in a pending list and are answered when the key
+// appears (or their deadline passes on the 100ms tick).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, int64_t status, const std::string& payload) {
+  uint32_t plen = static_cast<uint32_t>(payload.size());
+  std::string out;
+  out.resize(12 + payload.size());
+  std::memcpy(&out[0], &status, 8);
+  std::memcpy(&out[8], &plen, 4);
+  if (!payload.empty()) std::memcpy(&out[12], payload.data(), payload.size());
+  return write_exact(fd, out.data(), out.size());
+}
+
+struct PendingWait {
+  int fd;
+  std::string key;
+  int64_t deadline_ms;  // -1 = forever
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;  // guards kv (server thread + clear() from host thread)
+  std::unordered_map<std::string, std::string> kv;
+  std::vector<int> clients;
+  std::vector<PendingWait> waits;
+
+  void answer_ready_waits() {
+    int64_t t = now_ms();
+    for (auto it = waits.begin(); it != waits.end();) {
+      bool found;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        found = kv.count(it->key) != 0;
+      }
+      if (found) {
+        send_reply(it->fd, 0, "");
+        it = waits.erase(it);
+      } else if (it->deadline_ms >= 0 && t > it->deadline_ms) {
+        send_reply(it->fd, -1, "");
+        it = waits.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void drop_client(int fd) {
+    ::close(fd);
+    for (auto it = clients.begin(); it != clients.end(); ++it)
+      if (*it == fd) {
+        clients.erase(it);
+        break;
+      }
+    for (auto it = waits.begin(); it != waits.end();)
+      it = (it->fd == fd) ? waits.erase(it) : it + 1;
+  }
+
+  // one full request from fd; false = connection closed/broken
+  bool handle(int fd) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!read_exact(fd, &cmd, 1) || !read_exact(fd, &klen, 4)) return false;
+    if (klen > (1u << 20)) return false;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, &key[0], klen)) return false;
+    if (!read_exact(fd, &vlen, 4)) return false;
+    if (vlen > (1u << 26)) return false;
+    std::string val(vlen, '\0');
+    if (vlen && !read_exact(fd, &val[0], vlen)) return false;
+
+    switch (cmd) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = val;
+        }
+        return send_reply(fd, 0, "");
+      }
+      case 2: {  // GET
+        std::string out;
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          found = it != kv.end();
+          if (found) out = it->second;
+        }
+        return send_reply(fd, found ? 0 : -1, out);
+      }
+      case 3: {  // ADD — value stored as decimal string (reference layout)
+        int64_t delta = 0;
+        if (vlen == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          if (it != kv.end()) cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          cur += delta;
+          kv[key] = std::to_string(cur);
+        }
+        return send_reply(fd, cur, "");
+      }
+      case 4: {  // DEL
+        size_t n;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          n = kv.erase(key);
+        }
+        return send_reply(fd, static_cast<int64_t>(n), "");
+      }
+      case 5: {  // PREFIX — binary table: u32 count, then (u32 klen, k, u32 vlen, v)*
+        std::string payload(4, '\0');
+        uint32_t count = 0;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          for (auto& e : kv) {
+            if (e.first.rfind(key, 0) != 0) continue;
+            ++count;
+            uint32_t kl = e.first.size(), vl = e.second.size();
+            payload.append(reinterpret_cast<char*>(&kl), 4);
+            payload.append(e.first);
+            payload.append(reinterpret_cast<char*>(&vl), 4);
+            payload.append(e.second);
+          }
+        }
+        std::memcpy(&payload[0], &count, 4);
+        return send_reply(fd, 0, payload);
+      }
+      case 6: {  // WAIT
+        int64_t timeout_ms = -1;
+        if (vlen == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          found = kv.count(key) != 0;
+        }
+        if (found) return send_reply(fd, 0, "");
+        PendingWait w;
+        w.fd = fd;
+        w.key = key;
+        w.deadline_ms = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+        waits.push_back(w);
+        return true;  // reply deferred
+      }
+      case 7: {  // CLEAR
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv.clear();
+        }
+        return send_reply(fd, 0, "");
+      }
+      default:
+        return false;
+    }
+  }
+
+  void loop() {
+    while (!stop.load()) {
+      fd_set rfds;
+      FD_ZERO(&rfds);
+      FD_SET(listen_fd, &rfds);
+      int maxfd = listen_fd;
+      for (int fd : clients) {
+        FD_SET(fd, &rfds);
+        if (fd > maxfd) maxfd = fd;
+      }
+      timeval tv{0, 100 * 1000};  // 100ms tick drives wait deadlines
+      int rc = ::select(maxfd + 1, &rfds, nullptr, nullptr, &tv);
+      if (rc < 0 && errno != EINTR) break;
+      if (rc > 0) {
+        if (FD_ISSET(listen_fd, &rfds)) {
+          int c = ::accept(listen_fd, nullptr, nullptr);
+          if (c >= 0) {
+            int one = 1;
+            ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            // bound handle()'s blocking reads: a client stalled mid-frame
+            // costs at most this timeout, then read_exact fails and the
+            // connection is dropped (instead of wedging every rank's
+            // bootstrap + parked WAIT deadlines)
+            timeval rto{5, 0};
+            ::setsockopt(c, SOL_SOCKET, SO_RCVTIMEO, &rto, sizeof(rto));
+            clients.push_back(c);
+          }
+        }
+        std::vector<int> snapshot = clients;
+        for (int fd : snapshot)
+          if (FD_ISSET(fd, &rfds) && !handle(fd)) drop_client(fd);
+      }
+      answer_ready_waits();
+    }
+    for (int fd : clients) ::close(fd);
+    ::close(listen_fd);
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one in-flight request per connection
+};
+
+bool client_roundtrip(Client* c, uint8_t cmd, const std::string& key,
+                      const std::string& val, int64_t* status,
+                      std::string* payload) {
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t klen = key.size(), vlen = val.size();
+  std::string req;
+  req.push_back(static_cast<char>(cmd));
+  req.append(reinterpret_cast<char*>(&klen), 4);
+  req.append(key);
+  req.append(reinterpret_cast<char*>(&vlen), 4);
+  req.append(val);
+  if (!write_exact(c->fd, req.data(), req.size())) return false;
+  int64_t st;
+  uint32_t plen;
+  if (!read_exact(c->fd, &st, 8) || !read_exact(c->fd, &plen, 4)) return false;
+  std::string body(plen, '\0');
+  if (plen && !read_exact(c->fd, &body[0], plen)) return false;
+  *status = st;
+  if (payload) *payload = std::move(body);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  in_addr_t bind_ip = htonl(INADDR_ANY);
+  if (host && *host) {
+    bind_ip = ::inet_addr(host);
+    if (bind_ip == INADDR_NONE) {  // hostname: resolve like the client does
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+        ::close(fd);
+        return nullptr;
+      }
+      bind_ip = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
+      ::freeaddrinfo(res);
+    }
+  }
+  addr.sin_addr.s_addr = bind_ip;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->thread = std::thread([s] { s->loop(); });
+  return s;
+}
+
+int tcp_store_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void tcp_store_server_clear(void* h) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->kv.clear();
+}
+
+void tcp_store_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  if (s->thread.joinable()) s->thread.join();
+  delete s;
+}
+
+void* tcp_store_connect(const char* host, int port, int timeout_ms) {
+  int64_t deadline = now_ms() + timeout_ms;
+  // hostname -> IPv4 via getaddrinfo (inet_addr alone cannot resolve the
+  // multi-host case this backend exists for)
+  in_addr_t ip = ::inet_addr(host);
+  if (ip == INADDR_NONE) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || !res)
+      return nullptr;
+    ip = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
+    ::freeaddrinfo(res);
+  }
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    addr.sin_addr.s_addr = ip;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (now_ms() > deadline) return nullptr;  // rank-0 may start late: retry
+    ::usleep(100 * 1000);
+  }
+}
+
+int tcp_store_set(void* h, const char* key, const char* val, int64_t vlen) {
+  int64_t st;
+  if (!client_roundtrip(static_cast<Client*>(h), 1, key,
+                        std::string(val, vlen), &st, nullptr))
+    return -2;
+  return static_cast<int>(st);
+}
+
+int64_t tcp_store_get(void* h, const char* key, char* buf, int64_t cap) {
+  int64_t st;
+  std::string payload;
+  if (!client_roundtrip(static_cast<Client*>(h), 2, key, "", &st, &payload))
+    return -2;
+  if (st != 0) return -1;
+  int64_t n = static_cast<int64_t>(payload.size());
+  if (n > cap) return -3;
+  std::memcpy(buf, payload.data(), n);
+  return n;
+}
+
+int64_t tcp_store_add(void* h, const char* key, int64_t delta) {
+  int64_t st;
+  std::string val(8, '\0');
+  std::memcpy(&val[0], &delta, 8);
+  if (!client_roundtrip(static_cast<Client*>(h), 3, key, val, &st, nullptr))
+    return INT64_MIN;
+  return st;
+}
+
+int64_t tcp_store_del(void* h, const char* key) {
+  int64_t st;
+  if (!client_roundtrip(static_cast<Client*>(h), 4, key, "", &st, nullptr))
+    return -2;
+  return st;
+}
+
+int64_t tcp_store_prefix(void* h, const char* prefix, char* buf, int64_t cap) {
+  int64_t st;
+  std::string payload;
+  if (!client_roundtrip(static_cast<Client*>(h), 5, prefix, "", &st, &payload))
+    return -2;
+  int64_t n = static_cast<int64_t>(payload.size());
+  if (n > cap) return -3;
+  std::memcpy(buf, payload.data(), n);
+  return n;
+}
+
+int64_t tcp_store_wait(void* h, const char* key, int64_t timeout_ms) {
+  int64_t st;
+  std::string val(8, '\0');
+  std::memcpy(&val[0], &timeout_ms, 8);
+  if (!client_roundtrip(static_cast<Client*>(h), 6, key, val, &st, nullptr))
+    return -2;
+  return st;  // 0 = key present, -1 = timeout
+}
+
+int64_t tcp_store_clear(void* h) {
+  int64_t st;
+  if (!client_roundtrip(static_cast<Client*>(h), 7, "", "", &st, nullptr))
+    return -2;
+  return st;
+}
+
+void tcp_store_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -19,3 +19,4 @@ from ..parallel import auto_parallel  # noqa: F401
 from . import utils  # noqa: F401
 
 from ..parallel import communication_stream as stream  # noqa: E402
+from .tcp_store import TCPStore  # noqa: E402,F401

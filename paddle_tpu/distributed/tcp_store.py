@@ -1,0 +1,147 @@
+"""paddle.distributed TCPStore (reference: the C++ TCPStore in
+``paddle/phi/core/distributed/store/tcp_store.cc`` † exposed via pybind —
+the rendezvous substrate under init_parallel_env).
+
+Here the store itself IS native C++ (``csrc/tcp_store.cpp``: one select()
+loop, length-prefixed binary protocol, server-side blocking waits), bound
+over a plain C ABI. The master rank hosts the server in-process and every
+rank (master included) talks to it through a client connection — same
+process model as the reference.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from .. import csrc
+
+
+class TCPStore:
+    """Key-value store over TCP with set/get/add/wait/barrier.
+
+    Args mirror the reference: ``is_master`` hosts the server (on ``port``;
+    0 picks an ephemeral port, see ``.port``), everyone connects as a
+    client. ``world_size`` sizes the default barrier.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30.0):
+        lib = csrc._load_tcp()
+        if lib is None:
+            raise RuntimeError(
+                "native TCPStore unavailable (g++ build failed); use the "
+                "HTTP KVServer in paddle_tpu.parallel.launch.rendezvous")
+        self._lib = lib
+        self._server = None
+        self.is_master = is_master
+        self.world_size = world_size
+        if is_master:
+            # bind the REQUESTED interface (loopback by default) — the store
+            # is unauthenticated, so exposing it wider must be an explicit
+            # choice (host="0.0.0.0" / "")
+            self._server = lib.tcp_store_server_start(host.encode(),
+                                                      int(port))
+            if not self._server:
+                raise OSError(f"TCPStore: cannot bind {host}:{port}")
+            port = lib.tcp_store_server_port(self._server)
+        self.host = host
+        self.port = int(port)
+        self._client = lib.tcp_store_connect(
+            host.encode(), self.port, int(timeout * 1000))
+        if not self._client:
+            raise TimeoutError(
+                f"TCPStore: cannot reach master at {host}:{self.port} "
+                f"within {timeout}s")
+
+    # ------------------------------------------------------------- kv ops
+    def set(self, key: str, value):
+        v = value.encode() if isinstance(value, str) else bytes(value)
+        rc = self._lib.tcp_store_set(self._client, key.encode(), v, len(v))
+        if rc != 0:
+            raise ConnectionError("TCPStore.set failed")
+
+    def get(self, key: str):
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tcp_store_get(self._client, key.encode(), buf, cap)
+            if n == -3:
+                cap *= 16
+                continue
+            if n == -2:
+                raise ConnectionError("TCPStore.get failed")
+            if n == -1:
+                return None
+            return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        out = self._lib.tcp_store_add(self._client, key.encode(), int(amount))
+        if out == -(2 ** 63):
+            raise ConnectionError("TCPStore.add failed")
+        return int(out)
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.tcp_store_del(self._client, key.encode()) > 0
+
+    def wait(self, key: str, timeout=30.0):
+        rc = self._lib.tcp_store_wait(self._client, key.encode(),
+                                      int(timeout * 1000))
+        if rc == -2:
+            raise ConnectionError("TCPStore.wait failed")
+        if rc != 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}): {timeout}s elapsed")
+
+    def get_prefix(self, prefix: str) -> dict:
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tcp_store_prefix(self._client, prefix.encode(),
+                                           buf, cap)
+            if n == -3:
+                cap *= 16
+                continue
+            if n < 0:
+                raise ConnectionError("TCPStore.get_prefix failed")
+            raw = buf.raw[:n]
+            break
+        import struct
+        (count,) = struct.unpack_from("<I", raw, 0)
+        off = 4
+        out = {}
+        for _ in range(count):
+            (kl,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            k = raw[off:off + kl].decode()
+            off += kl
+            (vl,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            out[k] = raw[off:off + vl]
+            off += vl
+        return out
+
+    def clear(self):
+        if self._lib.tcp_store_clear(self._client) != 0:
+            raise ConnectionError("TCPStore.clear failed")
+
+    # ------------------------------------------------------------ barrier
+    def barrier(self, name: str = "default", world_size=None, timeout=30.0):
+        """All ranks bump a counter, then wait for the release key the
+        last arriver sets (two-phase; reusable per distinct name)."""
+        world = world_size or self.world_size
+        n = self.add(f"/__barrier__/{name}/count", 1)
+        if n >= world:
+            self.set(f"/__barrier__/{name}/release", b"1")
+        self.wait(f"/__barrier__/{name}/release", timeout=timeout)
+
+    def stop_server(self):
+        if self._server:
+            self._lib.tcp_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.tcp_store_close(self._client)
+                self._client = None
+            self.stop_server()
+        except Exception:
+            pass

@@ -46,6 +46,10 @@ def build_parser():
     p.add_argument("--devices", "--gpus", default=None,
                    help="accepted for CLI parity; devices come from the TPU "
                         "runtime")
+    p.add_argument("--rdzv_backend", default="http",
+                   choices=("http", "tcp"),
+                   help="rank-0 rendezvous store: threaded HTTP KV (http) "
+                        "or the native C++ TCPStore (tcp)")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_level", type=int, default=-1)
     p.add_argument("--restart_backoff", type=float, default=3.0,
@@ -86,10 +90,18 @@ def launch():
     # endpoint is exported to workers as PADDLE_MASTER_KV.
     kv_server = None
     if args.master and args.rank == 0:
-        from .rendezvous import KVServer
+        from .rendezvous import KVServer, NativeKVServer
         host, _, _port = args.master.partition(":")
         try:
-            kv_server = KVServer(port=0, host=host or "127.0.0.1")
+            if args.rdzv_backend == "tcp":
+                try:
+                    kv_server = NativeKVServer(port=0,
+                                               host=host or "127.0.0.1")
+                except Exception as e:
+                    logger.warning(f"native TCPStore unavailable ({e}); "
+                                   f"falling back to the HTTP store")
+            if kv_server is None:
+                kv_server = KVServer(port=0, host=host or "127.0.0.1")
             logger.info(f"rendezvous KV store serving on {kv_server.endpoint}")
         except OSError as e:
             logger.warning(f"KV store not started ({e}); assuming an "

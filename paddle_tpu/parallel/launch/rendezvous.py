@@ -90,7 +90,30 @@ class KVServer:
         self._httpd.server_close()
 
 
-class KVClient:
+class _RendezvousMixin:
+    """register/wait_world over the put/get_prefix primitives — shared by
+    the HTTP client and the native TCPStore adapter so barrier semantics
+    live in exactly one place."""
+
+    def register(self, job_id: str, rank: int, endpoint: str):
+        self.put(f"/job/{job_id}/rank/{rank}", endpoint)
+
+    def wait_world(self, job_id: str, world: int, timeout=60.0) -> dict:
+        """Barrier: poll until all `world` ranks registered; return the
+        rank -> endpoint table."""
+        deadline = time.time() + timeout
+        prefix = f"/job/{job_id}/rank/"
+        while True:
+            table = self.get_prefix(prefix)
+            if len(table) >= world:
+                return {int(k.rsplit("/", 1)[1]): v for k, v in table.items()}
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {len(table)}/{world} ranks after {timeout}s")
+            time.sleep(0.1)
+
+
+class KVClient(_RendezvousMixin):
     def __init__(self, endpoint: str, timeout=5.0):
         self._base = f"http://{endpoint}"
         self._timeout = timeout
@@ -118,19 +141,56 @@ class KVClient:
     def delete(self, key: str):
         self._req("DELETE", key).read()
 
-    def register(self, job_id: str, rank: int, endpoint: str):
-        self.put(f"/job/{job_id}/rank/{rank}", endpoint)
 
-    def wait_world(self, job_id: str, world: int, timeout=60.0) -> dict:
-        """Barrier: poll until all `world` ranks registered; return the
-        rank -> endpoint table."""
-        deadline = time.time() + timeout
-        prefix = f"/job/{job_id}/rank/"
-        while True:
-            table = self.get_prefix(prefix)
-            if len(table) >= world:
-                return {int(k.rsplit("/", 1)[1]): v for k, v in table.items()}
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"rendezvous: {len(table)}/{world} ranks after {timeout}s")
-            time.sleep(0.1)
+class NativeKVServer:
+    """Rank-0 server facade over the native C++ TCPStore
+    (``csrc/tcp_store.cpp``) — same surface as :class:`KVServer` so the
+    launcher can switch backends (``--rdzv_backend tcp``). Endpoints are
+    prefixed ``tcp://`` so clients pick the right protocol."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        from ...distributed.tcp_store import TCPStore
+        self._store = TCPStore(host=host, port=port, is_master=True)
+        self.host = host
+        self.port = self._store.port
+
+    @property
+    def endpoint(self):
+        return f"tcp://{self.host}:{self.port}"
+
+    def clear(self):
+        self._store.clear()
+
+    def stop(self):
+        self._store.stop_server()
+
+
+class NativeKVClient(_RendezvousMixin):
+    """KVClient-shaped adapter over a native TCPStore connection."""
+
+    def __init__(self, endpoint: str, timeout=5.0):
+        from ...distributed.tcp_store import TCPStore
+        host, _, port = endpoint.rpartition(":")
+        self._s = TCPStore(host=host or "127.0.0.1", port=int(port),
+                           timeout=timeout)
+
+    def put(self, key: str, value: str):
+        self._s.set(key, value)
+
+    def get(self, key: str):
+        v = self._s.get(key)
+        return None if v is None else v.decode()
+
+    def get_prefix(self, prefix: str) -> dict:
+        return {k: v.decode() for k, v in self._s.get_prefix(prefix).items()}
+
+    def delete(self, key: str):
+        self._s.delete_key(key)
+
+
+def connect(endpoint: str, timeout=5.0):
+    """Scheme-aware client factory: ``tcp://host:port`` -> native TCPStore,
+    bare ``host:port`` -> HTTP KVClient."""
+    if endpoint.startswith("tcp://"):
+        return NativeKVClient(endpoint[len("tcp://"):], timeout=timeout)
+    return KVClient(endpoint, timeout=timeout)

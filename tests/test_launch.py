@@ -132,3 +132,106 @@ class TestRendezvousStore:
                 cli.wait_world("j2", world=2, timeout=0.5)
         finally:
             srv.stop()
+
+
+def _tcp_available():
+    from paddle_tpu import csrc
+    return csrc.tcp_store_available()
+
+
+@pytest.mark.skipif(not _tcp_available(),
+                    reason="native TCPStore build unavailable (no g++)")
+class TestNativeTCPStore:
+    """Native C++ TCPStore (csrc/tcp_store.cpp — reference
+    ``paddle/phi/core/distributed/store/tcp_store.cc`` †)."""
+
+    def test_set_get_add_del(self):
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            m.set("/k", "v1")
+            assert m.get("/k") == b"v1"
+            assert m.get("/missing") is None
+            assert m.add("/n", 2) == 2
+            assert m.add("/n", 40) == 42
+            assert m.delete_key("/k") is True
+            assert m.get("/k") is None
+        finally:
+            m.stop_server()
+
+    def test_cross_connection_and_prefix(self):
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            c = TCPStore(port=m.port)
+            c.set("/job/z/rank/0", "a:1")
+            c.set("/job/z/rank/1", "b:2")
+            c.set("/other", "x")
+            table = m.get_prefix("/job/z/")
+            assert table == {"/job/z/rank/0": b"a:1", "/job/z/rank/1": b"b:2"}
+        finally:
+            m.stop_server()
+
+    def test_server_side_wait(self):
+        import threading
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            c = TCPStore(port=m.port)
+            threading.Timer(0.3, lambda: c.set("/late", "1")).start()
+            t0 = time.time()
+            m.wait("/late", timeout=10)
+            assert 0.2 < time.time() - t0 < 5
+            with pytest.raises(TimeoutError):
+                m.wait("/never", timeout=0.4)
+        finally:
+            m.stop_server()
+
+    def test_barrier_three_ranks(self):
+        import threading
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True, world_size=3)
+        done = []
+        try:
+            def rank(i):
+                c = TCPStore(port=m.port, world_size=3)
+                time.sleep(0.05 * i)
+                c.barrier("b", timeout=10)
+                done.append(i)
+
+            ts = [threading.Thread(target=rank, args=(i,)) for i in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=15)
+            assert sorted(done) == [0, 1, 2]
+        finally:
+            m.stop_server()
+
+    def test_native_adapter_wait_world(self):
+        from paddle_tpu.parallel.launch.rendezvous import (NativeKVServer,
+                                                           connect)
+        srv = NativeKVServer(port=0)
+        try:
+            assert srv.endpoint.startswith("tcp://")
+            cli = connect(srv.endpoint)
+            cli.register("jn", 0, "h0:1")
+            cli.register("jn", 1, "h1:2")
+            table = cli.wait_world("jn", world=2, timeout=5)
+            assert table == {0: "h0:1", 1: "h1:2"}
+            srv.clear()
+            assert cli.get_prefix("/job/jn/") == {}
+        finally:
+            srv.stop()
+
+    def test_launch_cli_tcp_backend(self, tmp_path):
+        p = _run_launch(["--procs", "1", "--master", "127.0.0.1:0",
+                         "--rdzv_backend", "tcp",
+                         "--log_dir", str(tmp_path / "logs"), TOY,
+                         str(tmp_path)])
+        assert p.returncode == 0, p.stderr[-500:]
+        with open(tmp_path / "env.0.json") as f:
+            env = json.load(f)
+        # native backend when buildable; documented fallback is the HTTP
+        # store, whose endpoint carries no scheme
+        assert env["PADDLE_MASTER_KV"].startswith("tcp://")
