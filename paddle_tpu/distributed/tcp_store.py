@@ -45,64 +45,96 @@ class TCPStore:
             port = lib.tcp_store_server_port(self._server)
         self.host = host
         self.port = int(port)
-        self._client = lib.tcp_store_connect(
-            host.encode(), self.port, int(timeout * 1000))
-        if not self._client:
+        self._timeout = timeout
+        self._client = self._dial()
+
+    def _dial(self):
+        client = self._lib.tcp_store_connect(
+            self.host.encode(), self.port, int(self._timeout * 1000))
+        if not client:
             raise TimeoutError(
-                f"TCPStore: cannot reach master at {host}:{self.port} "
-                f"within {timeout}s")
+                f"TCPStore: cannot reach master at {self.host}:{self.port} "
+                f"within {self._timeout}s")
+        return client
+
+    def _retry(self, op, *args):
+        """Run a client op; on a broken connection (server-side recv
+        timeout, network blip) reconnect ONCE and retry — a transient drop
+        must not permanently poison this client (heartbeat loops reuse it
+        forever)."""
+        try:
+            return op(*args)
+        except ConnectionError:
+            self._lib.tcp_store_close(self._client)
+            self._client = self._dial()
+            return op(*args)
 
     # ------------------------------------------------------------- kv ops
     def set(self, key: str, value):
         v = value.encode() if isinstance(value, str) else bytes(value)
-        rc = self._lib.tcp_store_set(self._client, key.encode(), v, len(v))
-        if rc != 0:
-            raise ConnectionError("TCPStore.set failed")
+
+        def op():
+            rc = self._lib.tcp_store_set(self._client, key.encode(), v,
+                                         len(v))
+            if rc != 0:
+                raise ConnectionError("TCPStore.set failed")
+        self._retry(op)
 
     def get(self, key: str):
-        cap = 1 << 16
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            n = self._lib.tcp_store_get(self._client, key.encode(), buf, cap)
-            if n == -3:
-                cap *= 16
-                continue
-            if n == -2:
-                raise ConnectionError("TCPStore.get failed")
-            if n == -1:
-                return None
-            return buf.raw[:n]
+        def op():
+            cap = 1 << 16
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.tcp_store_get(self._client, key.encode(), buf,
+                                            cap)
+                if n == -3:
+                    cap *= 16
+                    continue
+                if n == -2:
+                    raise ConnectionError("TCPStore.get failed")
+                if n == -1:
+                    return None
+                return buf.raw[:n]
+        return self._retry(op)
 
     def add(self, key: str, amount: int = 1) -> int:
-        out = self._lib.tcp_store_add(self._client, key.encode(), int(amount))
-        if out == -(2 ** 63):
-            raise ConnectionError("TCPStore.add failed")
-        return int(out)
+        def op():
+            out = self._lib.tcp_store_add(self._client, key.encode(),
+                                          int(amount))
+            if out == -(2 ** 63):
+                raise ConnectionError("TCPStore.add failed")
+            return int(out)
+        return self._retry(op)
 
     def delete_key(self, key: str) -> bool:
-        return self._lib.tcp_store_del(self._client, key.encode()) > 0
+        return self._retry(
+            lambda: self._lib.tcp_store_del(self._client, key.encode()) > 0)
 
     def wait(self, key: str, timeout=30.0):
-        rc = self._lib.tcp_store_wait(self._client, key.encode(),
-                                      int(timeout * 1000))
-        if rc == -2:
-            raise ConnectionError("TCPStore.wait failed")
-        if rc != 0:
-            raise TimeoutError(f"TCPStore.wait({key!r}): {timeout}s elapsed")
+        def op():
+            rc = self._lib.tcp_store_wait(self._client, key.encode(),
+                                          int(timeout * 1000))
+            if rc == -2:
+                raise ConnectionError("TCPStore.wait failed")
+            if rc != 0:
+                raise TimeoutError(
+                    f"TCPStore.wait({key!r}): {timeout}s elapsed")
+        self._retry(op)
 
     def get_prefix(self, prefix: str) -> dict:
-        cap = 1 << 20
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            n = self._lib.tcp_store_prefix(self._client, prefix.encode(),
-                                           buf, cap)
-            if n == -3:
-                cap *= 16
-                continue
-            if n < 0:
-                raise ConnectionError("TCPStore.get_prefix failed")
-            raw = buf.raw[:n]
-            break
+        def op():
+            cap = 1 << 20
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.tcp_store_prefix(self._client, prefix.encode(),
+                                               buf, cap)
+                if n == -3:
+                    cap *= 16
+                    continue
+                if n < 0:
+                    raise ConnectionError("TCPStore.get_prefix failed")
+                return buf.raw[:n]
+        raw = self._retry(op)
         import struct
         (count,) = struct.unpack_from("<I", raw, 0)
         off = 4
@@ -119,8 +151,10 @@ class TCPStore:
         return out
 
     def clear(self):
-        if self._lib.tcp_store_clear(self._client) != 0:
-            raise ConnectionError("TCPStore.clear failed")
+        def op():
+            if self._lib.tcp_store_clear(self._client) != 0:
+                raise ConnectionError("TCPStore.clear failed")
+        self._retry(op)
 
     # ------------------------------------------------------------ barrier
     def barrier(self, name: str = "default", world_size=None, timeout=30.0):

@@ -1,0 +1,153 @@
+"""Elastic training manager (reference:
+``python/paddle/distributed/fleet/elastic/manager.py`` † — ETCD-registered
+node liveness with TTL heartbeats, scale-up/down within ``--np min:max``,
+and kill-and-relaunch with new ranks on membership change).
+
+TPU adaptation: the liveness registry is the launcher's rendezvous KV
+store (HTTP or native TCPStore — ``launch/rendezvous.connect``) instead of
+ETCD; heartbeats are timestamp refreshes and TTL expiry is evaluated by
+readers, so no server-side lease support is needed. Scale events surface
+as a new **epoch** with a deterministic node→rank table; the launcher
+tears down local trainers and re-enters bootstrap with the new
+``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM`` — recovery of model state
+is the distributed checkpoint's job (SURVEY §5.3/§5.4).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ...utils.log import get_logger
+
+logger = get_logger("elastic")
+
+
+class ElasticStatus:
+    """Reference ``ElasticStatus`` verdicts."""
+
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"        # membership below min: wait, don't train
+    RESTART = "restart"  # membership changed: relaunch with new ranks
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat-registered membership over the rendezvous KV store.
+
+    One manager per launcher (host agent). ``start()`` begins
+    heartbeating; ``wait_ready()`` blocks until membership is inside
+    [np_min, np_max] and stable, returning ``(epoch, rank, world,
+    node_table)``; ``has_changed(epoch)`` tells a running job its
+    membership epoch is stale (scale-up/down → RESTART).
+    """
+
+    def __init__(self, endpoint: str, job_id: str, node_id: str,
+                 np: str = "1", heartbeat_interval: float = 1.0,
+                 ttl: float = 5.0):
+        from ..launch.rendezvous import connect
+        self._kv = connect(endpoint)
+        self.job_id = job_id
+        self.node_id = node_id
+        parts = str(np).split(":")
+        self.np_min = int(parts[0])
+        self.np_max = int(parts[-1])
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread = None
+        self._prefix = f"/elastic/{job_id}/node/"
+
+    # ------------------------------------------------------------ liveness
+    def _beat(self):
+        self._kv.put(self._prefix + self.node_id, repr(time.time()))
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception as e:  # store briefly unreachable: keep trying
+                logger.warning(f"heartbeat failed: {e}")
+
+    def start(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.heartbeat_interval)
+        try:
+            self._kv.delete(self._prefix + self.node_id)
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------- membership
+    def live_nodes(self) -> list:
+        """Node ids whose last heartbeat is within the TTL."""
+        now = time.time()
+        table = self._kv.get_prefix(self._prefix)
+        out = []
+        for key, stamp in table.items():
+            try:
+                fresh = now - float(stamp) <= self.ttl
+            except ValueError:
+                fresh = False
+            if fresh:
+                out.append(key[len(self._prefix):])
+        return sorted(out)
+
+    def rank_table(self):
+        """Deterministic node→rank assignment: sorted node ids."""
+        nodes = self.live_nodes()
+        return {n: r for r, n in enumerate(nodes)}
+
+    def status(self):
+        n = len(self.live_nodes())
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    @staticmethod
+    def _signature(table) -> str:
+        return ",".join(f"{n}:{r}" for n, r in sorted(table.items()))
+
+    def wait_ready(self, timeout: float = 60.0, settle: float | None = None):
+        """Block until membership is within [np_min, np_max] and stable for
+        one heartbeat interval; returns (epoch, rank, world, table). The
+        epoch is the membership SIGNATURE itself — a deterministic pure
+        function of the table, so every node that sees the same membership
+        derives the same epoch with no store read-modify-write to race
+        on (two nodes with different views get different epochs, and
+        ``has_changed`` flags whichever is stale)."""
+        settle = (self.heartbeat_interval if settle is None else settle)
+        deadline = time.time() + timeout
+        prev = None
+        stable_since = None
+        while True:
+            table = self.rank_table()
+            n = len(table)
+            ok = self.np_min <= n <= self.np_max and self.node_id in table
+            if ok and table == prev:
+                if stable_since is None:
+                    stable_since = time.time()
+                if time.time() - stable_since >= settle:
+                    return (self._signature(table), table[self.node_id], n,
+                            table)
+            else:
+                stable_since = None
+            prev = table
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"elastic: {n} live node(s), need "
+                    f"[{self.np_min}, {self.np_max}] within {timeout}s")
+            time.sleep(min(self.heartbeat_interval, 0.2))
+
+    def has_changed(self, epoch: str) -> bool:
+        """True when live membership no longer matches ``epoch``'s
+        signature — the launcher should tear down trainers and
+        re-rendezvous."""
+        return self._signature(self.rank_table()) != epoch

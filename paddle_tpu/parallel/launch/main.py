@@ -91,27 +91,107 @@ def launch():
     kv_server = None
     if args.master and args.rank == 0:
         from .rendezvous import KVServer, NativeKVServer
-        host, _, _port = args.master.partition(":")
+        host, _, mport = args.master.partition(":")
+        # elastic multi-node: bind DETERMINISTICALLY at master-port+1 so
+        # non-master launchers can reach the store without an env handoff
+        kv_port = (int(mport) + 1 if args.elastic_level >= 1 and mport
+                   and int(mport) > 0 else 0)
         try:
             if args.rdzv_backend == "tcp":
                 try:
-                    kv_server = NativeKVServer(port=0,
+                    kv_server = NativeKVServer(port=kv_port,
                                                host=host or "127.0.0.1")
                 except Exception as e:
                     logger.warning(f"native TCPStore unavailable ({e}); "
                                    f"falling back to the HTTP store")
             if kv_server is None:
-                kv_server = KVServer(port=0, host=host or "127.0.0.1")
+                kv_server = KVServer(port=kv_port, host=host or "127.0.0.1")
             logger.info(f"rendezvous KV store serving on {kv_server.endpoint}")
         except OSError as e:
             logger.warning(f"KV store not started ({e}); assuming an "
                            f"external rendezvous service")
 
+    # elastic membership (reference fleet/elastic/manager.py †): heartbeat
+    # this node into the KV store; each spawn round uses the LIVE world
+    # size and deterministic rank, and a membership change mid-run tears
+    # the trainers down for a re-rendezvous relaunch. NON-master launchers
+    # reach the store through PADDLE_MASTER_KV (operator-provided) or the
+    # deterministic master-port+1 convention below.
+    elastic_mgr = None
+    if args.elastic_level >= 1:
+        kv_endpoint_for_elastic = None
+        if kv_server is not None:
+            kv_endpoint_for_elastic = kv_server.endpoint
+        elif os.environ.get("PADDLE_MASTER_KV"):
+            kv_endpoint_for_elastic = os.environ["PADDLE_MASTER_KV"]
+        elif args.master:
+            host, _, port = args.master.partition(":")
+            if port and int(port) > 0:
+                scheme = "tcp://" if args.rdzv_backend == "tcp" else ""
+                kv_endpoint_for_elastic = f"{scheme}{host}:{int(port) + 1}"
+        if kv_endpoint_for_elastic is not None:
+            from ..fleet.elastic import ElasticManager
+            try:
+                elastic_mgr = ElasticManager(
+                    kv_endpoint_for_elastic, args.job_id,
+                    node_id=f"node-{args.rank}", np=args.nnodes,
+                    heartbeat_interval=float(os.environ.get(
+                        "PADDLE_ELASTIC_HEARTBEAT_INTERVAL", "1.0")),
+                    ttl=float(os.environ.get(
+                        "PADDLE_ELASTIC_TTL", "5.0"))).start()
+            except Exception as e:
+                logger.warning(f"elastic manager unavailable ({e}); "
+                               f"running with static membership")
+        else:
+            logger.warning("elastic mode needs a reachable KV store "
+                           "(--master with a fixed port, or "
+                           "PADDLE_MASTER_KV); running with static "
+                           "membership")
+
+    # tooling/tests: announce the rendezvous endpoint to a file so external
+    # agents (scale-up nodes) can find the ephemeral store
+    announce = os.environ.get("PADDLE_LAUNCH_KV_ANNOUNCE")
+    if announce and kv_server is not None:
+        with open(announce, "w") as f:
+            f.write(kv_server.endpoint)
+
+    # SIGTERM tears the job down and exits (never respawns). One flag +
+    # handler for the WHOLE launcher lifetime: `procs` is mutated in place
+    # each round, so a signal between rounds still hits live state.
+    procs = []
+    shutdown = {"requested": False}
+
+    def terminate_all(signum=None, frame=None):
+        if signum is not None:
+            shutdown["requested"] = True
+        for p, _ in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, terminate_all)
+
     restarts = 0
     while True:
-        procs = []
+        epoch = None
+        nnodes_live = nmin
+        if elastic_mgr is not None:
+            try:
+                epoch, my_rank, nnodes_live, table = elastic_mgr.wait_ready(
+                    timeout=120.0)
+            except TimeoutError as e:
+                logger.error(f"elastic: cluster never reached np range: {e}")
+                elastic_mgr.stop()
+                if kv_server is not None:
+                    kv_server.stop()
+                return 1
+            args.rank = my_rank
+            logger.info(f"elastic: {nnodes_live} node(s), this node is "
+                        f"rank {my_rank} ({table})")
+        if shutdown["requested"]:
+            break
+        procs[:] = []
         for lr in range(max(args.procs, 1)):
-            env = _child_env(args, lr, nmin,
+            env = _child_env(args, lr, nnodes_live,
                              kv_server.endpoint if kv_server else None)
             logfile = os.path.join(args.log_dir, f"workerlog.{lr}")
             out = open(logfile, "ab")
@@ -122,21 +202,41 @@ def launch():
                                  stderr=subprocess.STDOUT if lr != 0 else None)
             procs.append((p, out))
 
-        def terminate_all(signum=None, frame=None):
-            for p, _ in procs:
-                if p.poll() is None:
-                    p.terminate()
-
-        signal.signal(signal.SIGTERM, terminate_all)
         codes = []
+        scale_restart = False
         try:
-            for p, out in procs:
-                codes.append(p.wait())
+            while True:
+                if all(p.poll() is not None for p, _ in procs):
+                    break
+                changed = False
+                if elastic_mgr is not None:
+                    try:
+                        changed = elastic_mgr.has_changed(epoch)
+                    except Exception as e:
+                        # transient store failure must NOT crash the
+                        # launcher with live trainers — treat as unchanged
+                        logger.warning(f"membership probe failed: {e}")
+                if changed:
+                    logger.warning("elastic: membership changed — tearing "
+                                   "down trainers for re-rendezvous")
+                    scale_restart = True
+                    terminate_all()
+                    for p, _ in procs:
+                        p.wait()
+                    break
+                time.sleep(0.3)
+            codes = [p.poll() for p, _ in procs]
+            for _, out in procs:
                 if out is not None:
                     out.close()
         except KeyboardInterrupt:
             terminate_all()
             raise
+        if shutdown["requested"]:
+            break
+        if scale_restart:
+            _drop_stale_ranks(kv_server, args.job_id)
+            continue  # scale events don't consume failure-restart budget
         if all(c == 0 for c in codes):
             logger.info("job finished successfully")
             if kv_server is not None:
@@ -152,11 +252,37 @@ def launch():
         logger.warning(f"restart {restarts}/{args.max_restart} after failure "
                        f"{codes} (elastic mode, backoff {backoff:.1f}s)")
         terminate_all()
-        if kv_server is not None:
+        if elastic_mgr is not None:
+            # the store also holds elastic heartbeats/epochs now: drop only
+            # the dead run's rank registrations, not the membership state
+            _drop_stale_ranks(kv_server, args.job_id)
+        elif kv_server is not None:
             # stale rank registrations from the failed run would satisfy the
             # next run's wait_world barrier with dead endpoints
             kv_server.clear()
         time.sleep(backoff)
+
+    # `break` target: SIGTERM-requested shutdown
+    logger.info("SIGTERM: trainers stopped, launcher exiting")
+    if elastic_mgr is not None:
+        elastic_mgr.stop()
+    if kv_server is not None:
+        kv_server.stop()
+    return 143
+
+
+def _drop_stale_ranks(kv_server, job_id):
+    """Delete /job/<id>/rank/* so the next run's wait_world barrier cannot
+    be satisfied by dead endpoints (membership/heartbeat keys survive)."""
+    if kv_server is None:
+        return
+    from .rendezvous import connect
+    try:
+        cli = connect(kv_server.endpoint)
+        for key in cli.get_prefix(f"/job/{job_id}/rank/"):
+            cli.delete(key)
+    except Exception as e:
+        logger.warning(f"stale-rank cleanup failed: {e}")
 
 
 if __name__ == "__main__":
