@@ -83,6 +83,13 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._value)
 
+    def __array__(self, dtype=None):
+        # numpy protocol: without this, np.asarray(tensor) falls back to
+        # the sequence protocol, and the clamping jax __getitem__ never
+        # raises IndexError — an infinite loop
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
     def item(self, *args):
         return self._value.item(*args)
 

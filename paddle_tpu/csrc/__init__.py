@@ -181,6 +181,9 @@ def _load_tcp():
                                       c.c_int64]
         lib.tcp_store_add.restype = c.c_int64
         lib.tcp_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.tcp_store_add_raw.restype = c.c_int64
+        lib.tcp_store_add_raw.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                          c.c_int64]
         lib.tcp_store_del.restype = c.c_int64
         lib.tcp_store_del.argtypes = [c.c_void_p, c.c_char_p]
         lib.tcp_store_prefix.restype = c.c_int64

@@ -30,6 +30,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -87,6 +88,7 @@ struct Server {
   std::atomic<bool> stop{false};
   std::mutex mu;  // guards kv (server thread + clear() from host thread)
   std::unordered_map<std::string, std::string> kv;
+  std::unordered_set<std::string> applied_tokens;  // ADD idempotency
   std::vector<int> clients;
   std::vector<PendingWait> waits;
 
@@ -153,16 +155,25 @@ struct Server {
         }
         return send_reply(fd, found ? 0 : -1, out);
       }
-      case 3: {  // ADD — value stored as decimal string (reference layout)
+      case 3: {  // ADD — value stored as decimal string (reference layout).
+        // val = 8-byte delta, optionally followed by a 16-byte idempotency
+        // token: a client retrying after a dropped reply re-sends the SAME
+        // token, and a seen token returns the current value WITHOUT adding
+        // (without this, reconnect-retry could double-increment barrier
+        // counters and release barriers early).
         int64_t delta = 0;
-        if (vlen == 8) std::memcpy(&delta, val.data(), 8);
+        if (vlen >= 8) std::memcpy(&delta, val.data(), 8);
+        std::string token = vlen > 8 ? val.substr(8) : "";
         int64_t cur = 0;
         {
           std::lock_guard<std::mutex> g(mu);
+          bool dup = !token.empty() && !applied_tokens.insert(token).second;
           auto it = kv.find(key);
           if (it != kv.end()) cur = std::strtoll(it->second.c_str(), nullptr, 10);
-          cur += delta;
-          kv[key] = std::to_string(cur);
+          if (!dup) {
+            cur += delta;
+            kv[key] = std::to_string(cur);
+          }
         }
         return send_reply(fd, cur, "");
       }
@@ -395,6 +406,16 @@ int64_t tcp_store_get(void* h, const char* key, char* buf, int64_t cap) {
   if (n > cap) return -3;
   std::memcpy(buf, payload.data(), n);
   return n;
+}
+
+int64_t tcp_store_add_raw(void* h, const char* key, const char* payload,
+                          int64_t plen) {
+  // payload = 8-byte delta [+ idempotency token]; see ADD in handle()
+  int64_t st;
+  if (!client_roundtrip(static_cast<Client*>(h), 3, key,
+                        std::string(payload, plen), &st, nullptr))
+    return INT64_MIN;
+  return st;
 }
 
 int64_t tcp_store_add(void* h, const char* key, int64_t delta) {

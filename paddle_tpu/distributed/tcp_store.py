@@ -98,9 +98,16 @@ class TCPStore:
         return self._retry(op)
 
     def add(self, key: str, amount: int = 1) -> int:
+        # non-idempotent op: send an idempotency token so the reconnect
+        # retry cannot double-apply the increment if the first request was
+        # applied but its reply was lost
+        import os as _os
+        token = _os.urandom(16)
+        payload = int(amount).to_bytes(8, "little", signed=True) + token
+
         def op():
-            out = self._lib.tcp_store_add(self._client, key.encode(),
-                                          int(amount))
+            out = self._lib.tcp_store_add_raw(
+                self._client, key.encode(), payload, len(payload))
             if out == -(2 ** 63):
                 raise ConnectionError("TCPStore.add failed")
             return int(out)

@@ -127,14 +127,27 @@ def launch():
         elif args.master:
             host, _, port = args.master.partition(":")
             if port and int(port) > 0:
-                scheme = "tcp://" if args.rdzv_backend == "tcp" else ""
-                kv_endpoint_for_elastic = f"{scheme}{host}:{int(port) + 1}"
+                # the master may have FALLEN BACK to the HTTP store even if
+                # this launcher asked for tcp — probe both protocols and
+                # keep whichever answers, instead of trusting our own flag
+                first = "tcp://" if args.rdzv_backend == "tcp" else ""
+                other = "" if first else "tcp://"
+                base = f"{host}:{int(port) + 1}"
+                kv_endpoint_for_elastic = _probe_endpoint(
+                    [first + base, other + base])
         if kv_endpoint_for_elastic is not None:
             from ..fleet.elastic import ElasticManager
+            # unique per-launcher identity (two launchers default to
+            # --rank 0; colliding ids would silently collapse membership).
+            # The master sorts FIRST ("0-" prefix) so it keeps rank 0 and
+            # with it the PADDLE_MASTER coordinator role across epochs.
+            import socket as _socket
+            node_id = (("0-master" if kv_server is not None else
+                        f"1-{_socket.gethostname()}-{os.getpid()}"))
             try:
                 elastic_mgr = ElasticManager(
                     kv_endpoint_for_elastic, args.job_id,
-                    node_id=f"node-{args.rank}", np=args.nnodes,
+                    node_id=node_id, np=args.nnodes,
                     heartbeat_interval=float(os.environ.get(
                         "PADDLE_ELASTIC_HEARTBEAT_INTERVAL", "1.0")),
                     ttl=float(os.environ.get(
@@ -205,26 +218,39 @@ def launch():
         codes = []
         scale_restart = False
         try:
-            while True:
-                if all(p.poll() is not None for p, _ in procs):
-                    break
-                changed = False
-                if elastic_mgr is not None:
+            if elastic_mgr is None:
+                # non-elastic: block in wait() — no reason to busy-poll
+                # for the whole job lifetime
+                for p, _ in procs:
+                    p.wait()
+            else:
+                while True:
+                    if shutdown["requested"]:
+                        # SIGTERM may have landed mid-spawn, before some
+                        # children existed when the handler ran
+                        terminate_all()
+                        for p, _ in procs:
+                            p.wait()
+                        break
+                    if all(p.poll() is not None for p, _ in procs):
+                        break
+                    changed = False
                     try:
                         changed = elastic_mgr.has_changed(epoch)
                     except Exception as e:
                         # transient store failure must NOT crash the
                         # launcher with live trainers — treat as unchanged
                         logger.warning(f"membership probe failed: {e}")
-                if changed:
-                    logger.warning("elastic: membership changed — tearing "
-                                   "down trainers for re-rendezvous")
-                    scale_restart = True
-                    terminate_all()
-                    for p, _ in procs:
-                        p.wait()
-                    break
-                time.sleep(0.3)
+                    if changed:
+                        logger.warning("elastic: membership changed — "
+                                       "tearing down trainers for "
+                                       "re-rendezvous")
+                        scale_restart = True
+                        terminate_all()
+                        for p, _ in procs:
+                            p.wait()
+                        break
+                    time.sleep(0.3)
             codes = [p.poll() for p, _ in procs]
             for _, out in procs:
                 if out is not None:
@@ -269,6 +295,20 @@ def launch():
     if kv_server is not None:
         kv_server.stop()
     return 143
+
+
+def _probe_endpoint(candidates):
+    """First endpoint whose store answers a get() — protocol detection for
+    non-master launchers (the master may have fallen back to HTTP)."""
+    from .rendezvous import connect
+    for ep in candidates:
+        try:
+            connect(ep, timeout=3.0).get("/__probe__")
+            return ep
+        except Exception:
+            continue
+    logger.warning(f"no rendezvous store reachable at {candidates}")
+    return None
 
 
 def _drop_stale_ranks(kv_server, job_id):

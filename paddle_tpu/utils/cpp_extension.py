@@ -1,0 +1,190 @@
+"""paddle.utils.cpp_extension (reference:
+``python/paddle/utils/cpp_extension/`` † + the ``PD_BUILD_OP`` custom-op C
+API, ``paddle/phi/api/ext/`` †).
+
+TPU-native design: a custom C++ op cannot inject device code into XLA the
+way ``PD_BUILD_OP`` injects CUDA kernels — on TPU, device-side custom
+kernels are Pallas (``paddle_tpu.kernels``). What this module provides is
+the reference's *out-of-tree extension* capability: compile user C++ with
+the in-image g++ (plain C ABI, ctypes — no pybind11 in this environment),
+and lift exported symbols into framework ops that run as **host
+callbacks** (``jax.pure_callback``) — usable under jit, vmapped batch
+dims excluded, with an optional custom gradient via a paired backward
+symbol. This mirrors the role of the reference's CPU custom kernels;
+docs steer hot-path work to Pallas.
+
+Exported-symbol ABI (documented contract, float32 v1)::
+
+    extern "C" void op(int n_in, const float** ins,
+                       const int64_t* in_sizes, float* out,
+                       int64_t out_size);
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension",
+           "CustomOpLibrary"]
+
+_ARGTYPES = [
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_float),
+    ctypes.c_int64,
+]
+
+
+class CustomOpLibrary:
+    """A loaded extension; ``def_op`` lifts exported symbols into ops."""
+
+    def __init__(self, name, cdll, path):
+        self.name = name
+        self._cdll = cdll
+        self.path = path
+
+    def def_op(self, symbol, out_shape_fn=None, backward_symbol=None):
+        """Wrap C ``symbol`` as a framework op over float32 tensors.
+
+        ``out_shape_fn(*input_shapes) -> shape`` (default: first input's
+        shape). ``backward_symbol`` names a C function with the same ABI
+        computing dx from (inputs..., grad_out) — without it the op is
+        non-differentiable, like a reference custom op with no grad
+        kernel registered.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        cfn = getattr(self._cdll, symbol)
+        cfn.argtypes = _ARGTYPES
+        cfn.restype = None
+        bfn = None
+        if backward_symbol is not None:
+            bfn = getattr(self._cdll, backward_symbol)
+            bfn.argtypes = _ARGTYPES
+            bfn.restype = None
+
+        def call_c(fn, arrays, out_shape):
+            arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+            out = np.zeros(out_shape, np.float32)
+            ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))(*[
+                a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                for a in arrays])
+            sizes = (ctypes.c_int64 * len(arrays))(*[a.size for a in arrays])
+            fn(len(arrays), ins, sizes,
+               out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+            return out
+
+        def fwd_raw(*vals):
+            shape = (out_shape_fn(*[v.shape for v in vals])
+                     if out_shape_fn else vals[0].shape)
+            result = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+            return jax.pure_callback(
+                lambda *a: call_c(cfn, a, tuple(shape)), result, *vals)
+
+        if bfn is None:
+            op_fn = fwd_raw
+        else:
+            @jax.custom_vjp
+            def op_fn(*vals):
+                return fwd_raw(*vals)
+
+            def fwd_rule(*vals):
+                return fwd_raw(*vals), vals
+
+            def bwd_rule(res, g):
+                # backward symbol computes cotangents for ALL inputs,
+                # concatenated flat in input order
+                total = sum(int(np.prod(v.shape)) for v in res)
+                flat = jax.pure_callback(
+                    lambda *a: call_c(bfn, a, (total,)),
+                    jax.ShapeDtypeStruct((total,), jnp.float32),
+                    *res, g)
+                outs = []
+                off = 0
+                for v in res:
+                    n = int(np.prod(v.shape))
+                    outs.append(flat[off:off + n].reshape(v.shape))
+                    off += n
+                return tuple(outs)
+
+            op_fn.defvjp(fwd_rule, bwd_rule)
+
+        from ..ops._op import tensor_op
+        wrapped = tensor_op(differentiable=bfn is not None)(
+            lambda *vals: op_fn(*vals))
+        wrapped.__name__ = symbol
+        return wrapped
+
+    def __getattr__(self, symbol):
+        return getattr(self._cdll, symbol)
+
+
+def load(name, sources, extra_cflags=None, extra_ldflags=None,
+         build_directory=None, verbose=False, **kw):
+    """Compile ``sources`` into a shared library and load it (reference
+    ``cpp_extension.load`` JIT-build path). Rebuilds only when sources
+    change (content hash in the artifact name)."""
+    sources = [sources] if isinstance(sources, str) else list(sources)
+    build_directory = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_directory, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    for flag in (extra_cflags or []) + (extra_ldflags or []):
+        h.update(flag.encode())
+    so = os.path.join(build_directory, f"{name}_{h.hexdigest()[:16]}.so")
+    if not os.path.exists(so):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cflags or []) + sources
+               + (extra_ldflags or []) + ["-o", so])
+        if verbose:
+            print("building:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{e.stderr.decode()}") from e
+    return CustomOpLibrary(name, ctypes.CDLL(so), so)
+
+
+class CppExtension:
+    """setuptools-Extension-shaped shim (reference ``CppExtension``)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension targets the reference's CUDA backend; on TPU, write "
+        "device kernels with Pallas (paddle_tpu.kernels) and host-side "
+        "extensions with CppExtension/load")
+
+
+class BuildExtension:
+    """Stand-in for the reference's setuptools build_ext command: builds
+    each CppExtension with the same g++ pipeline as :func:`load`."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+    def build_extensions(self, extensions, build_directory=None):
+        return [load(getattr(e, "name", f"ext{i}"), e.sources,
+                     build_directory=build_directory)
+                for i, e in enumerate(extensions)]

@@ -5,6 +5,13 @@ import os
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")
+# EXPORTED (not just config.update) so multiprocessing-spawn children —
+# DataLoader workers, launcher toys, shm-ring producers — inherit them:
+# with the axon tunnel dead/busy, a child that initializes the axon PJRT
+# plugin hangs at import, and in-function env fixes run too late because
+# the helper module imports paddle_tpu at module scope.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import jax  # noqa: E402
 

@@ -235,3 +235,20 @@ class TestNativeTCPStore:
         # native backend when buildable; documented fallback is the HTTP
         # store, whose endpoint carries no scheme
         assert env["PADDLE_MASTER_KV"].startswith("tcp://")
+
+    def test_add_idempotency_token(self):
+        """Replaying an ADD with the same token (reconnect-retry semantics)
+        must not double-increment."""
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            payload = (5).to_bytes(8, "little", signed=True) + b"T" * 16
+            v1 = m._lib.tcp_store_add_raw(m._client, b"/ctr", payload,
+                                          len(payload))
+            v2 = m._lib.tcp_store_add_raw(m._client, b"/ctr", payload,
+                                          len(payload))
+            assert (v1, v2) == (5, 5)
+            # a fresh token applies normally
+            assert m.add("/ctr", 1) == 6
+        finally:
+            m.stop_server()
