@@ -1,0 +1,35 @@
+"""paddle.audio (reference: ``python/paddle/audio/`` † — feature layers +
+filterbank functional; the soundfile-IO backends are gated on the optional
+dependency, matching the reference's backend registry)."""
+from . import features, functional  # noqa: F401
+
+
+def _soundfile():
+    try:
+        import soundfile
+        return soundfile
+    except ImportError:
+        raise RuntimeError(
+            "paddle.audio.load/save need the optional 'soundfile' package "
+            "(unavailable in this environment)")
+
+
+def load(path, sr=None, mono=True, dtype="float32"):
+    sf = _soundfile()
+    data, native_sr = sf.read(path, dtype=dtype)
+    if mono and getattr(data, "ndim", 1) == 2:
+        data = data.mean(axis=1)
+    if sr is not None and int(sr) != int(native_sr):
+        raise ValueError(
+            f"file is {native_sr} Hz but sr={sr} was requested; resampling "
+            f"is not built in — load at native rate and resample explicitly")
+    return data, native_sr
+
+
+def save(path, data, sample_rate):
+    sf = _soundfile()
+    sf.write(path, data, sample_rate)
+
+
+backends = type("backends", (), {"list_available_backends":
+                                 staticmethod(lambda: [])})

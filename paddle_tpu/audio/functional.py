@@ -1,0 +1,135 @@
+"""paddle.audio.functional (reference:
+``python/paddle/audio/functional/{window,functional}.py`` † — mel/DCT
+filterbank math and window synthesis over the framework's fft/signal
+substrate)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._op import tensor_op
+
+
+def _as_value(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+# ------------------------------------------------------------------ scales
+def hz_to_mel(freq, htk=False):
+    """Hz -> mel. Slaney formula by default (reference), HTK optional."""
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = jnp.asarray(_as_value(freq), jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep, mels)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = jnp.asarray(_as_value(mel), jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    low = hz_to_mel(float(f_min), htk=htk)
+    high = hz_to_mel(float(f_max), htk=htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return mel_to_hz(Tensor(mels), htk=htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return Tensor(jnp.linspace(0.0, float(sr) / 2, n_fft // 2 + 1))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] mel filterbank (triangular, Slaney-normalized
+    by default — matches the reference/librosa)."""
+    f_max = float(f_max) if f_max is not None else float(sr) / 2
+    fft_f = jnp.linspace(0.0, float(sr) / 2, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk).value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct layout)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis = basis * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                                  math.sqrt(2.0 / n_mels))[None, :]
+    else:
+        basis = basis * 2.0
+    return Tensor(basis.astype(dtype))
+
+
+@tensor_op
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    db = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    db = db - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        db = jnp.maximum(db, jnp.max(db) - top_db)
+    return db
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window synthesis (reference get_window): hann/hamming/blackman/
+    bartlett/kaiser(beta)/gaussian(std)/taylor not included."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    N = win_length + (0 if fftbins else -1)
+    n = jnp.arange(win_length, dtype=jnp.float32)
+    if name == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / max(N, 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / max(N, 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / max(N, 1))
+             + 0.08 * jnp.cos(4 * math.pi * n / max(N, 1)))
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * n / max(N, 1) - 1.0)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        from jax.scipy.special import i0 as _i0
+        arg = beta * jnp.sqrt(jnp.maximum(
+            0.0, 1.0 - (2.0 * n / max(N, 1) - 1.0) ** 2))
+        w = _i0(arg) / _i0(jnp.float32(beta))
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = jnp.exp(-0.5 * ((n - N / 2.0) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

@@ -83,10 +83,15 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._value)
 
-    def __array__(self, dtype=None):
+    def __array__(self, dtype=None, copy=None):
         # numpy protocol: without this, np.asarray(tensor) falls back to
         # the sequence protocol, and the clamping jax __getitem__ never
-        # raises IndexError — an infinite loop
+        # raises IndexError — an infinite loop. `copy` is the NumPy 2
+        # keyword; device->host transfer always materializes, so
+        # copy=False cannot be honored.
+        if copy is False:
+            raise ValueError(
+                "Tensor.__array__ cannot avoid a copy (device buffer)")
         arr = np.asarray(self._value)
         return arr.astype(dtype) if dtype is not None else arr
 

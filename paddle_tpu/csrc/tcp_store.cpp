@@ -223,6 +223,7 @@ struct Server {
         {
           std::lock_guard<std::mutex> g(mu);
           kv.clear();
+          applied_tokens.clear();
         }
         return send_reply(fd, 0, "");
       }
@@ -345,6 +346,7 @@ void tcp_store_server_clear(void* h) {
   auto* s = static_cast<Server*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   s->kv.clear();
+  s->applied_tokens.clear();
 }
 
 void tcp_store_server_stop(void* h) {

@@ -220,7 +220,11 @@ def launch():
         try:
             if elastic_mgr is None:
                 # non-elastic: block in wait() — no reason to busy-poll
-                # for the whole job lifetime
+                # for the whole job lifetime. Re-run terminate_all first in
+                # case SIGTERM landed mid-spawn (the handler only saw the
+                # children appended at that moment).
+                if shutdown["requested"]:
+                    terminate_all()
                 for p, _ in procs:
                     p.wait()
             else:

@@ -145,16 +145,23 @@ def load(name, sources, extra_cflags=None, extra_ldflags=None,
         h.update(flag.encode())
     so = os.path.join(build_directory, f"{name}_{h.hexdigest()[:16]}.so")
     if not os.path.exists(so):
+        # build to a temp path + atomic rename: K launcher-spawned ranks
+        # calling load() concurrently must never dlopen a half-written .so
+        tmp = f"{so}.tmp.{os.getpid()}"
         cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
                + (extra_cflags or []) + sources
-               + (extra_ldflags or []) + ["-o", so])
+               + (extra_ldflags or []) + ["-o", tmp])
         if verbose:
             print("building:", " ".join(cmd))
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            os.rename(tmp, so)
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{e.stderr.decode()}") from e
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return CustomOpLibrary(name, ctypes.CDLL(so), so)
 
 
@@ -163,6 +170,7 @@ class CppExtension:
 
     def __init__(self, sources, *args, **kwargs):
         self.sources = sources
+        self.name = kwargs.get("name")
         self.kwargs = kwargs
 
 
@@ -185,6 +193,6 @@ class BuildExtension:
         return cls
 
     def build_extensions(self, extensions, build_directory=None):
-        return [load(getattr(e, "name", f"ext{i}"), e.sources,
+        return [load(getattr(e, "name", None) or f"ext{i}", e.sources,
                      build_directory=build_directory)
                 for i, e in enumerate(extensions)]
