@@ -22,10 +22,18 @@ from ..autograd import engine
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
-                 "name", "persistable", "_hooks", "__weakref__", "__dict__")
+                 "name", "persistable", "_hooks", "_ctime", "__weakref__",
+                 "__dict__")
+
+    # monotonically increasing creation stamp — lets static-graph capture
+    # distinguish pre-existing tensors (captured as constants) from
+    # tensors born inside program_guard (must come from recorded ops)
+    _creation_counter = 0
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None,
                  dtype=None):
+        Tensor._creation_counter += 1
+        self._ctime = Tensor._creation_counter
         if isinstance(value, Tensor):
             value = value._value
         if dtype is not None:
@@ -93,7 +101,11 @@ class Tensor:
             raise ValueError(
                 "Tensor.__array__ cannot avoid a copy (device buffer)")
         arr = np.asarray(self._value)
-        return arr.astype(dtype) if dtype is not None else arr
+        if dtype is not None:
+            return arr.astype(dtype)  # astype always copies -> writable
+        # copy=True must hand back a WRITABLE copy; np.asarray over a jax
+        # buffer is a read-only view
+        return arr.copy() if copy else arr
 
     def item(self, *args):
         return self._value.item(*args)

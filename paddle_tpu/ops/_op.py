@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from ..autograd import engine
 from ..autograd.engine import GradNode
 from ..core.tensor import Tensor
+from ..static import capture as _capture
 
 OP_REGISTRY: Dict[str, Callable] = {}
 
@@ -41,6 +42,11 @@ def apply(fn, args, kwargs, differentiable=True, name=""):
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
     tensor_pos = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
     vals = [x.value if isinstance(x, Tensor) else x for x in flat]
+
+    # static-graph capture (paddle.static.program_guard): this dispatch
+    # point doubles as the reference's op-desc recorder — every op applied
+    # while a program is being built is appended to it for later replay
+    recording = _capture.current_program()
 
     # AMP O1/O2: cast tensor inputs per white/black list (no-op when disabled)
     from ..amp import amp_state, amp_cast_inputs
@@ -59,7 +65,10 @@ def apply(fn, args, kwargs, differentiable=True, name=""):
     if not need_grad:
         a, k = jax.tree.unflatten(treedef, vals)
         out = fn(*a, **k)
-        return _wrap(out, stop_gradient=True)
+        wrapped = _wrap(out, stop_gradient=True)
+        if recording is not None:
+            recording.record(fn, name, flat, treedef, wrapped)
+        return wrapped
 
     def pure(*diff_vals):
         v = list(vals)
@@ -88,7 +97,10 @@ def apply(fn, args, kwargs, differentiable=True, name=""):
             # Integer/bool outputs (indices etc.) are never differentiable.
             t = Tensor(o, stop_gradient=True)
         wrapped.append(t)
-    return jax.tree.unflatten(out_treedef, wrapped)
+    result = jax.tree.unflatten(out_treedef, wrapped)
+    if recording is not None:
+        recording.record(fn, name, flat, treedef, result)
+    return result
 
 
 def _wrap(out, stop_gradient=True):

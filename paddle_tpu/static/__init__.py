@@ -88,9 +88,20 @@ def trace_layer(layer, example_inputs) -> Program:
     return Program(fn, (params, buffers, *vals))
 
 
+from .capture import (Executor, StaticProgram, data,  # noqa: E402
+                      program_guard)
+
+_default_main = StaticProgram()
+
+
 def default_main_program():
-    raise NotImplementedError(
-        "no global Program in the TPU build — trace with static.trace_layer")
+    return _default_main
+
+
+def default_startup_program():
+    # parameter init happens eagerly at Layer construction (no separate
+    # startup graph under XLA); an empty program keeps the API total
+    return StaticProgram()
 
 
 def name_scope(name):

@@ -1,0 +1,199 @@
+"""Define-and-run static graphs (reference: ``ProgramDesc`` + ``Executor``,
+``paddle/fluid/framework/program_desc.cc`` / ``executor.cc`` †).
+
+The op dispatch point (``ops._op.apply``) doubles as the reference's
+op-desc recorder: under ``program_guard``, every framework op appends
+(raw fn, input var ids, output var ids) to the current ``StaticProgram``.
+``Executor.run`` replays the op list as a PURE function of the feed
+arrays — and jits that replay, so a captured program compiles to exactly
+one XLA executable like any other step (XLA is the executor; the replay
+is the "graph").
+
+Same contract as the reference's static mode: Python control flow is
+frozen at build time, and ops execute in recorded order.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+
+def current_program():
+    return getattr(_tls, "program", None)
+
+
+class StaticProgram:
+    """An op-list program: feed placeholders -> recorded ops -> fetches."""
+
+    def __init__(self):
+        self.ops = []          # (fn, name, arg_slots, treedef, out_ids)
+        self.feed_names = {}   # placeholder Tensor id -> feed name
+        self._feed_shapes = {}
+        self._known = set()    # Tensor ids produced so far (or fed)
+        self._const = {}       # Tensor id -> captured literal value
+        self._compiled = {}
+        # ids index the graph, so every recorded Tensor must stay alive
+        # for the program's lifetime — otherwise CPython reuses a freed
+        # intermediate's id for a new object and the graph silently
+        # cross-wires
+        self._keepalive = []
+        self._build_ctime = None  # Tensor creation-counter at guard entry
+
+    # ----------------------------------------------------------- building
+    def add_feed(self, name, tensor, spec_shape=None):
+        self.feed_names[id(tensor)] = name
+        self._feed_shapes[name] = (tuple(spec_shape if spec_shape is not None
+                                         else tensor.shape), tensor.dtype)
+        self._known.add(id(tensor))
+        self._keepalive.append(tensor)
+
+    def record(self, fn, name, flat, treedef, out_tree):
+        slots = []
+        for x in flat:
+            if isinstance(x, Tensor):
+                xid = id(x)
+                if xid not in self._known and xid not in self._const:
+                    from ..core.tensor import Parameter
+                    if (self._build_ctime is not None
+                            and not isinstance(x, Parameter)
+                            and getattr(x, "_ctime", 0) >= self._build_ctime):
+                        # created DURING capture but not by a recorded op
+                        # and not a Parameter: raw Tensor construction
+                        # bypassed the dispatch. If its value derives from
+                        # a placeholder it will be FROZEN at build-time
+                        # values — warn loudly (layers legitimately build
+                        # constant tensors in __init__, so this cannot be
+                        # a hard error).
+                        import warnings
+                        warnings.warn(
+                            f"static capture: input of op '{name}' was "
+                            f"created inside program_guard without going "
+                            f"through the op dispatch; it is captured as a "
+                            f"BUILD-TIME CONSTANT. If it derives from a "
+                            f"data() placeholder, the program will ignore "
+                            f"that feed.")
+                    # a tensor from OUTSIDE the program (weights, eager
+                    # constants): captured by value, like the reference's
+                    # persistable vars
+                    self._const[xid] = x.value
+                    self._keepalive.append(x)
+                slots.append(("var", xid))
+            else:
+                slots.append(("lit", x))
+        out_ids = []
+        for o in jax.tree.leaves(out_tree, is_leaf=lambda t: isinstance(t, Tensor)):
+            if isinstance(o, Tensor):
+                oid = id(o)
+                out_ids.append(oid)
+                self._known.add(oid)
+                self._keepalive.append(o)
+        self.ops.append((fn, name, slots, treedef, out_ids))
+
+    # ------------------------------------------------------------ running
+    def _replay(self, feed_vals, fetch_ids):
+        """Pure function: feed dict (name->array) -> fetched values."""
+        env = dict(self._const)
+        for tid, fname in self.feed_names.items():
+            env[tid] = feed_vals[fname]
+        for fn, name, slots, treedef, out_ids in self.ops:
+            vals = [env[s[1]] if s[0] == "var" else s[1] for s in slots]
+            a, k = jax.tree.unflatten(treedef, vals)
+            out = fn(*a, **k)
+            leaves = jax.tree.leaves(out)
+            for oid, leaf in zip(out_ids, leaves):
+                env[oid] = leaf
+        return tuple(env[fid] for fid in fetch_ids)
+
+    def run(self, feed, fetch_ids, jit=True):
+        key = (tuple(sorted(feed)), tuple(fetch_ids), jit)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = (jax.jit(lambda fv: self._replay(fv, fetch_ids)) if jit
+                  else (lambda fv: self._replay(fv, fetch_ids)))
+            self._compiled[key] = fn
+        return fn(feed)
+
+    def op_names(self):
+        return [name for _, name, _, _, _ in self.ops]
+
+    def __str__(self):
+        lines = [f"StaticProgram({len(self.ops)} ops, "
+                 f"feeds={sorted(self._feed_shapes)})"]
+        lines += [f"  {i}: {n}" for i, n in enumerate(self.op_names())]
+        return "\n".join(lines)
+
+
+class program_guard:
+    """Capture ops built inside the ``with`` into ``main_program``."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.program = main_program
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = current_program()
+        if self.program._build_ctime is None:
+            self.program._build_ctime = Tensor._creation_counter
+        _tls.program = self.program
+        return self.program
+
+    def __exit__(self, *exc):
+        _tls.program = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference ``paddle.static.data``): a zero tensor
+    whose id is bound to ``name`` in the current program; Executor.run
+    substitutes the fed array at that slot."""
+    import jax.numpy as jnp
+
+    from ..core import dtype as dtype_mod
+    prog = current_program()
+    spec = tuple(-1 if s in (-1, None) else int(s) for s in shape)
+    # -1 dims materialize as 1 for the zero placeholder; build-time Python
+    # reads of the placeholder's shape therefore see 1, not the symbolic
+    # batch — Executor.run validates feeds against the ORIGINAL spec
+    shape = tuple(1 if s == -1 else s for s in spec)
+    t = Tensor(jnp.zeros(shape, dtype_mod.to_jax_dtype(dtype)), name=name)
+    if prog is not None:
+        prog.add_feed(name, t, spec_shape=spec)
+    return t
+
+
+class Executor:
+    """Replays captured programs (reference ``paddle.static.Executor``);
+    ``place`` is accepted for API parity (XLA owns placement)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, jit=True):
+        if program is None or not isinstance(program, StaticProgram):
+            raise ValueError("Executor.run needs the StaticProgram that "
+                             "captured the graph (program_guard target)")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feed_vals = {k: (v.value if isinstance(v, Tensor) else v)
+                     for k, v in feed.items()}
+        missing = set(program.feed_names.values()) - set(feed_vals)
+        if missing:
+            raise ValueError(f"missing feeds: {sorted(missing)}")
+        for fname, (spec, _dt) in program._feed_shapes.items():
+            if fname not in feed_vals:
+                continue
+            got = tuple(getattr(feed_vals[fname], "shape", ()))
+            if len(got) != len(spec) or any(
+                    s != -1 and s != g for s, g in zip(spec, got)):
+                raise ValueError(
+                    f"feed '{fname}' has shape {got}, expected {spec} "
+                    f"(-1 = any)")
+        fetch_ids = tuple(id(t) for t in fetch_list)
+        outs = program.run(feed_vals, fetch_ids, jit=jit)
+        import numpy as np
+        return [np.asarray(o) for o in outs]
