@@ -2,6 +2,8 @@
 from .engine import (backward, enable_grad, grad, is_grad_enabled, no_grad,
                      set_grad_enabled)
 from .py_layer import LegacyPyLayer, PyLayer, PyLayerContext
+from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp
 
 __all__ = ["backward", "enable_grad", "grad", "is_grad_enabled", "no_grad",
-           "set_grad_enabled", "PyLayer", "PyLayerContext", "LegacyPyLayer"]
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "LegacyPyLayer",
+           "jacobian", "hessian", "jvp", "vjp", "Jacobian", "Hessian"]

@@ -1,0 +1,206 @@
+"""Functional autograd transforms (reference: ``python/paddle/autograd/``
+``paddle.autograd.jacobian/hessian`` + ``paddle.incubate.autograd.{jvp,
+vjp,Jacobian,Hessian}`` †).
+
+On the reference these are built by replaying the tape per row/column; on
+a jax core they ARE the native transforms — ``jax.jacfwd/jacrev/jvp/vjp``
+over a functionalized view of the user callable — so a Jacobian is one
+vmapped program, not O(outputs) backward passes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .engine import no_grad
+
+
+def _T():
+    # resolved lazily: core.tensor imports autograd.engine at package
+    # init, so a module-level import here would be circular
+    from ..core.tensor import Tensor
+    return Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def _unwrap(tree):
+    Tensor = _T()
+    return jax.tree.map(lambda t: t.value if isinstance(t, Tensor) else t,
+                        tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree.map(_T(), tree)
+
+
+def _functionalize(func):
+    """Tensor-level callable -> pure jnp callable (runs the op library
+    under no_grad; jax transforms differentiate the pure trace)."""
+
+    def pure(*vals):
+        with no_grad():
+            t_args = jax.tree.map(_T(), vals)
+            out = func(*t_args)
+        return _unwrap(out)
+
+    return pure
+
+
+def _norm_inputs(xs):
+    single = not isinstance(xs, (tuple, list))
+    vals = _unwrap(tuple(xs) if not single else (xs,))
+    return single, vals
+
+
+def _check_create_graph(create_graph):
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (tape-connected results) is not supported: "
+            "these transforms return detached values. For higher-order "
+            "derivatives compose the transforms, e.g. "
+            "jacobian(lambda x: jacobian(f, x)[...], xs) or hessian(f, xs).")
+
+
+def jacobian(func, xs, create_graph=False, batch_axis=None):
+    """d func(xs) / d xs. Single input & output -> Tensor
+    [*out_shape, *in_shape]; multiple inputs -> tuple. ``batch_axis=0``
+    treats dim 0 as a batch (per-sample Jacobians, vmapped)."""
+    _check_create_graph(create_graph)
+    single, vals = _norm_inputs(xs)
+    pure = _functionalize(func)
+
+    if batch_axis is None:
+        jac = jax.jacrev(pure, argnums=tuple(range(len(vals))))(*vals)
+    else:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        jac = jax.vmap(jax.jacrev(pure, argnums=tuple(range(len(vals)))))(
+            *vals)
+    jac = jax.tree.map(_T(), jac)
+    return jac[0] if single else jac
+
+
+def hessian(func, xs, create_graph=False, batch_axis=None):
+    """d²(scalar func)/dxs² — forward-over-reverse like the reference's
+    Hessian (jacfwd(jacrev))."""
+    _check_create_graph(create_graph)
+    if batch_axis not in (None, 0):
+        raise ValueError("batch_axis must be None or 0")
+    single, vals = _norm_inputs(xs)
+    pure = _functionalize(func)
+    argnums = tuple(range(len(vals)))
+
+    def scalar(*v):
+        out = pure(*v)
+        leaves = jax.tree.leaves(out)
+        if len(leaves) != 1 or jnp.ndim(leaves[0]) != 0:
+            # under vmap (batch_axis=0) a valid per-sample output is still
+            # a 0-d scalar, so this check holds in both modes
+            raise ValueError("hessian expects a scalar-output func")
+        return leaves[0]
+
+    h = jax.jacfwd(jax.jacrev(scalar, argnums=argnums), argnums=argnums)
+    hes = (jax.vmap(h)(*vals) if batch_axis == 0 else h(*vals))
+    hes = jax.tree.map(_T(), hes)
+    if single:
+        return hes[0][0]
+    return hes
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) — reference
+    paddle.incubate.autograd.jvp."""
+    single, vals = _norm_inputs(xs)
+    pure = _functionalize(func)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        _, tangents = _norm_inputs(v)
+    out, tangent_out = jax.jvp(pure, vals, tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ @ J) — reference
+    paddle.incubate.autograd.vjp."""
+    single, vals = _norm_inputs(xs)
+    pure = _functionalize(func)
+    out, pullback = jax.vjp(pure, *vals)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot = _unwrap(v)
+    grads = pullback(cot)
+    grads = _wrap(grads)
+    return _wrap(out), (grads[0] if single else grads)
+
+
+class Jacobian:
+    """Lazy row-indexable Jacobian (reference incubate.autograd.Jacobian):
+    ``J[:]`` materializes [out_size, in_size] (2-D, flattened), rows/cols
+    sliceable; ``is_batched=True`` keeps dim 0 as batch."""
+
+    def __init__(self, func, xs, is_batched=False):
+        single = not isinstance(xs, (tuple, list))
+        mats = jacobian(func, xs, batch_axis=0 if is_batched else None)
+        blocks = (mats,) if single else tuple(mats)
+        ins = (xs,) if single else tuple(xs)
+        cols = []
+        for blk, xin in zip(blocks, ins):
+            v = blk.value
+            x_sz = int(jnp.size(_unwrap(xin)))
+            if is_batched:
+                b = v.shape[0]
+                cols.append(v.reshape(b, -1, x_sz // b))
+            else:
+                cols.append(v.reshape(-1, x_sz))
+        # multi-input: concatenate per-input blocks along the column dim
+        # ([out_size, sum(in_sizes)]) — the reference's flattened layout
+        self._flat = cols[0] if len(cols) == 1 else jnp.concatenate(
+            cols, axis=-1)
+
+    @property
+    def shape(self):
+        return list(self._flat.shape)
+
+    def __getitem__(self, idx):
+        return _T()(self._flat[idx])
+
+
+class Hessian:
+    """Materialized symmetric Hessian of a scalar func (reference
+    incubate.autograd.Hessian): 2-D [in_size, in_size], indexable."""
+
+    def __init__(self, func, xs, is_batched=False):
+        single = not isinstance(xs, (tuple, list))
+        h = hessian(func, xs, batch_axis=0 if is_batched else None)
+        ins = (xs,) if single else tuple(xs)
+        sizes = [int(jnp.size(_unwrap(x))) for x in ins]
+        if is_batched:
+            b = _unwrap(ins[0]).shape[0]
+            sizes = [s // b for s in sizes]
+        if single:
+            rows = [[h]]
+        else:
+            rows = [[h[i][j] for j in range(len(ins))]
+                    for i in range(len(ins))]
+        # assemble the FULL block matrix incl. cross-input blocks
+        # ([sum(sizes), sum(sizes)]) — dropping them would silently
+        # truncate the Hessian to d²f/dx0²
+        def blk(t, ni, nj):
+            v = t.value
+            return (v.reshape(b, ni, nj) if is_batched
+                    else v.reshape(ni, nj))
+        mat_rows = [jnp.concatenate([blk(rows[i][j], sizes[i], sizes[j])
+                                     for j in range(len(ins))], axis=-1)
+                    for i in range(len(ins))]
+        self._flat = (mat_rows[0] if len(mat_rows) == 1
+                      else jnp.concatenate(mat_rows, axis=-2))
+
+    @property
+    def shape(self):
+        return list(self._flat.shape)
+
+    def __getitem__(self, idx):
+        return _T()(self._flat[idx])

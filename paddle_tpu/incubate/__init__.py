@@ -2,3 +2,5 @@
 layers/functional (Pallas-backed on TPU) and the distributed models (MoE)."""
 from . import nn
 from . import distributed
+
+from .. import autograd as autograd  # incubate.autograd alias

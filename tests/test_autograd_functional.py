@@ -1,0 +1,148 @@
+"""Functional autograd tests (reference:
+``test/autograd/test_autograd_functional_dynamic.py`` † — jacobian/
+hessian/jvp/vjp against closed forms and numeric differentiation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import autograd as AG
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestJacobian:
+    def test_elementwise_square_is_diagonal(self):
+        x = _t([1.0, 2.0, 3.0])
+        J = AG.jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(np.asarray(J), np.diag([2.0, 4.0, 6.0]),
+                                   rtol=1e-6)
+
+    def test_matmul_jacobian_matches_numeric(self):
+        rng = np.random.RandomState(0)
+        W = _t(rng.rand(3, 2))
+        x = _t(rng.rand(3))
+        J = np.asarray(AG.jacobian(lambda a: paddle.matmul(a, W), x))
+        # d(xW)/dx = W^T rows
+        np.testing.assert_allclose(J, np.asarray(W.numpy()).T, rtol=1e-5)
+
+    def test_multi_input(self):
+        x, y = _t([1.0, 2.0]), _t([3.0, 4.0])
+        Jx, Jy = AG.jacobian(lambda a, b: a * b, [x, y])
+        np.testing.assert_allclose(np.asarray(Jx), np.diag([3.0, 4.0]))
+        np.testing.assert_allclose(np.asarray(Jy), np.diag([1.0, 2.0]))
+
+    def test_batched(self):
+        xb = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        Jb = AG.jacobian(lambda a: a * a, xb, batch_axis=0)
+        assert Jb.shape == [2, 3, 3]
+        np.testing.assert_allclose(np.asarray(Jb)[1],
+                                   np.diag([6.0, 8.0, 10.0]))
+
+    def test_jacobian_class_flattens(self):
+        x = _t(np.ones((2, 2)))
+        Jc = AG.Jacobian(lambda a: paddle.sum(a * a, axis=1), x)
+        assert Jc.shape == [2, 4]
+        row0 = np.asarray(Jc[0].value)
+        np.testing.assert_allclose(row0, [2.0, 2.0, 0.0, 0.0])
+
+
+class TestHessian:
+    def test_cubic_sum(self):
+        x = _t([1.0, 2.0, 3.0])
+        H = AG.hessian(lambda a: paddle.sum(a * a * a), x)
+        np.testing.assert_allclose(np.asarray(H),
+                                   np.diag([6.0, 12.0, 18.0]), rtol=1e-6)
+
+    def test_quadratic_form(self):
+        rng = np.random.RandomState(1)
+        A = rng.rand(3, 3).astype(np.float32)
+        A = (A + A.T) / 2
+        At = _t(A)
+        H = AG.hessian(
+            lambda v: 0.5 * paddle.sum(v * paddle.matmul(At, v)), _t(rng.rand(3)))
+        np.testing.assert_allclose(np.asarray(H), A, rtol=1e-4, atol=1e-5)
+
+    def test_hessian_class(self):
+        Hc = AG.Hessian(lambda a: paddle.sum(a * a), _t([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(Hc[:].value), 2 * np.eye(2),
+                                   rtol=1e-6)
+
+    def test_nonscalar_raises(self):
+        with pytest.raises(ValueError, match="scalar"):
+            AG.hessian(lambda a: a * a, _t([1.0, 2.0]))
+
+
+class TestJvpVjp:
+    def test_jvp_matches_directional_derivative(self):
+        x = _t([0.5, 1.5])
+        v = _t([1.0, -1.0])
+        out, tan = AG.jvp(lambda a: paddle.exp(a), x, v)
+        np.testing.assert_allclose(np.asarray(tan),
+                                   np.exp([0.5, 1.5]) * [1.0, -1.0],
+                                   rtol=1e-5)
+
+    def test_vjp_matches_backward(self):
+        x = _t([1.0, 2.0, 3.0])
+        out, g = AG.vjp(lambda a: paddle.sum(paddle.sin(a)), x)
+        np.testing.assert_allclose(np.asarray(g), np.cos([1.0, 2.0, 3.0]),
+                                   rtol=1e-5)
+
+    def test_jvp_vjp_duality(self):
+        # <J v, u> == <v, J^T u> for random u, v
+        rng = np.random.RandomState(2)
+        W = _t(rng.rand(3, 3))
+        fn = lambda a: paddle.tanh(paddle.matmul(a, W))
+        x = _t(rng.rand(3))
+        v = rng.rand(3).astype(np.float32)
+        u = rng.rand(3).astype(np.float32)
+        _, Jv = AG.jvp(fn, x, _t(v))
+        _, JTu = AG.vjp(fn, x, _t(u))
+        np.testing.assert_allclose(np.dot(np.asarray(Jv), u),
+                                   np.dot(v, np.asarray(JTu)), rtol=1e-4)
+
+    def test_incubate_namespace(self):
+        assert paddle.incubate.autograd.jacobian is AG.jacobian
+        assert paddle.incubate.autograd.Hessian is AG.Hessian
+
+
+class TestReviewRegressions:
+    def test_hessian_class_multi_input_full_blocks(self):
+        x, y = _t([1.0, 2.0]), _t([3.0, 4.0])
+        Hc = AG.Hessian(lambda a, b: paddle.sum(a * b), [x, y])
+        # f = sum(a*b): d2f/da db = I, diagonal blocks zero
+        expect = np.block([[np.zeros((2, 2)), np.eye(2)],
+                           [np.eye(2), np.zeros((2, 2))]])
+        np.testing.assert_allclose(np.asarray(Hc[:].value), expect,
+                                   atol=1e-6)
+
+    def test_jacobian_class_multi_input(self):
+        x, y = _t([1.0, 2.0]), _t([3.0, 4.0])
+        Jc = AG.Jacobian(lambda a, b: a * b, [x, y])
+        assert Jc.shape == [2, 4]
+        np.testing.assert_allclose(
+            np.asarray(Jc[:].value),
+            np.hstack([np.diag([3.0, 4.0]), np.diag([1.0, 2.0])]))
+
+    def test_hessian_invalid_batch_axis_raises(self):
+        with pytest.raises(ValueError, match="batch_axis"):
+            AG.hessian(lambda a: paddle.sum(a * a), _t([[1.0, 2.0]]),
+                       batch_axis=1)
+
+    def test_batched_nonscalar_raises(self):
+        with pytest.raises(ValueError, match="scalar"):
+            AG.hessian(lambda a: a * a, _t(np.ones((2, 3))), batch_axis=0)
+
+    def test_create_graph_unsupported(self):
+        with pytest.raises(NotImplementedError, match="compose"):
+            AG.jacobian(lambda a: a * a, _t([1.0]), create_graph=True)
+
+    def test_batched_hessian_class(self):
+        xb = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        Hc = AG.Hessian(lambda a: paddle.sum(a * a * a), xb,
+                        is_batched=True)
+        assert Hc.shape == [2, 3, 3]
+        np.testing.assert_allclose(np.asarray(Hc[1].value),
+                                   np.diag(6.0 * np.arange(3, 6)),
+                                   rtol=1e-5)
