@@ -39,6 +39,9 @@ from . import amp  # noqa: E402
 from . import io  # noqa: E402
 from . import vision  # noqa: E402
 from . import audio  # noqa: E402
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
+from . import text  # noqa: E402
 from . import jit  # noqa: E402
 from . import parallel  # noqa: E402
 from . import distributed  # noqa: E402

@@ -1,0 +1,70 @@
+"""paddle.hub (reference: ``python/paddle/hapi/hub.py`` † — list/help/load
+over a repo's ``hubconf.py`` entrypoints).
+
+The ``local`` source is fully supported (executes ``hubconf.py`` from a
+directory, exactly the reference protocol). ``github``/``gitee`` sources
+need network access and raise a clear error in this offline environment —
+clone the repo and use ``source='local'`` instead.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(repo, source):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source {source!r} needs network access (unavailable "
+            f"here); git-clone {repo!r} yourself and call with "
+            f"source='local'")
+
+
+def _entrypoints(mod):
+    for name in sorted(vars(mod)):
+        fn = getattr(mod, name)
+        if callable(fn) and not name.startswith("_"):
+            yield name, fn
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf."""
+    _check_source(repo_dir, source)
+    mod = _load_hubconf(repo_dir)
+    return [name for name, _ in _entrypoints(mod)]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(repo_dir, source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate entrypoint ``model`` from the repo's hubconf."""
+    _check_source(repo_dir, source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(
+            f"no entrypoint {model!r} in {repo_dir}; available: "
+            f"{[n for n, _ in _entrypoints(mod)]}")
+    return fn(**kwargs)

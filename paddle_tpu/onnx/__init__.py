@@ -1,0 +1,12 @@
+"""paddle.onnx (reference: ``python/paddle/onnx/export.py`` † — paddle2onnx
+bridge). ONNX interchange is CUDA-deployment tooling; the TPU deployment
+path is jit + checkpoint (XLA owns the compiled artifact). ``export``
+raises with that guidance rather than silently writing nothing."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export targets the reference's CUDA/TensorRT deployment "
+        "path. On TPU, deploy with paddle.jit.save (params) + "
+        "paddle.jit.to_static (compiled forward), or serve the jitted "
+        "function directly — XLA owns the compiled artifact.")

@@ -1,0 +1,111 @@
+"""paddle.text (reference: ``python/paddle/text/datasets/`` † — Conll05,
+Imdb, Imikolov, Movielens, UCIHousing, WMT14/16). Every dataset downloads
+from a hosted archive; with zero egress here, constructors raise with the
+manual-placement recipe instead of hanging on DNS. The Dataset machinery
+itself (paddle.io) is fully functional — point it at local files."""
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _offline(name, url_hint):
+    raise RuntimeError(
+        f"paddle.text.{name} downloads its corpus on first use "
+        f"({url_hint}); this environment has no network egress. Download "
+        f"the archive elsewhere and build a paddle.io.Dataset over the "
+        f"local files.")
+
+
+def _make_dataset(name, url_hint):
+    class _D(Dataset):
+        def __init__(self, *a, **k):
+            _offline(name, url_hint)
+
+    _D.__name__ = name
+    _D.__qualname__ = name
+    return _D
+
+
+Conll05st = _make_dataset("Conll05st", "conll05st-tests.tar.gz")
+Imdb = _make_dataset("Imdb", "aclImdb_v1.tar.gz")
+Imikolov = _make_dataset("Imikolov", "simple-examples.tgz")
+Movielens = _make_dataset("Movielens", "ml-1m.zip")
+UCIHousing = _make_dataset("UCIHousing", "housing.data")
+WMT14 = _make_dataset("WMT14", "wmt14.tgz")
+WMT16 = _make_dataset("WMT16", "wmt16.tar.gz")
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False):
+    """CRF Viterbi decode (reference paddle.text.viterbi_decode): returns
+    (scores, best paths). Pure lax.scan over time — jit/batch friendly.
+
+    ``lengths`` masks padded timesteps (padded path entries are 0 and
+    contribute nothing to the score). ``include_bos_eos_tag`` applies the
+    reference's boundary convention: the LAST tag index is BOS (its
+    transition row scores the first step) and the second-to-last is EOS
+    (its transition column scores each sequence's final step).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops._op import unwrap
+    pot = unwrap(potentials)        # [B, T, N]
+    trans = unwrap(transition_params)  # [N, N]
+    B, T, N = pot.shape
+    if lengths is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = unwrap(lengths).astype(jnp.int32)
+
+    init = pot[:, 0]
+    if include_bos_eos_tag:
+        init = init + trans[N - 1][None, :]
+
+    def body(carry, xs):
+        score, t = carry
+        emit = xs  # [B, N]
+        cand = score[:, :, None] + trans[None]  # [B, N, N]
+        best_prev = jnp.argmax(cand, axis=1)    # [B, N]
+        new = jnp.max(cand, axis=1) + emit
+        valid = (t < lens)[:, None]             # padded step: freeze
+        score = jnp.where(valid, new, score)
+        bp = jnp.where(valid, best_prev,
+                       jnp.broadcast_to(jnp.arange(N)[None], (B, N)))
+        return (score, t + 1), bp
+
+    (score, _), backptrs = jax.lax.scan(
+        body, (init, jnp.int32(1)), jnp.swapaxes(pot[:, 1:], 0, 1))
+    if include_bos_eos_tag:
+        score = score + trans[:, N - 2][None, :]
+    last_idx = jnp.argmax(score, axis=1)  # [B]
+    best_score = jnp.max(score, axis=1)
+
+    def walk(carry, bp):  # reverse walk through backpointers
+        # carry = state at time k+1; bp maps it to the best state at k
+        # (identity on padded steps, so the walk passes through them).
+        # Output prev (state k): stacked outputs are times 0..T-2, with
+        # last_idx appended as time T-1.
+        idx = carry
+        prev = jnp.take_along_axis(bp, idx[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(walk, last_idx, backptrs, reverse=True)
+    paths = jnp.concatenate(
+        [jnp.swapaxes(path_rev, 0, 1), last_idx[:, None]], axis=1)
+    paths = jnp.where(jnp.arange(T)[None, :] < lens[:, None], paths, 0)
+    return Tensor(best_score), Tensor(paths.astype(jnp.int32))
+
+
+class ViterbiDecoder:
+    """Layer-shaped wrapper (reference paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=False, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              include_bos_eos_tag=self.include_bos_eos_tag)

@@ -1,0 +1,114 @@
+"""paddle.hub / paddle.text / paddle.onnx surface tests (reference:
+``python/paddle/hapi/hub.py`` †, ``python/paddle/text/`` †)."""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestHub:
+    @pytest.fixture()
+    def repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_mlp(hidden=4):\n"
+            "    'A tiny MLP entrypoint.'\n"
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.nn.Linear(2, hidden)\n"
+            "def _private():\n"
+            "    return None\n")
+        return str(tmp_path)
+
+    def test_list_skips_private(self, repo):
+        assert paddle.hub.list(repo, source="local") == ["tiny_mlp"]
+
+    def test_help_and_load(self, repo):
+        assert "tiny MLP" in paddle.hub.help(repo, "tiny_mlp",
+                                             source="local")
+        m = paddle.hub.load(repo, "tiny_mlp", source="local", hidden=3)
+        out = m(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        assert out.shape == [1, 3]
+
+    def test_remote_sources_gated(self):
+        with pytest.raises(RuntimeError, match="local"):
+            paddle.hub.load("user/repo", "model")
+
+    def test_missing_entrypoint(self, repo):
+        with pytest.raises(ValueError, match="tiny_mlp"):
+            paddle.hub.load(repo, "nope", source="local")
+
+
+class TestText:
+    def test_viterbi_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 5, 3
+        pot = rng.rand(B, T, N).astype(np.float32)
+        trans = rng.rand(N, N).astype(np.float32)
+        score, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans))
+        for b in range(B):
+            def total(p):
+                return pot[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + pot[b, i, p[i]]
+                    for i in range(1, T))
+            best = max(itertools.product(range(N), repeat=T), key=total)
+            assert tuple(np.asarray(path.value)[b]) == best
+            np.testing.assert_allclose(float(np.asarray(score.value)[b]),
+                                       total(best), rtol=1e-5)
+
+    def test_viterbi_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        dec = paddle.text.ViterbiDecoder(
+            paddle.to_tensor(rng.rand(3, 3).astype(np.float32)))
+        score, path = dec(paddle.to_tensor(rng.rand(1, 4, 3).astype(np.float32)))
+        assert path.shape == [1, 4]
+
+    def test_viterbi_lengths_mask_padding(self):
+        rng = np.random.RandomState(2)
+        pot = rng.rand(2, 5, 3).astype(np.float32)
+        trans = rng.rand(3, 3).astype(np.float32)
+        lens = np.array([3, 5], np.int64)
+        score, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens))
+        p = np.asarray(path.value)
+        # padded tail zeroed
+        assert (p[0, 3:] == 0).all()
+        # batch-0 decode == unpadded decode of its first 3 steps
+        s0, p0 = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot[:1, :3]), paddle.to_tensor(trans))
+        np.testing.assert_array_equal(p[0, :3], np.asarray(p0.value)[0])
+        np.testing.assert_allclose(float(np.asarray(score.value)[0]),
+                                   float(np.asarray(s0.value)[0]), rtol=1e-5)
+
+    def test_viterbi_bos_eos_brute_force(self):
+        rng = np.random.RandomState(3)
+        T, N = 4, 4  # last tag = BOS, second-to-last = EOS
+        pot = rng.rand(1, T, N).astype(np.float32)
+        trans = rng.rand(N, N).astype(np.float32)
+        score, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            include_bos_eos_tag=True)
+
+        def total(p):
+            s = trans[N - 1, p[0]] + pot[0, 0, p[0]]
+            for i in range(1, T):
+                s += trans[p[i - 1], p[i]] + pot[0, i, p[i]]
+            return s + trans[p[-1], N - 2]
+        best = max(itertools.product(range(N), repeat=T), key=total)
+        assert tuple(np.asarray(path.value)[0]) == best
+        np.testing.assert_allclose(float(np.asarray(score.value)[0]),
+                                   total(best), rtol=1e-5)
+
+    def test_datasets_gated_offline(self):
+        for name in ["Imdb", "Conll05st", "UCIHousing", "WMT14"]:
+            with pytest.raises(RuntimeError, match="network egress"):
+                getattr(paddle.text, name)()
+
+
+class TestOnnx:
+    def test_export_guides_to_jit(self):
+        with pytest.raises(NotImplementedError, match="jit"):
+            paddle.onnx.export(None, "/tmp/x")
