@@ -60,6 +60,14 @@ class Tensor:
 
     @property
     def shape(self):
+        # static.data() placeholders carry their declared spec so symbolic
+        # (-1) dims stay symbolic in build-time shape reads — same contract
+        # as the reference's static mode, where unknown dims read as -1.
+        # Without this, a reshape size computed from the placeholder's
+        # shape would silently bake the materialized batch=1 (ADVICE r3).
+        spec = self.__dict__.get("_static_spec")
+        if spec is not None:
+            return list(spec)
         return list(self._value.shape)
 
     @property
@@ -70,6 +78,10 @@ class Tensor:
 
     @property
     def size(self):
+        # symbolic-batch placeholders: element count is unknown — return -1
+        # (consistent with .shape's -1 dims) rather than a batch=1 product
+        if any(s == -1 for s in self.__dict__.get("_static_spec", ())):
+            return -1
         return int(np.prod(self._value.shape)) if self._value.shape else 1
 
     @property
@@ -116,6 +128,10 @@ class Tensor:
     def __len__(self):
         if self.ndim == 0:
             raise TypeError("len() of a 0-d tensor")
+        if self.shape[0] == -1:  # symbolic-batch placeholder
+            raise TypeError(
+                "len() of a placeholder with a symbolic (-1) leading dim "
+                "is unknown at build time")
         return self._value.shape[0]
 
     def __bool__(self):

@@ -10,15 +10,23 @@
 // cmds: 1=SET 2=GET 3=ADD(val=i64 delta) 4=DEL 5=PREFIX 6=WAIT(val=i64
 // timeout_ms; server-side blocking via the pending-wait list) 7=CLEAR
 //
-// The server is one select() loop on a detached thread: no thread per
+// The server is one poll() loop on a detached thread: no thread per
 // connection, WAITs park in a pending list and are answered when the key
-// appears (or their deadline passes on the 100ms tick).
+// appears (or their deadline passes on the 100ms tick). Connections are
+// non-blocking with per-connection read buffers, so a client stalled
+// mid-frame NEVER blocks the loop (ADVICE r3 — the old select() design
+// paid up to a 5s SO_RCVTIMEO per stall and was undefined past
+// FD_SETSIZE; poll() has no fd ceiling). Only reply WRITES may wait, on a
+// poll(POLLOUT) bounded by 5s total, and only when a reader's socket
+// buffer is full.
 
 #include <arpa/inet.h>
+#include <cerrno>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
-#include <sys/select.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -55,12 +63,26 @@ bool read_exact(int fd, void* buf, size_t n) {
 }
 
 bool write_exact(int fd, const void* buf, size_t n) {
+  // works for blocking (client) and non-blocking (server reply) fds: on
+  // EAGAIN, poll for writability with a 5s total bound — a reader whose
+  // socket buffer stays full for 5s is dropped, not waited on forever
   const char* p = static_cast<const char*>(buf);
+  int64_t deadline = now_ms() + 5000;
   while (n > 0) {
     ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (r <= 0) return false;
-    p += r;
-    n -= static_cast<size_t>(r);
+    if (r > 0) {
+      p += r;
+      n -= static_cast<size_t>(r);
+      continue;
+    }
+    if (r < 0 && errno == EINTR) continue;
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (now_ms() > deadline) return false;
+      pollfd pf{fd, POLLOUT, 0};
+      ::poll(&pf, 1, 100);
+      continue;
+    }
+    return false;
   }
   return true;
 }
@@ -81,6 +103,13 @@ struct PendingWait {
   int64_t deadline_ms;  // -1 = forever
 };
 
+struct Conn {
+  int fd;
+  std::string buf;  // bytes received but not yet forming a complete frame
+  int64_t partial_since_ms;  // first buffering time of the pending partial
+                             // frame; 0 = no partial frame pending
+};
+
 struct Server {
   int listen_fd = -1;
   int port = 0;
@@ -89,7 +118,7 @@ struct Server {
   std::mutex mu;  // guards kv (server thread + clear() from host thread)
   std::unordered_map<std::string, std::string> kv;
   std::unordered_set<std::string> applied_tokens;  // ADD idempotency
-  std::vector<int> clients;
+  std::vector<Conn> clients;
   std::vector<PendingWait> waits;
 
   void answer_ready_waits() {
@@ -115,7 +144,7 @@ struct Server {
   void drop_client(int fd) {
     ::close(fd);
     for (auto it = clients.begin(); it != clients.end(); ++it)
-      if (*it == fd) {
+      if (it->fd == fd) {
         clients.erase(it);
         break;
       }
@@ -123,19 +152,63 @@ struct Server {
       it = (it->fd == fd) ? waits.erase(it) : it + 1;
   }
 
-  // one full request from fd; false = connection closed/broken
-  bool handle(int fd) {
-    uint8_t cmd;
-    uint32_t klen, vlen;
-    if (!read_exact(fd, &cmd, 1) || !read_exact(fd, &klen, 4)) return false;
-    if (klen > (1u << 20)) return false;
-    std::string key(klen, '\0');
-    if (klen && !read_exact(fd, &key[0], klen)) return false;
-    if (!read_exact(fd, &vlen, 4)) return false;
-    if (vlen > (1u << 26)) return false;
-    std::string val(vlen, '\0');
-    if (vlen && !read_exact(fd, &val[0], vlen)) return false;
+  // drain available bytes into the connection's buffer, then dispatch
+  // every COMPLETE frame; a partial frame just stays buffered until the
+  // next poll readiness — the loop never blocks on one client's recv.
+  // false = connection closed/broken/protocol violation
+  bool pump(Conn& c) {
+    char tmp[65536];
+    bool eof = false;
+    bool progressed = false;
+    while (!eof) {
+      ssize_t r = ::recv(c.fd, tmp, sizeof(tmp), 0);
+      if (r > 0) {
+        c.buf.append(tmp, static_cast<size_t>(r));
+        progressed = true;
+        if (r < static_cast<ssize_t>(sizeof(tmp))) break;
+        continue;
+      }
+      if (r == 0) {
+        eof = true;  // peer closed — still dispatch what it already sent
+        break;
+      }
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;
+    }
+    size_t off = 0;
+    while (true) {
+      if (c.buf.size() - off < 5) break;
+      uint8_t cmd;
+      uint32_t klen, vlen;
+      std::memcpy(&cmd, c.buf.data() + off, 1);
+      std::memcpy(&klen, c.buf.data() + off + 1, 4);
+      if (klen > (1u << 20)) return false;
+      if (c.buf.size() - off < 5 + static_cast<size_t>(klen) + 4) break;
+      std::memcpy(&vlen, c.buf.data() + off + 5 + klen, 4);
+      if (vlen > (1u << 26)) return false;
+      size_t total = 5 + static_cast<size_t>(klen) + 4 + vlen;
+      if (c.buf.size() - off < total) break;
+      std::string key = c.buf.substr(off + 5, klen);
+      std::string val = c.buf.substr(off + 5 + klen + 4, vlen);
+      off += total;
+      // a mutation from a client that closed right after writing must
+      // still apply; its failed reply is irrelevant on eof
+      if (!handle(c.fd, cmd, key, val) && !eof) return false;
+    }
+    c.buf.erase(0, off);
+    // the sweep timer measures STALL (time since last byte), not total
+    // frame duration — a slow-but-progressing large SET must not be cut
+    c.partial_since_ms = c.buf.empty() ? 0
+                         : (progressed || !c.partial_since_ms
+                                ? now_ms() : c.partial_since_ms);
+    return !eof;
+  }
 
+  // one parsed request; false = drop the connection
+  bool handle(int fd, uint8_t cmd, const std::string& key,
+              const std::string& val) {
+    uint32_t vlen = static_cast<uint32_t>(val.size());
     switch (cmd) {
       case 1: {  // SET
         {
@@ -234,39 +307,55 @@ struct Server {
 
   void loop() {
     while (!stop.load()) {
-      fd_set rfds;
-      FD_ZERO(&rfds);
-      FD_SET(listen_fd, &rfds);
-      int maxfd = listen_fd;
-      for (int fd : clients) {
-        FD_SET(fd, &rfds);
-        if (fd > maxfd) maxfd = fd;
-      }
-      timeval tv{0, 100 * 1000};  // 100ms tick drives wait deadlines
-      int rc = ::select(maxfd + 1, &rfds, nullptr, nullptr, &tv);
-      if (rc < 0 && errno != EINTR) break;
+      std::vector<pollfd> pfds;
+      pfds.push_back({listen_fd, POLLIN, 0});
+      for (auto& c : clients) pfds.push_back({c.fd, POLLIN, 0});
+      int rc = ::poll(pfds.data(), pfds.size(), 100);  // 100ms tick drives
+      if (rc < 0 && errno != EINTR) break;             // wait deadlines
       if (rc > 0) {
-        if (FD_ISSET(listen_fd, &rfds)) {
+        if (pfds[0].revents & POLLIN) {
           int c = ::accept(listen_fd, nullptr, nullptr);
           if (c >= 0) {
             int one = 1;
             ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-            // bound handle()'s blocking reads: a client stalled mid-frame
-            // costs at most this timeout, then read_exact fails and the
-            // connection is dropped (instead of wedging every rank's
-            // bootstrap + parked WAIT deadlines)
-            timeval rto{5, 0};
-            ::setsockopt(c, SOL_SOCKET, SO_RCVTIMEO, &rto, sizeof(rto));
-            clients.push_back(c);
+            // reclaim half-open peers (died without FIN/RST): kernel
+            // keepalive probes eventually surface POLLERR/POLLHUP
+            ::setsockopt(c, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+            ::fcntl(c, F_SETFL, ::fcntl(c, F_GETFL, 0) | O_NONBLOCK);
+            clients.push_back({c, std::string(), 0});
           }
         }
-        std::vector<int> snapshot = clients;
-        for (int fd : snapshot)
-          if (FD_ISSET(fd, &rfds) && !handle(fd)) drop_client(fd);
+        // pfds[i] (i>=1) mirrored clients[i-1] at poll time; collect ready
+        // fds first because pump() may mutate `clients` via drop paths
+        std::vector<int> ready;
+        for (size_t i = 1; i < pfds.size(); ++i)
+          if (pfds[i].revents & (POLLIN | POLLERR | POLLHUP))
+            ready.push_back(pfds[i].fd);
+        for (int fd : ready) {
+          Conn* c = nullptr;
+          for (auto& e : clients)
+            if (e.fd == fd) {
+              c = &e;
+              break;
+            }
+          if (c != nullptr && !pump(*c)) drop_client(fd);
+        }
+      }
+      // drop connections stalled mid-frame for >30s (the non-blocking
+      // reads never stall the LOOP, but the fd + partial buffer would
+      // otherwise live forever; a healthy idle conn has no partial frame
+      // and is exempt)
+      {
+        int64_t t = now_ms();
+        std::vector<int> stalled;
+        for (auto& c : clients)
+          if (c.partial_since_ms && t - c.partial_since_ms > 30000)
+            stalled.push_back(c.fd);
+        for (int fd : stalled) drop_client(fd);
       }
       answer_ready_waits();
     }
-    for (int fd : clients) ::close(fd);
+    for (auto& c : clients) ::close(c.fd);
     ::close(listen_fd);
   }
 };

@@ -71,7 +71,17 @@ class TCPStore:
 
     # ------------------------------------------------------------- kv ops
     def set(self, key: str, value):
-        v = value.encode() if isinstance(value, str) else bytes(value)
+        # str/bytes-like only: bytes(5) would silently store five NUL bytes
+        # rather than any representation of 5 (ADVICE r3)
+        if isinstance(value, str):
+            v = value.encode()
+        elif isinstance(value, (bytes, bytearray, memoryview)):
+            v = bytes(value)
+        else:
+            raise TypeError(
+                f"TCPStore.set value must be str or bytes-like, got "
+                f"{type(value).__name__}; encode it explicitly "
+                f"(e.g. str(value).encode())")
 
         def op():
             rc = self._lib.tcp_store_set(self._client, key.encode(), v,

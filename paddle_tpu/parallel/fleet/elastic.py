@@ -14,6 +14,7 @@ is the distributed checkpoint's job (SURVEY §5.3/§5.4).
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 
@@ -57,6 +58,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._thread = None
         self._prefix = f"/elastic/{job_id}/node/"
+        self._commit_key = f"/elastic/{job_id}/commit"
 
     # ------------------------------------------------------------ liveness
     def _beat(self):
@@ -82,6 +84,12 @@ class ElasticManager:
             self._thread.join(timeout=2 * self.heartbeat_interval)
         try:
             self._kv.delete(self._prefix + self.node_id)
+            # a departing master retires its commit so a stale table can't
+            # arm a defer/adopt cycle for the next membership round
+            commit = self._read_commit()
+            if commit and min(commit["table"],
+                              key=commit["table"].get) == self.node_id:
+                self._kv.delete(self._commit_key)
         except Exception:
             pass
 
@@ -115,35 +123,98 @@ class ElasticManager:
     def _signature(table) -> str:
         return ",".join(f"{n}:{r}" for n, r in sorted(table.items()))
 
+    # ----------------------------------------------------- commit protocol
+    def _read_commit(self):
+        try:
+            raw = self._kv.get(self._commit_key)
+        except Exception:
+            return None
+        if not raw:
+            return None
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8", errors="replace")
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict) or not isinstance(doc.get("table"), dict):
+            return None
+        return doc
+
+    def _publish_commit(self, sig: str, table: dict):
+        self._kv.put(self._commit_key,
+                     json.dumps({"sig": sig, "table": table,
+                                 "stamp": time.time()}))
+
     def wait_ready(self, timeout: float = 60.0, settle: float | None = None):
-        """Block until membership is within [np_min, np_max] and stable for
-        one heartbeat interval; returns (epoch, rank, world, table). The
-        epoch is the membership SIGNATURE itself — a deterministic pure
-        function of the table, so every node that sees the same membership
-        derives the same epoch with no store read-modify-write to race
-        on (two nodes with different views get different epochs, and
-        ``has_changed`` flags whichever is stale)."""
+        """Block until membership is within [np_min, np_max], stable for one
+        heartbeat interval, AND committed by the master; returns
+        (epoch, rank, world, table).
+
+        The epoch is the membership SIGNATURE — a deterministic pure
+        function of the table. Per-node stability alone is not agreement
+        (ADVICE r3: two nodes can pass their settle windows with different
+        snapshots, e.g. a third registers between their reads), so a commit
+        round follows: the node holding rank 0 in its own stable view
+        publishes {sig, table} under a job-wide commit key, and every other
+        node returns only when the PUBLISHED table exists and equals its
+        own stable view. Nobody launches trainers on an un-blessed table;
+        divergent views converge through the shared store within one TTL
+        and the master republishes until they do."""
         settle = (self.heartbeat_interval if settle is None else settle)
         deadline = time.time() + timeout
         prev = None
         stable_since = None
+        n = 0
         while True:
             table = self.rank_table()
             n = len(table)
             ok = self.np_min <= n <= self.np_max and self.node_id in table
+            stable = None
             if ok and table == prev:
                 if stable_since is None:
                     stable_since = time.time()
                 if time.time() - stable_since >= settle:
-                    return (self._signature(table), table[self.node_id], n,
-                            table)
+                    stable = table
             else:
                 stable_since = None
             prev = table
+
+            if stable is not None:
+                sig = self._signature(stable)
+                commit = self._read_commit()
+                ctable = (None if commit is None else
+                          {k: int(v) for k, v in commit["table"].items()})
+                if min(stable) == self.node_id:  # rank 0 in OWN view
+                    # Self-blessing guard: if the COMMITTED master is live
+                    # but missing from our stable view, our views are
+                    # diverging — defer one beat instead of overwriting
+                    # its commit (two masters must not publish divergent
+                    # tables). Views share one store, so within a TTL the
+                    # committed master either appears in our table (then
+                    # it is in `stable` and deferring would deadlock — a
+                    # larger-id node can never republish, so WE publish)
+                    # or expires (legitimate takeover). Like the TTL
+                    # itself, this assumes loosely-synced clocks.
+                    other_master = (None if ctable is None else
+                                    min(ctable, key=ctable.get))
+                    diverged = (other_master not in (None, self.node_id)
+                                and other_master not in stable
+                                and other_master in self.live_nodes())
+                    if not diverged:
+                        if commit is None or commit.get("sig") != sig:
+                            self._publish_commit(sig, stable)
+                        return (sig, stable[self.node_id], n, stable)
+                elif ctable == stable:
+                    return (sig, stable[self.node_id], n, stable)
+                # commit missing/stale: keep heartbeating until the master
+                # blesses the membership we see (or our view converges)
+
             if time.time() > deadline:
                 raise TimeoutError(
                     f"elastic: {n} live node(s), need "
-                    f"[{self.np_min}, {self.np_max}] within {timeout}s")
+                    f"[{self.np_min}, {self.np_max}] (and a master commit) "
+                    f"within {timeout}s")
             time.sleep(min(self.heartbeat_interval, 0.2))
 
     def has_changed(self, epoch: str) -> bool:

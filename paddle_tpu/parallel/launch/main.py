@@ -118,6 +118,11 @@ def launch():
     # reach the store through PADDLE_MASTER_KV (operator-provided) or the
     # deterministic master-port+1 convention below.
     elastic_mgr = None
+    # the endpoint exported to trainer children as PADDLE_MASTER_KV: the
+    # local server when we host it, else whatever endpoint this launcher
+    # RESOLVED (probe or operator env) — so child env is consistent across
+    # master and non-master nodes (ADVICE r3)
+    kv_export = kv_server.endpoint if kv_server is not None else None
     if args.elastic_level >= 1:
         kv_endpoint_for_elastic = None
         if kv_server is not None:
@@ -135,6 +140,8 @@ def launch():
                 base = f"{host}:{int(port) + 1}"
                 kv_endpoint_for_elastic = _probe_endpoint(
                     [first + base, other + base])
+        if kv_export is None:
+            kv_export = kv_endpoint_for_elastic
         if kv_endpoint_for_elastic is not None:
             from ..fleet.elastic import ElasticManager
             # unique per-launcher identity (two launchers default to
@@ -204,8 +211,7 @@ def launch():
             break
         procs[:] = []
         for lr in range(max(args.procs, 1)):
-            env = _child_env(args, lr, nnodes_live,
-                             kv_server.endpoint if kv_server else None)
+            env = _child_env(args, lr, nnodes_live, kv_export)
             logfile = os.path.join(args.log_dir, f"workerlog.{lr}")
             out = open(logfile, "ab")
             logger.info(f"spawn rank {env['PADDLE_TRAINER_ID']}: "

@@ -156,11 +156,15 @@ def data(name, shape, dtype="float32", lod_level=0):
     from ..core import dtype as dtype_mod
     prog = current_program()
     spec = tuple(-1 if s in (-1, None) else int(s) for s in shape)
-    # -1 dims materialize as 1 for the zero placeholder; build-time Python
-    # reads of the placeholder's shape therefore see 1, not the symbolic
-    # batch — Executor.run validates feeds against the ORIGINAL spec
+    # -1 dims materialize as 1 for the zero placeholder VALUE, but the
+    # placeholder's `.shape` reads back the declared spec (-1 stays -1, as
+    # in the reference's static mode) so a reshape/arange size computed
+    # from it at build time cannot silently bake batch=1. reshape(-1, ...)
+    # then infers correctly at replay for any fed batch.
     shape = tuple(1 if s == -1 else s for s in spec)
     t = Tensor(jnp.zeros(shape, dtype_mod.to_jax_dtype(dtype)), name=name)
+    if any(s == -1 for s in spec):
+        t._static_spec = spec
     if prog is not None:
         prog.add_feed(name, t, spec_shape=spec)
     return t

@@ -88,6 +88,37 @@ class TestElasticManager:
         finally:
             srv.stop()
 
+    def test_commit_round_blocks_non_master(self):
+        """ADVICE r3: per-node stability alone is not agreement. A
+        non-master must NOT return from wait_ready until the master has
+        published the membership table it also sees."""
+        import threading
+        srv = KVServer(port=0)
+        try:
+            a = _mgr(srv, "a", np="2:3").start()
+            b = _mgr(srv, "b", np="2:3").start()
+            out = {}
+
+            def b_wait():
+                out["b"] = b.wait_ready(timeout=10)
+            t = threading.Thread(target=b_wait)
+            t.start()
+            # b's view is stable well within 1s, but no commit exists yet
+            time.sleep(1.0)
+            assert "b" not in out, "non-master returned without a commit"
+            ea, ra, wa, ta = a.wait_ready(timeout=10)  # master: publishes
+            t.join(timeout=10)
+            assert not t.is_alive() and "b" in out
+            eb, rb, wb, tb = out["b"]
+            assert (ea, ta) == (eb, tb) and sorted([ra, rb]) == [0, 1]
+            # the committed table is readable on the store
+            import json as _json
+            doc = _json.loads(a._kv.get(a._commit_key))
+            assert doc["sig"] == ea and doc["table"] == {"a": 0, "b": 1}
+            a.stop(); b.stop()
+        finally:
+            srv.stop()
+
     def test_scale_down_reassigns_ranks(self):
         srv = KVServer(port=0)
         try:

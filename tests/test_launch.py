@@ -159,6 +159,39 @@ class TestNativeTCPStore:
         finally:
             m.stop_server()
 
+    def test_set_rejects_non_bytes(self):
+        # ADVICE r3: bytes(5) would silently store five NUL bytes
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            with pytest.raises(TypeError, match="str or bytes"):
+                m.set("/k", 5)
+            m.set("/k", bytearray(b"ok"))
+            assert m.get("/k") == b"ok"
+        finally:
+            m.stop_server()
+
+    def test_stalled_partial_frame_does_not_block_loop(self):
+        """ADVICE r3: a client that sends HALF a request frame and stalls
+        must not delay other clients (old design: 5s SO_RCVTIMEO blocked
+        the whole select loop per stall)."""
+        import socket as _socket
+        import struct as _struct
+        from paddle_tpu.distributed import TCPStore
+        m = TCPStore(is_master=True)
+        try:
+            # handcraft a partial SET frame: cmd + klen, then stall
+            s = _socket.create_connection(("127.0.0.1", m.port))
+            s.sendall(bytes([1]) + _struct.pack("<I", 100))  # promises 100b key
+            c = TCPStore(port=m.port)
+            t0 = time.time()
+            c.set("/fast", "v")
+            assert c.get("/fast") == b"v"
+            assert time.time() - t0 < 2.0, "healthy client was blocked"
+            s.close()
+        finally:
+            m.stop_server()
+
     def test_cross_connection_and_prefix(self):
         from paddle_tpu.distributed import TCPStore
         m = TCPStore(is_master=True)

@@ -111,6 +111,24 @@ class TestStaticProgram:
             prog, feed={"x": np.ones((7, 4), np.float32)}, fetch_list=[out])
         assert r.shape == (7, 3)
 
+    def test_symbolic_dim_reads_as_minus_one(self):
+        """ADVICE r3: data() with a -1 dim must not let build-time shape
+        reads bake batch=1. The placeholder's .shape returns the declared
+        spec (-1 stays -1, reference static-mode contract), so
+        reshape(x.shape[0], ...) records -1 and infers per-feed."""
+        prog = static.StaticProgram()
+        with static.program_guard(prog):
+            x = static.data("x", shape=[-1, 4])
+            assert x.shape == [-1, 4]  # not [1, 4]
+            y = paddle.reshape(x, [x.shape[0], 2, 2])
+            out = paddle.sum(y, axis=[1, 2])
+        for batch in (3, 5):
+            a = np.ones((batch, 4), np.float32)
+            r, = static.Executor().run(prog, feed={"x": a},
+                                       fetch_list=[out])
+            assert r.shape == (batch,)
+            np.testing.assert_allclose(r, np.full(batch, 4.0))
+
     def test_bypass_dispatch_warns(self):
         import warnings
         from paddle_tpu.core.tensor import Tensor as RawTensor
