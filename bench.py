@@ -132,6 +132,42 @@ def main_one_config(idx):
     return 0
 
 
+def _measure_decode(max_new=256, B=8, prompt=128):
+    """Decode throughput on the 350M config: jitted generate with the
+    ragged Pallas decode kernel (kernels/pallas_decode.py). Timed run is
+    the SECOND call (same shapes -> cached executable); prefill is one
+    128-token forward vs `max_new` sequential steps, so the figure is
+    decode-dominated. Reported via DecodeMeter (2N fwd FLOPs/token; decode
+    is weight-streaming-bound so mbu ~ bandwidth utilization)."""
+    import numpy as np_
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.profiler.metrics import DecodeMeter
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=24,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=2048, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    rng = np_.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, prompt)).astype(np_.int32))
+    out = model.generate(ids, max_new_tokens=max_new, seed=0)  # compile
+    _ = out.numpy()
+    meter = DecodeMeter(n_params=model.num_params())
+    meter.start()
+    out = model.generate(ids, max_new_tokens=max_new, seed=0)
+    _ = out.numpy()  # host transfer = reliable fence on axon
+    meter.end_decode(tokens=B * max_new)
+    rep = meter.report()
+    return {"name": "decode",
+            "decode_tok_s": float(rep["decode_tokens_per_sec"]),
+            "decode_mbu": float(rep.get("decode_mbu", 0.0)),
+            "B": B, "prompt": prompt, "max_new": max_new}
+
+
 def main_7b_layer():
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "scripts"))
@@ -239,8 +275,15 @@ def watchdog():
     if r7 is not None:
         layer7b = (f", 7b-layer {r7['layer7b_tok_s']} tok/s "
                    f"{r7['layer7b_mfu']:.3f} MFU")
+
+    decode = ""
+    rc, out, err = _run([me, "--decode"], CONFIG_TIMEOUT_S)
+    rd = _parse_result(rc, out)
+    if rd is not None:
+        decode = (f", decode {rd['decode_tok_s']:.0f} tok/s "
+                  f"mbu={rd['decode_mbu']:.2f}")
     _flush_self_bench(results, extra={"best": best["name"],
-                                      "layer7b": r7})
+                                      "layer7b": r7, "decode": rd})
 
     mfu = best["mfu"]
     print(json.dumps({
@@ -249,7 +292,7 @@ def watchdog():
         "unit": f"MFU (6N formula, N={best['n_params']/1e6:.0f}M, "
                 f"{best['tok_s']:.0f} tok/s/chip, "
                 f"peak={best['peak']/1e12:.0f}TF, loss={best['loss']:.3f}, "
-                f"cfg={best['name']}{layer7b})",
+                f"cfg={best['name']}{layer7b}{decode})",
         "vs_baseline": round(mfu / 0.45, 4),
     }))
     return 0
@@ -260,4 +303,7 @@ if __name__ == "__main__":
         sys.exit(main_one_config(int(sys.argv[sys.argv.index("--config") + 1])))
     if "--layer7b" in sys.argv:
         sys.exit(main_7b_layer())
+    if "--decode" in sys.argv:
+        print(json.dumps(_measure_decode()))
+        sys.exit(0)
     sys.exit(watchdog())

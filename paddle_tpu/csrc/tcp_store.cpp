@@ -123,22 +123,25 @@ struct Server {
 
   void answer_ready_waits() {
     int64_t t = now_ms();
+    // a failed reply (possible mid-frame now that client fds are
+    // non-blocking with a bounded write deadline) leaves the peer's
+    // stream desynced — the connection must be dropped, not kept
+    std::vector<int> broken;
     for (auto it = waits.begin(); it != waits.end();) {
       bool found;
       {
         std::lock_guard<std::mutex> g(mu);
         found = kv.count(it->key) != 0;
       }
-      if (found) {
-        send_reply(it->fd, 0, "");
-        it = waits.erase(it);
-      } else if (it->deadline_ms >= 0 && t > it->deadline_ms) {
-        send_reply(it->fd, -1, "");
+      if (found || (it->deadline_ms >= 0 && t > it->deadline_ms)) {
+        if (!send_reply(it->fd, found ? 0 : -1, ""))
+          broken.push_back(it->fd);
         it = waits.erase(it);
       } else {
         ++it;
       }
     }
+    for (int fd : broken) drop_client(fd);
   }
 
   void drop_client(int fd) {

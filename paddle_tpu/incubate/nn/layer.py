@@ -234,6 +234,8 @@ def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None):
         v = qkv[:, :, H + Hkv:]
         Sq = q.shape[1]
         new_cache = None
+        decode_one = (layer_cache is not None and time_step is not None
+                      and Sq == 1 and mask is None)
         if layer_cache is not None:
             ck, cv = layer_cache[0], layer_cache[1]
             if time_step is not None:
@@ -241,20 +243,29 @@ def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None):
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, v, time_step, 1)
                 k, v = ck[:, :time_step + Sq], cv[:, :time_step + Sq]
             new_cache = jnp.stack([ck, cv])
-        if Hkv != H:  # GQA: each kv head serves H//Hkv query heads
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
-        scale = 1.0 / math.sqrt(D)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        Sq, Sk = q.shape[1], k.shape[1]
-        causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
-        logits = jnp.where(causal[None, None], logits, -1e30)
-        if mask is not None:
-            logits = logits + mask.astype(logits.dtype)
-        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        attn = attn.reshape(attn.shape[0], attn.shape[1], E)
+        if decode_one:
+            # single-token decode: the ragged Pallas kernel reads the cache
+            # in place (no GQA repeat, kv blocks past t+1 skipped) —
+            # reference's masked-multihead-attention decode kernel slot
+            from ...kernels.pallas_decode import decode_attention_pallas
+            lens = jnp.full((q.shape[0],), time_step + 1, jnp.int32)
+            attn = decode_attention_pallas(q[:, 0], ck, cv, lens)[:, None]
+            attn = attn.astype(h.dtype).reshape(q.shape[0], 1, E)
+        else:
+            if Hkv != H:  # GQA: each kv head serves H//Hkv query heads
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+            scale = 1.0 / math.sqrt(D)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            Sq, Sk = q.shape[1], k.shape[1]
+            causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+            logits = jnp.where(causal[None, None], logits, -1e30)
+            if mask is not None:
+                logits = logits + mask.astype(logits.dtype)
+            probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            attn = attn.reshape(attn.shape[0], attn.shape[1], E)
         h = residual + jnp.matmul(attn, lw) + lbias
         residual = h
         hn = ln(h, fls, flb)

@@ -79,6 +79,11 @@ class LlamaConfig:
     # live (the reference's c_softmax_with_cross_entropy memory trick,
     # TPU-style). 0 = single fused [B,S,V] logsumexp.
     loss_chunk: int = 0
+    # "pallas" routes generate()'s per-token attention through the ragged
+    # single-query Pallas kernel (kernels/pallas_decode.py — GQA resolved
+    # in-kernel, kv blocks past the current position skipped); "jnp" keeps
+    # the masked-softmax-over-S_max path.
+    decode_attention: str = "pallas"
     dtype: str = "float32"
 
     @property
@@ -466,7 +471,7 @@ class LlamaPretrainCriterion(nn.Layer):
 def _llama_generate_fn(ids, max_new, s_max, nh, nkv, hd, eps, theta, tied,
                        temperature, top_k, key, *, embed, wq, wk, wv, wo,
                        w_gate, w_up, w_down, input_ln, post_ln, final_norm,
-                       lm_head):
+                       lm_head, decode_attn="pallas"):
     """Jitted prefill + decode (reference: the generation loop over
     ``fused_multi_transformer`` with in-place KV cache, SURVEY §3.5 —
     here the cache is a functional scan carry updated with
@@ -532,17 +537,24 @@ def _llama_generate_fn(ids, max_new, s_max, nh, nkv, hd, eps, theta, tied,
         k = _apply_rope(k, sin_p, cos_p)
         ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        kr, vr = ck, cv
-        if nkv != nh:
-            kr = jnp.repeat(kr, nh // nkv, axis=2)
-            vr = jnp.repeat(vr, nh // nkv, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
-                            preferred_element_type=jnp.float32) \
-            / jnp.sqrt(jnp.float32(hd))
-        valid = jnp.arange(s_max)[None, None, None, :] <= pos
-        logits = jnp.where(valid, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        if decode_attn == "pallas":
+            # ragged single-query kernel: GQA resolved in-kernel (no
+            # G×-repeated cache read) and kv blocks past pos+1 skipped
+            from ..kernels.pallas_decode import decode_attention_pallas
+            lens = jnp.full((B,), pos + 1, jnp.int32)
+            attn = decode_attention_pallas(q[:, 0], ck, cv, lens)[:, None]
+        else:
+            kr, vr = ck, cv
+            if nkv != nh:
+                kr = jnp.repeat(kr, nh // nkv, axis=2)
+                vr = jnp.repeat(vr, nh // nkv, axis=2)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                                preferred_element_type=jnp.float32) \
+                / jnp.sqrt(jnp.float32(hd))
+            valid = jnp.arange(s_max)[None, None, None, :] <= pos
+            logits = jnp.where(valid, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
         h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(B, 1, nh * hd), lwo)
         h = ffn(h, lpost, lg, lu, ld)
         return (h, pos), (ck, cv)
@@ -600,7 +612,7 @@ def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
         lm_head=(self.embed_tokens.value if self.lm_head is None
                  else self.lm_head.value))
     cache_key = (int(max_new_tokens), s_max, float(temperature),
-                 int(top_k))
+                 int(top_k), c.decode_attention)
     jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
     fn = jit_cache.get(cache_key)
     if fn is None:
@@ -609,7 +621,8 @@ def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
             nh=c.num_attention_heads, nkv=c.num_key_value_heads,
             hd=c.head_dim, eps=float(c.rms_norm_eps),
             theta=float(c.rope_theta), tied=self.lm_head is None,
-            temperature=float(temperature), top_k=int(top_k)))
+            temperature=float(temperature), top_k=int(top_k),
+            decode_attn=c.decode_attention))
         jit_cache[cache_key] = fn
     out = fn(ids, key=key, **params)
     return _T(out)
