@@ -21,6 +21,7 @@
 // buffer is full.
 
 #include <arpa/inet.h>
+#include <algorithm>
 #include <cerrno>
 #include <fcntl.h>
 #include <netdb.h>
@@ -134,9 +135,10 @@ struct Server {
         found = kv.count(it->key) != 0;
       }
       if (found || (it->deadline_ms >= 0 && t > it->deadline_ms)) {
-        if (!send_reply(it->fd, found ? 0 : -1, ""))
-          broken.push_back(it->fd);
-        it = waits.erase(it);
+        if (!send_reply(it->fd, found ? 0 : -1, "") &&
+            std::find(broken.begin(), broken.end(), it->fd) == broken.end())
+          broken.push_back(it->fd);  // dedup: double-close would destroy a
+        it = waits.erase(it);        // descriptor another thread reopened
       } else {
         ++it;
       }

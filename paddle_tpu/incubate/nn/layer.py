@@ -146,9 +146,13 @@ class FusedMultiTransformer(nn.Layer):
                  ffn1_weight_attrs=None, ffn1_bias_attrs=None,
                  ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
                  num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
-                 kv_num_heads=None, name=None):
+                 kv_num_heads=None, name=None, decode_attention="pallas"):
         super().__init__()
         assert normalize_before, "reference fused op is pre-LN"
+        # "pallas" routes single-token decode through the ragged Pallas
+        # kernel (kernels/pallas_decode.py); "jnp" keeps the masked-softmax
+        # path — the same escape hatch LlamaConfig.decode_attention offers
+        self.decode_attention = decode_attention
         if num_layers < 0:
             num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
         self.num_layers = num_layers
@@ -198,16 +202,19 @@ class FusedMultiTransformer(nn.Layer):
         eps = self.epsilon
         H, D, Hkv = self.num_heads, self.head_dim, self.kv_num_heads
 
+        decode_attn = self.decode_attention
+
         def stack_fn(src_v, mask_v, cache_v, **p):
             return _fmt_forward(src_v, mask_v, cache_v, p, H, D, act, eps, ts,
-                                Hkv)
+                                Hkv, decode_attn=decode_attn)
 
         out = op_apply(stack_fn, (src, attn_mask, cache_vals), vals,
                        name="fused_multi_transformer")
         return out
 
 
-def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None):
+def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None,
+                 decode_attn="pallas"):
     """Layer-scan body for the fused stack. cache: [L, 2, B, S_max, Hkv, D].
 
     ``time_step`` is the cache write offset: prefill = Sq tokens written at
@@ -235,7 +242,8 @@ def _fmt_forward(x, mask, cache, p, H, D, act, eps, time_step, Hkv=None):
         Sq = q.shape[1]
         new_cache = None
         decode_one = (layer_cache is not None and time_step is not None
-                      and Sq == 1 and mask is None)
+                      and Sq == 1 and mask is None
+                      and decode_attn == "pallas")
         if layer_cache is not None:
             ck, cv = layer_cache[0], layer_cache[1]
             if time_step is not None:

@@ -89,6 +89,15 @@ def _decode_call(q, k_cache, v_cache, lengths, scale, block_k, interpret):
     grid = (B, Hkv, nk)
     kernel = functools.partial(_decode_kernel, scale=scale, block_k=block_k,
                                s_max=s_max)
+    def _kv_index(b, h, ki, lens):
+        # ragged DMA skip: blocks fully past lens[b] re-reference the last
+        # valid block instead of fetching — Pallas elides the copy when the
+        # block index repeats, so HBM traffic scales with the VALID cache
+        # length, not S_max (the compute for those steps is pl.when-gated
+        # off anyway). This is the paged-attention fetch pattern.
+        last = (jnp.maximum(lens[b], 1) - 1) // block_k
+        return (b, jnp.minimum(ki, last), h, 0)
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -96,10 +105,8 @@ def _decode_call(q, k_cache, v_cache, lengths, scale, block_k, interpret):
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, G, D), lambda b, h, ki, lens: (b, h, 0, 0)),
-                pl.BlockSpec((1, block_k, 1, D),
-                             lambda b, h, ki, lens: (b, ki, h, 0)),
-                pl.BlockSpec((1, block_k, 1, D),
-                             lambda b, h, ki, lens: (b, ki, h, 0)),
+                pl.BlockSpec((1, block_k, 1, D), _kv_index),
+                pl.BlockSpec((1, block_k, 1, D), _kv_index),
             ],
             out_specs=pl.BlockSpec((1, 1, G, D),
                                    lambda b, h, ki, lens: (b, h, 0, 0)),

@@ -61,7 +61,8 @@ class LlamaConfig:
     # >1 uses the interleaved (virtual-stage) schedule: each pp device
     # holds this many layer chunks and microbatches make that many ring
     # passes — cuts the pipeline bubble ~by this factor (reference
-    # PipelineParallelWithInterleave). Requires microbatches <= pp degree.
+    # PipelineParallelWithInterleave). Microbatches must be <= pp degree
+    # or a multiple of it (group injection).
     pipeline_virtual_stages: int = 1
     # "" | "ring" | "ulysses": context parallelism over the 'sep' mesh axis
     # (parallel.sp_attention). "ring" composes with the pipeline schedule

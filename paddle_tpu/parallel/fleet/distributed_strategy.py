@@ -18,7 +18,8 @@ _DEFAULTS = {
         "pp_degree": 1,
         "sharding_degree": 1,
         "sep_degree": 1,
-        "order": ["dp", "pp", "sharding", "sep", "mp"],
+        "ep_degree": 1,
+        "order": ["dp", "pp", "sharding", "sep", "ep", "mp"],
         "mp_configs": {"sync_param": False, "sync_grad": False},
         "pp_configs": {"micro_batch_size": 1, "accumulate_steps": 1,
                        "schedule_mode": "1F1B", "virtual_pp_degree": 1,

@@ -41,6 +41,7 @@ class Fleet:
             "pp": int(hc.get("pp_degree", 1)),
             "sharding": int(hc.get("sharding_degree", 1)),
             "sep": int(hc.get("sep_degree", 1)),
+            "ep": int(hc.get("ep_degree", 1)),
             "mp": int(hc.get("mp_degree", 1)),
         }
         import jax

@@ -18,7 +18,7 @@ import numpy as np
 from .. import mesh as mesh_mod
 from ..mesh import Group
 
-ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+ORDER = ["dp", "pp", "sharding", "sep", "ep", "mp"]
 
 
 class CommunicateTopology:
@@ -73,6 +73,7 @@ class HybridCommunicateGroup:
         self._pp_degree = degrees.get("pp", 1)
         self._sharding_degree = degrees.get("sharding", 1)
         self._sep_degree = degrees.get("sep", 1)
+        self._ep_degree = degrees.get("ep", 1)
         if mesh is None:
             mesh = mesh_mod.build_mesh(degrees)
         self.mesh = mesh_mod.set_mesh(mesh)
@@ -94,6 +95,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._sep_degree
 
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
     # ---------------------------------------------------------------- groups
     def get_data_parallel_group(self) -> Group:
         return Group(self.mesh, ("dp",), pg_name="dp")
@@ -109,6 +113,10 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> Group:
         return Group(self.mesh, ("sep",), pg_name="sep")
+
+    def get_expert_parallel_group(self) -> Group:
+        """The moe_group: pass to MoELayer to carry experts on 'ep'."""
+        return Group(self.mesh, ("ep",), pg_name="ep")
 
     def get_check_parallel_group(self, sharding_new_group=False) -> Group:
         # dp+sharding fused check group (reference semantics)
@@ -140,7 +148,9 @@ class HybridCommunicateGroup:
         return self._pp_degree == 1
 
     def get_rank_from_stage(self, stage_id, **kwargs):
-        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0, mp=0)
+        coords = {n: 0 for n in self._topo.get_hybrid_group_names()}
+        coords["pp"] = stage_id
+        return self._topo.get_rank(**coords)
 
     def get_p2p_groups(self):
         return None

@@ -124,12 +124,18 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
     PipelineLayer topologies) via ``lax.switch`` on the stage index.
 
     ``stage_fns[i](stage_params[i], h) -> h`` must all map activations of
-    the same shape/dtype (the pipeline handoff contract). Stage params are
-    passed replicated w.r.t. 'pp' (arbitrary per-stage pytrees can't be
-    mesh-sharded on a stage dim); weight residency therefore applies only
-    to the homogeneous ``pipeline_spmd`` path. Gradients for every stage's
-    params come out correct: shard_map's autodiff psums the replicated-in
-    cotangents over 'pp', and only stage i's devices contribute nonzero.
+    the same shape/dtype (the pipeline handoff contract).
+
+    Weight residency: when every stage's params share ONE pytree structure
+    with matching leaf shapes/dtypes (stages differ only in their fn or
+    weight values), the per-stage leaves are stacked on a leading stage
+    dim sharded ``P('pp')`` — each device HOLDS only its own stage's
+    weights, like the reference's per-rank PipelineLayer ownership †; only
+    the fn dispatch remains a ``lax.switch``. Structurally heterogeneous
+    stages fall back to pp-replicated params (arbitrary per-stage pytrees
+    can't be mesh-sharded on a stage dim); gradients are correct either
+    way — shard_map's autodiff psums replicated-in cotangents over 'pp',
+    and sharded-in params keep per-shard cotangents.
     """
     mesh = mesh if mesh is not None else mesh_mod.get_mesh()
     S = _pp_degree(mesh, axis)
@@ -141,6 +147,37 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
     if len(stage_fns) != S:
         raise ValueError(f"{len(stage_fns)} stage fns for pp degree {S}")
     params_tuple = tuple(stage_params)
+
+    import numpy as np
+
+    def _sig(p):
+        # np.shape/result_type tolerate scalar (non-array) leaves, which
+        # the replicated fallback has always supported
+        return [(np.shape(l), jnp.result_type(l)) for l in jax.tree.leaves(p)]
+
+    leaves0, struct0 = jax.tree.flatten(params_tuple[0])
+    sig0 = _sig(params_tuple[0])
+    same_structure = all(
+        jax.tree.structure(p) == struct0 and _sig(p) == sig0
+        for p in params_tuple[1:])
+
+    if same_structure:
+        # stack per-stage leaves on a stage dim sharded over 'pp': each
+        # device receives a leading-dim-1 slice = its OWN stage's weights
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *params_tuple)
+
+        def apply_resident(params_local, a):
+            s = jax.lax.axis_index(axis)
+            mine = jax.tree.map(lambda p: p[0], params_local)
+            branches = [
+                (lambda a, i=i: stage_fns[i](mine, a)) for i in range(S)
+            ]
+            return jax.lax.switch(s, branches, a)
+
+        return _run_schedule(
+            apply_resident, stacked,
+            jax.tree.map(lambda _: P(axis), stacked), x,
+            M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
 
     def apply_switch(params_all, a):
         s = jax.lax.axis_index(axis)
@@ -168,15 +205,18 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
     Layers are split into S·V chunks; device s holds chunks
     ``{s, s+S, ..., s+(V-1)S}`` of K = L/(S·V) layers each, and every
     microbatch makes V passes around the device RING (``ppermute`` with the
-    wrap edge S-1 -> 0). Busy fraction rises from M/(M+S-1) to
-    M·V/(M·V+S-1)-equivalent: the bubble shrinks ~by the interleave factor
-    V for the same microbatch count, which is the point of the reference
-    schedule.
+    wrap edge S-1 -> 0). The bubble fraction drops from (S-1)/(M+S-1) to
+    (S-1)/(M·V+S-1) — shrunk ~by the interleave factor V, which is the
+    point of the reference schedule.
 
-    Conflict-free injection requires ``num_microbatches <= S`` (stage 0's
-    injection window must not collide with pass-v wrap-arounds; the
-    reference's interleave similarly constrains M to multiples of S). For
-    M > S use :func:`pipeline_spmd` or raise V.
+    Microbatches are processed in GROUPS of S: group g's microbatch j
+    makes pass v through device s at tick ``t = g·S·V + v·S + j + s``.
+    The (g, v, j) decomposition of t-s is unique, so every device is busy
+    each tick once the fill ends, and the final-pass wrap of group g
+    arrives at device 0 exactly on group g+1's injection tick (where the
+    injected microbatch overrides it) — conflict-free for any
+    ``num_microbatches`` that is ≤ S or a multiple of S (the reference's
+    interleave likewise constrains M to multiples of S †).
 
     ``stage_fn(chunk_params, h) -> h`` applies ONE chunk (leading dim K).
     """
@@ -186,11 +226,11 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
         return stage_fn(stacked_params, x)
     M = int(num_microbatches)
     V = int(num_virtual)
-    if M > S:
+    if M > S and M % S != 0:
         raise ValueError(
-            f"interleaved schedule needs num_microbatches ({M}) <= pp degree "
-            f"({S}) for conflict-free injection; use pipeline_spmd or fewer "
-            f"microbatches")
+            f"interleaved schedule needs num_microbatches ({M}) <= pp "
+            f"degree ({S}) or a multiple of it (group injection windows "
+            f"must align with pass-wrap ticks)")
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     if L % (S * V) != 0:
         raise ValueError(f"layer count {L} not divisible by S*V = {S * V}")
@@ -199,11 +239,12 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     mb = B // M
+    k_groups = max(1, M // S)
     # layer l = (v*S + s)*K + k  ->  [V, S, K, ...]; dim 1 is the stage dim
     params_r = jax.tree.map(
         lambda p: p.reshape(V, S, K, *p.shape[1:]), stacked_params)
     xs = x.reshape(M, mb, *x.shape[1:])
-    T = M + S * V - 1
+    T = k_groups * S * V + S - 1
     stage = jax.checkpoint(stage_fn) if remat else stage_fn
     ring = [(i, (i + 1) % S) for i in range(S)]
 
@@ -212,12 +253,16 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
         pl = jax.tree.map(lambda p: p[:, 0], params_local)  # [V, K, ...]
 
         def tick(a, t):
-            rel = t - s
-            m = jnp.mod(rel, S)          # microbatch id (when in window)
-            v = jnp.clip(jnp.where(rel >= 0, rel, 0) // S, 0, V - 1)
+            rel = jnp.where(t - s >= 0, t - s, 0)
+            g = rel // (S * V)           # microbatch group
+            r = jnp.mod(rel, S * V)
+            v = r // S                   # virtual pass / chunk index
+            j = jnp.mod(r, S)            # within-group microbatch
+            m = g * S + j                # global microbatch id
             x_t = jax.lax.dynamic_index_in_dim(
                 xs_, jnp.clip(m, 0, M - 1), 0, keepdims=False)
-            inject = (s == 0) & (rel >= 0) & (rel < M)  # first-pass window
+            inject = ((s == 0) & (t - s >= 0) & (v == 0) & (m < M)
+                      & (g < k_groups))
             a_in = jnp.where(inject, x_t, a)
             chunk_params = jax.tree.map(
                 lambda p: jax.lax.dynamic_index_in_dim(p, v, 0,
@@ -234,6 +279,9 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
         body, mesh=mesh, axis_names={axis},
         in_specs=(jax.tree.map(lambda _: P(None, axis), params_r), P()),
         out_specs=P(axis), check_vma=False)(params_r, xs)
-    # microbatch m finishes chunk S*V-1 on device S-1 at tick m + S*V - 1
-    out = ys[S - 1, S * V - 1: S * V - 1 + M]
+    # microbatch m = (g, j) finishes chunk S*V-1 on device S-1 at tick
+    # (g+1)*S*V - 1 + j
+    m_ids = jnp.arange(M)
+    out_ticks = (m_ids // S + 1) * S * V - 1 + jnp.mod(m_ids, S)
+    out = jnp.take(ys[S - 1], out_ticks, axis=0)
     return out.reshape(B, *out.shape[2:])

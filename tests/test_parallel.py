@@ -35,7 +35,7 @@ class TestMeshTopology:
     def test_hybrid_mesh_shape(self):
         hcg = _reset_fleet(dp_degree=2, mp_degree=2, pp_degree=2)
         assert dict(hcg.mesh.shape) == {"dp": 2, "pp": 2, "sharding": 1,
-                                        "sep": 1, "mp": 2}
+                                        "sep": 1, "ep": 1, "mp": 2}
         assert hcg.get_model_parallel_group().nranks == 2
         assert hcg.get_data_parallel_group().nranks == 2
 

@@ -119,6 +119,47 @@ class TestPipeline1F1BHeterogeneous:
         np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_same_structure_stages_get_resident_weights(self):
+        """VERDICT r3 item 4: same-pytree-structure stages must ship their
+        stacked per-stage leaves sharded P('pp') into the schedule (each
+        device holds ONLY its stage), falling back to replicated params
+        only for structurally heterogeneous stages."""
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel import pp as pp_mod
+        hcg = _reset_fleet(pp_degree=2, dp_degree=4)
+        rng = np.random.RandomState(5)
+        H = 8
+        w0 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        w1 = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        x = jnp.asarray(rng.randn(8, H).astype(np.float32))
+        fns = [lambda p, h: jnp.tanh(h @ p),
+               lambda p, h: jax.nn.relu(h @ p)]
+        captured = {}
+        orig = pp_mod._run_schedule
+
+        def spy(apply_fn, params, params_in_specs, *a, **k):
+            captured["specs"] = params_in_specs
+            return orig(apply_fn, params, params_in_specs, *a, **k)
+
+        pp_mod._run_schedule, _saved = spy, orig
+        try:
+            y = jax.jit(lambda p, x: pipeline_1f1b(
+                fns, p, x, num_microbatches=4, mesh=hcg.mesh))((w0, w1), x)
+            assert jax.tree.leaves(captured["specs"]) == [P("pp")]
+            np.testing.assert_allclose(
+                np.asarray(y), np.asarray(fns[1](w1, fns[0](w0, x))),
+                rtol=1e-5, atol=1e-6)
+            # heterogeneous STRUCTURE falls back to replication
+            captured.clear()
+            fns2 = [lambda p, h: jnp.tanh(h @ p),
+                    lambda p, h: jax.nn.relu(h @ p[0] @ p[1])]
+            p2 = (w0, (w1, jnp.eye(H)))
+            jax.jit(lambda p, x: pipeline_1f1b(
+                fns2, p, x, num_microbatches=4, mesh=hcg.mesh))(p2, x)
+            assert all(s == P() for s in jax.tree.leaves(captured["specs"]))
+        finally:
+            pp_mod._run_schedule = _saved
+
     def test_switch_stages_grads(self):
         hcg = _reset_fleet(pp_degree=2, dp_degree=4)
         rng = np.random.RandomState(3)
@@ -356,13 +397,57 @@ class TestInterleavedPipeline:
         hlo = f.lower(W, x).compile().as_text()
         assert "collective-permute" in hlo
 
-    def test_microbatches_above_degree_rejected(self):
+    def test_non_multiple_microbatches_rejected(self):
+        from paddle_tpu.parallel.pp import pipeline_interleaved
+        hcg = _reset_fleet(pp_degree=4, dp_degree=2)
+        W, x = _mk(L=8, H=8, B=6)
+        with pytest.raises(ValueError, match="multiple"):
+            pipeline_interleaved(lambda w, h: h, W, x, num_microbatches=6,
+                                 num_virtual=2, mesh=hcg.mesh)
+
+    @pytest.mark.parametrize("pp,m,v", [(2, 4, 2), (2, 8, 2), (4, 8, 2),
+                                        (2, 4, 4)])
+    def test_interleaved_m_multiple_of_s_matches_serial(self, pp, m, v):
+        """VERDICT r3 item 4: M = k*S is the regime that actually shrinks
+        the bubble at scale (the reference constrains M to multiples of S
+        †); group g's final-pass wrap must land exactly on group g+1's
+        injection ticks."""
+        from paddle_tpu.parallel.pp import pipeline_interleaved
+        hcg = _reset_fleet(pp_degree=pp, dp_degree=8 // pp)
+        W, x = _mk(L=pp * v * 2, H=8, B=m * 2, seed=pp + m + v)
+
+        def stage(chunk_w, h):
+            h, _ = jax.lax.scan(_layer, h, chunk_w)
+            return h
+
+        out = jax.jit(lambda W, x: pipeline_interleaved(
+            stage, W, x, num_microbatches=m, num_virtual=v,
+            mesh=hcg.mesh))(W, x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_serial(W, x)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_interleaved_m_multiple_grads_match_serial(self):
         from paddle_tpu.parallel.pp import pipeline_interleaved
         hcg = _reset_fleet(pp_degree=2, dp_degree=4)
-        W, x = _mk(L=8, H=8, B=8)
-        with pytest.raises(ValueError, match="<= pp degree"):
-            pipeline_interleaved(lambda w, h: h, W, x, num_microbatches=4,
-                                 num_virtual=2, mesh=hcg.mesh)
+        W, x = _mk(L=8, H=8, B=8, seed=9)
+
+        def stage(chunk_w, h):
+            h, _ = jax.lax.scan(_layer, h, chunk_w)
+            return h
+
+        def loss_pp(W):
+            return (pipeline_interleaved(
+                stage, W, x, num_microbatches=4, num_virtual=2,
+                mesh=hcg.mesh) ** 2).sum()
+
+        def loss_serial(W):
+            return (_serial(W, x) ** 2).sum()
+
+        g_pp = jax.jit(jax.grad(loss_pp))(W)
+        g_s = jax.grad(loss_serial)(W)
+        np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_s),
+                                   rtol=5e-5, atol=5e-6)
 
 
 class TestLlamaInterleaved:
