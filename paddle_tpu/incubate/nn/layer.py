@@ -152,6 +152,7 @@ class FusedMultiTransformer(nn.Layer):
         # "pallas" routes single-token decode through the ragged Pallas
         # kernel (kernels/pallas_decode.py); "jnp" keeps the masked-softmax
         # path — the same escape hatch LlamaConfig.decode_attention offers
+        assert decode_attention in ("pallas", "jnp"), decode_attention
         self.decode_attention = decode_attention
         if num_layers < 0:
             num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1

@@ -612,6 +612,10 @@ def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
         post_ln=self.post_ln.value, final_norm=self.final_norm.value,
         lm_head=(self.embed_tokens.value if self.lm_head is None
                  else self.lm_head.value))
+    if c.decode_attention not in ("pallas", "jnp"):
+        raise ValueError(
+            f"decode_attention must be 'pallas' or 'jnp', got "
+            f"{c.decode_attention!r}")
     cache_key = (int(max_new_tokens), s_max, float(temperature),
                  int(top_k), c.decode_attention)
     jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})

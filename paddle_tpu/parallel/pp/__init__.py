@@ -155,7 +155,7 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
         # the replicated fallback has always supported
         return [(np.shape(l), jnp.result_type(l)) for l in jax.tree.leaves(p)]
 
-    leaves0, struct0 = jax.tree.flatten(params_tuple[0])
+    struct0 = jax.tree.structure(params_tuple[0])
     sig0 = _sig(params_tuple[0])
     same_structure = all(
         jax.tree.structure(p) == struct0 and _sig(p) == sig0
@@ -244,7 +244,9 @@ def pipeline_interleaved(stage_fn, stacked_params, x, *, num_microbatches,
     params_r = jax.tree.map(
         lambda p: p.reshape(V, S, K, *p.shape[1:]), stacked_params)
     xs = x.reshape(M, mb, *x.shape[1:])
-    T = k_groups * S * V + S - 1
+    # exactly one past the last harvest tick ((g+1)SV - 1 + j for the final
+    # microbatch): M<=S gives the old M + SV - 1, M=kS gives kSV + S - 1
+    T = ((M - 1) // S + 1) * S * V + (M - 1) % S
     stage = jax.checkpoint(stage_fn) if remat else stage_fn
     ring = [(i, (i + 1) % S) for i in range(S)]
 

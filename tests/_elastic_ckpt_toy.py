@@ -1,0 +1,110 @@
+"""Elastic trainer toy for the elastic x checkpoint e2e test
+(tests/test_elastic.py): trains under the CURRENT elastic world size with
+a world-dependent hybrid layout, resumes from the distributed checkpoint
+(reshard-on-load) if one exists, saves one after its steps, and — in the
+pre-scale phase — idles so the external agent can trigger the scale event.
+
+Phase layouts differ on purpose: mp4 x sharding2 before the scale,
+mp2 x sharding4 after — both the mp-sharded weights AND the ZeRO-sharded
+optimizer slots must reshard on resume (SURVEY §5.3 <-> §5.4 loop).
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed import load_state_dict, save_state_dict  # noqa: E402
+from paddle_tpu.jit import TrainStep  # noqa: E402
+from paddle_tpu.optimizer import AdamW  # noqa: E402
+from paddle_tpu.parallel.fleet.mp import (ColumnParallelLinear,  # noqa: E402
+                                          RowParallelLinear)
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "."
+CKPT = os.path.join(OUT, "ckpt")
+# default 1 so the e2e test can IMPORT this module for MpMLP/oracle reuse
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+# the world size drives PHASE selection only: the second elastic "node"
+# in the test is a bare heartbeat agent with no trainer, so this single
+# trainer must not attempt a 2-process jax.distributed rendezvous
+for _k in ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID", "PADDLE_MASTER",
+           "PADDLE_TRAINER_ENDPOINTS"):
+    os.environ.pop(_k, None)
+STEPS_PER_PHASE = 2
+
+
+class MpMLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = ColumnParallelLinear(16, 32, gather_output=False)
+        self.down = RowParallelLinear(32, 16, input_is_parallel=True)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.down(F.relu(self.up(x)))
+
+
+def build_step():
+    degrees = ({"mp_degree": 4, "sharding_degree": 2} if WORLD == 1
+               else {"mp_degree": 2, "sharding_degree": 4})
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = degrees
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    paddle.seed(0)
+    model = MpMLP()
+    opt = AdamW(learning_rate=0.05, parameters=model.parameters())
+    step = TrainStep(model, lambda out, label: ((out - label) ** 2).mean(),
+                     opt, mesh=hcg.mesh, sharding_stage=2)
+    return step, degrees
+
+
+def flat_state(step):
+    tree = {"params": step.params, "opt": step.opt_state}
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(kp): Tensor(v) for kp, v in leaves}
+
+
+def main():
+    step, degrees = build_step()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+
+    start = 0
+    progress = os.path.join(OUT, "progress.json")
+    if os.path.exists(os.path.join(CKPT, "0.metadata.json")):
+        st = flat_state(step)
+        load_state_dict(st, CKPT)  # reshard-on-load into the NEW layout
+        tree = {"params": step.params, "opt": step.opt_state}
+        leaves_kp, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        new_tree = jax.tree_util.tree_unflatten(
+            treedef,
+            [st[jax.tree_util.keystr(kp)].value for kp, _ in leaves_kp])
+        step._params = new_tree["params"]
+        step._opt_state = new_tree["opt"]
+        start = json.load(open(progress))["step"]
+
+    losses = [float(step.step((x,), (y,)).value)
+              for _ in range(STEPS_PER_PHASE)]
+
+    save_state_dict(flat_state(step), CKPT)
+    json.dump({"step": start + STEPS_PER_PHASE}, open(progress, "w"))
+    json.dump({"start": start, "losses": losses, "world": WORLD,
+               "degrees": degrees},
+              open(os.path.join(OUT, f"phase.{WORLD}.json"), "w"))
+    if WORLD == 1:
+        time.sleep(120)  # idle until the scale event tears us down
+
+
+if __name__ == "__main__":
+    main()

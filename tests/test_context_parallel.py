@@ -172,7 +172,7 @@ class TestCPInsidePipeline:
     aborts XLA-CPU, so the parity check runs in a fresh child interpreter
     with the legacy partitioner (tests/_cp_pp_child.py)."""
 
-    def _run_child(self, cp):
+    def _run_child(self, cp, extra=()):
         import os
         import subprocess
         import sys
@@ -180,13 +180,24 @@ class TestCPInsidePipeline:
         env = dict(os.environ, PYTHONPATH=repo, PALLAS_AXON_POOL_IPS="")
         p = subprocess.run(
             [sys.executable, os.path.join(repo, "tests", "_cp_pp_child.py"),
-             cp],
+             cp, *extra],
             capture_output=True, text=True, timeout=420, env=env, cwd=repo)
         assert p.returncode == 0, p.stderr[-600:]
         assert "parity OK" in p.stdout
 
     def test_ring_cp_inside_pp2_matches_serial(self):
         self._run_child("ring")
+
+    @pytest.mark.xfail(
+        strict=True,
+        reason="Shardy cannot yet transpose nested partial-manual regions "
+               "(ring shard_map inside the pipeline's manual 'pp' region); "
+               "ring-in-pp training needs the legacy partitioner. STRICT: "
+               "the day a JAX upgrade makes this pass, this xfail FAILS the "
+               "suite so the llama.py warning + README constraint get "
+               "removed (VERDICT r3 item 7).")
+    def test_ring_cp_inside_pp_shardy_canary(self):
+        self._run_child("ring", extra=("--shardy",))
 
     def test_ulysses_inside_pp_rejected_with_guidance(self):
         """Ulysses' head-scatter all_to_all cannot partition inside a
