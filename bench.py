@@ -51,13 +51,26 @@ SELF_BENCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # config OOMs on the driver's chip: measured r3 on-chip, bhsd=0.3154 and
 # base=0.3113 MFU — both >= the r2 shipped number, so a total accum failure
 # cannot regress the headline below r2.
+# r4 additions: fuserope folds rotary into the flash kernels (prologue +
+# dq/dk adjoint — no rotated-q/k HBM round-trip) and the fbq/fbk variants
+# sweep the flash block sizes at the bench shapes (VERDICT r3 item 9);
+# both stack on the bhsd+hd128 no-remat accumulation winner lineage.
 CONFIGS = [
+    ("bhsd+hd128+noremat+accum4+chunk+fuserope",
+     {"attention_layout": "bhsd", "num_attention_heads": 8,
+      "num_key_value_heads": 8, "use_recompute": False, "loss_chunk": 512,
+      "fuse_rope": True, "_accum": 4}),
     ("hd128+noremat+accum4+chunk",
      {"num_attention_heads": 8, "num_key_value_heads": 8,
       "use_recompute": False, "loss_chunk": 512, "_accum": 4}),
     ("bhsd+hd128+noremat+accum4+chunk",
      {"attention_layout": "bhsd", "num_attention_heads": 8,
       "num_key_value_heads": 8, "use_recompute": False, "loss_chunk": 512,
+      "_accum": 4}),
+    ("bhsd+hd128+noremat+accum4+chunk+fuserope+fb512",
+     {"attention_layout": "bhsd", "num_attention_heads": 8,
+      "num_key_value_heads": 8, "use_recompute": False, "loss_chunk": 512,
+      "fuse_rope": True, "flash_block_q": 512, "flash_block_k": 512,
       "_accum": 4}),
     ("noremat+accum4+chunk",
      {"use_recompute": False, "loss_chunk": 512, "_accum": 4}),
@@ -166,6 +179,34 @@ def _measure_decode(max_new=256, B=8, prompt=128):
             "decode_tok_s": float(rep["decode_tokens_per_sec"]),
             "decode_mbu": float(rep.get("decode_mbu", 0.0)),
             "B": B, "prompt": prompt, "max_new": max_new}
+
+
+def main_trace(idx):
+    """Re-run ONE config for a few steps under jax.profiler and print the
+    top op-time sinks parsed from the XPlane trace — the on-chip profile
+    VERDICT r3 item 1 asks for, captured automatically at driver-bench
+    time (profiler/xplane.py, no TensorFlow dependency)."""
+    import tempfile
+
+    import jax
+
+    name, overrides = CONFIGS[idx]
+    d = tempfile.mkdtemp(prefix="bench_trace_")
+    # _measure_config warms its own executable for 3 steps before timing,
+    # so compile lands at the start of the trace and the timed steps are
+    # clean; a separate warm call would just rebuild + recompile
+    jax.profiler.start_trace(d)
+    r = _measure_config(name, overrides, iters=4)
+    jax.profiler.stop_trace()
+    from paddle_tpu.profiler.xplane import op_statistics
+    rows = op_statistics(d, device_only=True, top=12)
+    if not rows:  # CPU fallback: host plane carries the XLA ops
+        rows = op_statistics(d, device_only=False, top=12)
+    print(json.dumps({"name": name, "mfu": r["mfu"],
+                      "top_ops": [{"op": x["name"][:80],
+                                   "total_ms": round(x["total_ms"], 3),
+                                   "count": x["count"]} for x in rows]}))
+    return 0
 
 
 def main_7b_layer():
@@ -282,8 +323,15 @@ def watchdog():
     if rd is not None:
         decode = (f", decode {rd['decode_tok_s']:.0f} tok/s "
                   f"mbu={rd['decode_mbu']:.2f}")
+
+    # profile the winning config: top op-time sinks into the artifact
+    best_idx = next(i for i, (n, _) in enumerate(CONFIGS)
+                    if n == best["name"])
+    rc, out, err = _run([me, "--trace", str(best_idx)], CONFIG_TIMEOUT_S)
+    rt = _parse_result(rc, out)
     _flush_self_bench(results, extra={"best": best["name"],
-                                      "layer7b": r7, "decode": rd})
+                                      "layer7b": r7, "decode": rd,
+                                      "trace": rt})
 
     mfu = best["mfu"]
     print(json.dumps({
@@ -306,4 +354,6 @@ if __name__ == "__main__":
     if "--decode" in sys.argv:
         print(json.dumps(_measure_decode()))
         sys.exit(0)
+    if "--trace" in sys.argv:
+        sys.exit(main_trace(int(sys.argv[sys.argv.index("--trace") + 1])))
     sys.exit(watchdog())

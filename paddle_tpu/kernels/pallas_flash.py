@@ -40,9 +40,33 @@ def _row_valid(ref_block, idx, block, seq_len):
     return jnp.where(rows < seq_len, ref_block, jnp.zeros_like(ref_block))
 
 
+def _rope_block(x, sin, cos):
+    """Neox rope applied to a [block, D] tile in the kernel prologue —
+    fuses the reference's fused_rope_kernel.cu † into the attention reads
+    (no separate HBM round-trip for rotated q/k)."""
+    d = x.shape[-1]
+    rot = jnp.concatenate([-x[:, d // 2:], x[:, :d // 2]], axis=-1)
+    return (x * cos + rot * sin).astype(x.dtype)
+
+
+def _rope_t_block(y, sin, cos):
+    """Adjoint of _rope_block: rope(x) = c*x + s*R(x) with
+    R([x1,x2]) = [-x2,x1], so rope^T(y) = c*y + R^T(s*y) and
+    R^T([z1,z2]) = [z2,-z1]. Applied to dq/dk accumulators so the kernels
+    return gradients w.r.t. the PRE-rope projections."""
+    d = y.shape[-1]
+    z = y * sin
+    rot_t = jnp.concatenate([z[:, d // 2:], -z[:, :d // 2]], axis=-1)
+    return y * cos + rot_t
+
+
 # ----------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_q, block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+                seq_len, rope=False):
+    if rope:
+        sq_ref, cq_ref, sk_ref, ck_ref = rest[:4]
+        rest = rest[4:]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -62,6 +86,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     def _compute():
         q = q_ref[0]
         k = k_ref[0]
+        if rope:
+            q = _rope_block(q, sq_ref[...], cq_ref[...])
+            k = _rope_block(k, sk_ref[...], ck_ref[...])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal or tail:
@@ -98,20 +125,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _rope_specs(block_q, block_k, D):
+    """BlockSpecs for (sin_q, cos_q, sin_k, cos_k) over [S, D] tables."""
+    return [
+        pl.BlockSpec((block_q, D), lambda b, qi, ki: (qi, 0)),
+        pl.BlockSpec((block_q, D), lambda b, qi, ki: (qi, 0)),
+        pl.BlockSpec((block_k, D), lambda b, qi, ki: (ki, 0)),
+        pl.BlockSpec((block_k, D), lambda b, qi, ki: (ki, 0)),
+    ]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               rope=None):
     BH, S, D = q.shape
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(S, block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, seq_len=S)
+                               block_q=block_q, block_k=block_k, seq_len=S,
+                               rope=rope is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    args = [q, k, v]
+    if rope is not None:
+        sin, cos = rope
+        in_specs += _rope_specs(block_q, block_k, D)
+        args += [sin, cos, sin, cos]
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -127,14 +172,17 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_cparams(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
 # ----------------------------------------------------------------- backward
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
-                block_k, seq_len):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, block_q, block_k, seq_len, rope=False):
+    if rope:
+        sq_ref, cq_ref, sk_ref, ck_ref = rest[:4]
+        rest = rest[4:]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -157,6 +205,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if rope:
+            q = _rope_block(q, sq_ref[...], cq_ref[...])
+            k = _rope_block(k, sk_ref[...], ck_ref[...])
         if tail:  # padded q rows are undefined and sum into every dk/dv row
             q = _row_valid(q, qi, block_q, seq_len)
             do = _row_valid(do, qi, block_q, seq_len)
@@ -185,12 +236,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dk = dk_scr[:]
+        if rope:  # gradient w.r.t. the PRE-rope k projection
+            dk = _rope_t_block(dk, sk_ref[...], ck_ref[...])
+        dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k, seq_len):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, block_q, block_k, seq_len, rope=False):
+    if rope:
+        sq_ref, cq_ref, sk_ref, ck_ref = rest[:4]
+        rest = rest[4:]
+    dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -212,6 +270,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if rope:
+            q = _rope_block(q, sq_ref[...], cq_ref[...])
+            k = _rope_block(k, sk_ref[...], ck_ref[...])
         if tail:  # padded k/v rows are undefined and sum into every dq row
             k = _row_valid(k, ki, block_k, seq_len)
             v = _row_valid(v, ki, block_k, seq_len)
@@ -237,10 +298,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq = dq_scr[:]
+        if rope:  # gradient w.r.t. the PRE-rope q projection
+            dq = _rope_t_block(dq, sq_ref[...], cq_ref[...])
+        dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
+               rope=None):
     q, k, v, o, lse = res
     do = g
     BH, S, D = q.shape
@@ -249,9 +314,25 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(S, block_k)
 
+    base_args = [q, k, v, do, lse, delta]
+    rope_args = []
+    if rope is not None:
+        sin, cos = rope
+        rope_args = [sin, cos, sin, cos]
+
+    # NOTE the dkv grid is (b, ki, qi): its rope specs swap the index args
+    def dkv_rope_specs():
+        return [
+            pl.BlockSpec((block_q, D), lambda b, ki, qi: (qi, 0)),
+            pl.BlockSpec((block_q, D), lambda b, ki, qi: (qi, 0)),
+            pl.BlockSpec((block_k, D), lambda b, ki, qi: (ki, 0)),
+            pl.BlockSpec((block_k, D), lambda b, ki, qi: (ki, 0)),
+        ]
+
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=S),
+                          block_q=block_q, block_k=block_k, seq_len=S,
+                          rope=rope is not None),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
@@ -260,7 +341,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
-        ],
+        ] + (dkv_rope_specs() if rope is not None else []),
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
@@ -275,12 +356,13 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_cparams(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*base_args, *rope_args)
     dk, dv = dkv
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=S),
+                          block_q=block_q, block_k=block_k, seq_len=S,
+                          rope=rope is not None),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
@@ -289,13 +371,13 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
-        ],
+        ] + (_rope_specs(block_q, block_k, D) if rope is not None else []),
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_cparams(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*base_args, *rope_args)
     return dq, dk, dv
 
 
@@ -319,6 +401,32 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, g):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# rope-fused variant: q/k rotate inside the kernels (prologue on reads,
+# adjoint on dq/dk) — no separate rope HBM round-trip. sin/cos cotangents
+# are reported as zero: the tables are position constants, never trained.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_rope(q, k, v, sin, cos, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                      _interpret_mode(), rope=(sin, cos))
+    return o
+
+
+def _flash_rope_fwd_rule(q, k, v, sin, cos, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        _interpret_mode(), rope=(sin, cos))
+    return o, (q, k, v, o, lse, sin, cos)
+
+
+def _flash_rope_bwd_rule(scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse, sin, cos = res
+    dq, dk, dv = _flash_bwd((q, k, v, o, lse), g, scale, causal, block_q,
+                            block_k, _interpret_mode(), rope=(sin, cos))
+    return dq, dk, dv, jnp.zeros_like(sin), jnp.zeros_like(cos)
+
+
+_flash_rope.defvjp(_flash_rope_fwd_rule, _flash_rope_bwd_rule)
 
 _FORCE_INTERPRET = [False]
 
@@ -349,13 +457,25 @@ def flash_attention_pallas(q, k, v, causal=True, block_q=1024, block_k=1024):
     return jnp.swapaxes(o.reshape(B, H, S, D), 1, 2)
 
 
-def flash_attention_bhsd(q, k, v, causal=True, block_q=1024, block_k=1024):
+def flash_attention_bhsd(q, k, v, causal=True, block_q=1024, block_k=1024,
+                         rope=None):
     """Transpose-free entry: q/k/v are [BH, S, D] (heads folded into batch).
     Use this from models that emit head-major projections — the head
     transpose then folds into the projection matmul epilogue instead of a
-    separate HBM pass."""
+    separate HBM pass.
+
+    ``rope=(sin, cos)`` ([S, D] f32 tables) applies neox rotary embedding
+    to q/k INSIDE the kernels (prologue + dq/dk adjoint) — the fusion of
+    the reference's ``fused_rope_kernel.cu`` † into attention, eliminating
+    the rotated q/k HBM round-trip."""
     BH, S, D = q.shape
     scale = 1.0 / math.sqrt(D)
     bq = min(block_q, S)
     bk = min(block_k, S)
+    if rope is not None:
+        sin, cos = rope
+        sin = jnp.asarray(sin, jnp.float32)
+        cos = jnp.asarray(cos, jnp.float32)
+        assert sin.shape == (S, D) and cos.shape == (S, D), (sin.shape, S, D)
+        return _flash_rope(q, k, v, sin, cos, scale, bool(causal), bq, bk)
     return _flash(q, k, v, scale, bool(causal), bq, bk)

@@ -85,6 +85,13 @@ class LlamaConfig:
     # in-kernel, kv blocks past the current position skipped); "jnp" keeps
     # the masked-softmax-over-S_max path.
     decode_attention: str = "pallas"
+    # apply rotary embedding INSIDE the flash kernels (prologue + dq/dk
+    # adjoint — the reference's fused_rope_kernel.cu fusion): no rotated
+    # q/k HBM round-trip. Takes effect on the bhsd layout's Pallas path.
+    fuse_rope: bool = False
+    # Pallas flash block sizes (bench sweep lever; 0 = kernel default)
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     dtype: str = "float32"
 
     @property
@@ -158,8 +165,11 @@ def _apply_rope_bhsd(x, sin, cos):
     return (x * cos[None, None, :, :] + rotated * sin[None, None, :, :]).astype(x.dtype)
 
 
-def _attention_bhsd(q, k, v, nh):
-    """[B, H, S, D] attention: Pallas flash on TPU, jnp reference elsewhere."""
+def _attention_bhsd(q, k, v, nh, rope=None, block_q=0, block_k=0):
+    """[B, H, S, D] attention: Pallas flash on TPU, jnp reference elsewhere.
+
+    ``rope=(sin, cos)`` means q/k arrive UN-rotated and rotation happens
+    inside the Pallas kernels (or is applied here on the fallback path)."""
     B, Hq, S, D = q.shape
     Hk = k.shape[1]
     if Hk != Hq:
@@ -169,10 +179,20 @@ def _attention_bhsd(q, k, v, nh):
     from ..kernels.flash_attention import _use_pallas
     if _use_pallas(S) and S % 128 == 0 and D % 8 == 0:
         from ..kernels.pallas_flash import flash_attention_bhsd
+        kw = {}
+        if block_q:
+            kw["block_q"] = block_q
+        if block_k:
+            kw["block_k"] = block_k
         o = flash_attention_bhsd(q.reshape(B * Hq, S, D),
                                  k.reshape(B * Hq, S, D),
-                                 v.reshape(B * Hq, S, D), causal=True)
+                                 v.reshape(B * Hq, S, D), causal=True,
+                                 rope=rope, **kw)
         return o.reshape(B, Hq, S, D)
+    if rope is not None:  # fallback path rotates explicitly
+        sin, cos = rope
+        q = _apply_rope_bhsd(q, sin, cos)
+        k = _apply_rope_bhsd(k, sin, cos)
     import math as _m
     scale = 1.0 / _m.sqrt(D)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -268,7 +288,9 @@ class LlamaForCausalLM(nn.Layer):
             pipeline_virtual_stages=int(c.pipeline_virtual_stages),
             context_parallel=str(c.context_parallel),
             attention_layout=str(c.attention_layout),
-            loss_chunk=int(c.loss_chunk), **params)
+            loss_chunk=int(c.loss_chunk), fuse_rope=bool(c.fuse_rope),
+            flash_block_q=int(c.flash_block_q),
+            flash_block_k=int(c.flash_block_k), **params)
         return out
 
     def num_params(self):
@@ -280,7 +302,8 @@ class LlamaForCausalLM(nn.Layer):
 def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                    policy="full", pipeline_microbatches=0,
                    pipeline_virtual_stages=1, context_parallel="",
-                   attention_layout="bshd", loss_chunk=0,
+                   attention_layout="bshd", loss_chunk=0, fuse_rope=False,
+                   flash_block_q=0, flash_block_k=0,
                    *, embed, wq, wk, wv, wo, w_gate, w_up, w_down, input_ln,
                    post_ln, final_norm, lm_head):
     B, S = input_ids.shape
@@ -310,8 +333,10 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
             q = jnp.einsum("bsh,hnd->bnsd", hn, lwq.reshape(H_, nh, hd))
             k = jnp.einsum("bsh,hnd->bnsd", hn, lwk.reshape(H_, nkv, hd))
             v = jnp.einsum("bsh,hnd->bnsd", hn, lwv.reshape(H_, nkv, hd))
-            q = _apply_rope_bhsd(q, sin, cos)
-            k = _apply_rope_bhsd(k, sin, cos)
+            defer_rope = fuse_rope and not use_cp
+            if not defer_rope:
+                q = _apply_rope_bhsd(q, sin, cos)
+                k = _apply_rope_bhsd(k, sin, cos)
             q = _ann(q, batch_spec, "mp", None, None)
             k = _ann(k, batch_spec, "mp", None, None)
         else:
@@ -339,7 +364,10 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                           jnp.swapaxes(vr, 1, 2), causal=True, mesh=mesh),
                     1, 2)
         elif head_major:
-            attn = _attention_bhsd(q, k, v, nh)
+            attn = _attention_bhsd(
+                q, k, v, nh,
+                rope=(sin, cos) if defer_rope else None,
+                block_q=flash_block_q, block_k=flash_block_k)
         else:
             attn = _attention(q, k, v, causal=True)
         if head_major:

@@ -135,10 +135,14 @@ def _install_tensor_methods():
     Tensor.__getitem__ = lambda s, idx: getitem(s, idx)
 
     def _setitem_inplace(s, idx, value):
+        from .inplace import graph_alias
         from .manipulation import _setitem
         idx = _coerce_index(idx)
         v = value.value if isinstance(value, Tensor) else value
-        out = _setitem(s, idx, v)
+        # record a shadow of the pre-write tensor in the graph: recording
+        # `s` itself would make the setitem node its own input after the
+        # rebind below (grad path to s's producers severed)
+        out = _setitem(graph_alias(s), idx, v)
         s._value = out.value
         s._grad_node = out._grad_node
         s._out_index = out._out_index
@@ -161,3 +165,8 @@ from .math import (bitwise_and, bitwise_not, bitwise_or, bitwise_xor, lerp)  # n
 from .extra import *  # noqa: E402,F401,F403
 
 _install_tensor_methods()
+
+# inplace (*_) variants + the r4 long tail — installed AFTER the method
+# table so their Tensor bindings see the functional ops in place
+from .inplace import *  # noqa: E402,F401,F403
+from .tail import *  # noqa: E402,F401,F403

@@ -1,0 +1,171 @@
+"""Inplace op variants (reference: the ``*_``-suffixed APIs generated in
+``python/paddle/tensor/`` † — paddle-idiomatic mutation like ``x.add_(y)``,
+``x.scatter_(idx, v)``, ``x.uniform_()``).
+
+jax arrays are immutable, so "inplace" here is the Tensor wrapper REBIND:
+the functional op runs, and the receiver's underlying value / grad node
+are swapped to the result's — same observable semantics as the reference
+(the Python-visible object mutates, autograd keeps flowing), XLA still
+sees pure SSA ops. This is the identical mechanism ``Tensor.__setitem__``
+already uses.
+
+Every variant is exposed both as a ``paddle.<name>_`` function (mutating
+its first argument) and a ``Tensor.<name>_`` method, and is entered in
+OP_REGISTRY like any other op.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..core.tensor import Tensor
+from ._op import OP_REGISTRY
+
+__all__ = []
+
+
+def _rebind(dst: Tensor, out: Tensor) -> Tensor:
+    dst._value = out.value
+    dst._grad_node = out._grad_node
+    dst._out_index = out._out_index
+    dst.stop_gradient = dst.stop_gradient and out.stop_gradient
+    return dst
+
+
+def graph_alias(x: Tensor) -> Tensor:
+    """A distinct Tensor object carrying ``x``'s CURRENT value and grad
+    history. The inplace op must record THIS as its autograd input: after
+    the rebind, ``x._grad_node`` is the op's own node, so recording ``x``
+    itself would make the node its own input (a cycle) and sever the path
+    to ``x``'s producers."""
+    shadow = Tensor(x.value, stop_gradient=x.stop_gradient)
+    shadow._grad_node = x._grad_node
+    shadow._out_index = x._out_index
+    return shadow
+
+
+def _inplace_of(fn, name):
+    @functools.wraps(fn)
+    def inplace(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            raise TypeError(f"{name} mutates a Tensor, got "
+                            f"{type(x).__name__}")
+        return _rebind(x, fn(graph_alias(x), *args, **kwargs))
+
+    inplace.__name__ = name
+    inplace.__qualname__ = name
+    inplace.__doc__ = (f"Inplace variant of ``{fn.__name__}``: rebinds "
+                       f"``x`` to the result and returns it.")
+    return inplace
+
+
+def _install():
+    from . import extra, manipulation, math
+    from .math import clip as _clip
+
+    sources = {
+        # elementwise math
+        "add_": math.add, "subtract_": math.subtract,
+        "multiply_": math.multiply, "divide_": math.divide,
+        "remainder_": math.remainder, "mod_": math.mod,
+        "floor_divide_": math.floor_divide, "pow_": math.pow,
+        "clip_": _clip, "scale_": math.scale, "exp_": math.exp,
+        "sqrt_": math.sqrt, "rsqrt_": math.rsqrt,
+        "reciprocal_": math.reciprocal, "round_": math.round,
+        "floor_": math.floor, "ceil_": math.ceil, "abs_": math.abs,
+        "neg_": math.neg, "trunc_": math.trunc, "frac_": math.frac,
+        "erfinv_": math.erfinv, "lerp_": math.lerp, "logit_": math.logit,
+        "tanh_": math.tanh, "sigmoid_": math.sigmoid,
+        "nan_to_num_": math.nan_to_num,
+        # shape
+        "squeeze_": manipulation.squeeze,
+        "unsqueeze_": manipulation.unsqueeze,
+        "reshape_": manipulation.reshape,
+        "flatten_": manipulation.flatten,
+        "transpose_": manipulation.transpose,
+        "t_": manipulation.t,
+        # indexed writes
+        "scatter_": manipulation.scatter,
+        "masked_fill_": manipulation.masked_fill,
+        "index_add_": manipulation.index_add,
+        "index_put_": manipulation.index_put,
+        "index_fill_": extra.index_fill,
+        "masked_scatter_": extra.masked_scatter,
+        "put_along_axis_": manipulation.put_along_axis,
+        "renorm_": extra.renorm,
+    }
+    import sys
+    mod = sys.modules[__name__]
+    for name, fn in sources.items():
+        ip = _inplace_of(fn, name)
+        setattr(mod, name, ip)
+        __all__.append(name)
+        OP_REGISTRY.setdefault(name, ip)
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, ip)
+
+
+_install()
+
+
+# ------------------------- random refills (reference x.uniform_() etc.) --
+def _random_refill(name, sample):
+    def refill(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            raise TypeError(f"{name} mutates a Tensor, got "
+                            f"{type(x).__name__}")
+        out = sample(x, *args, **kwargs)
+        x._value = out.value if isinstance(out, Tensor) else out
+        x._grad_node = None
+        x._out_index = None
+        return x
+
+    refill.__name__ = refill.__qualname__ = name
+    __all__.append(name)
+    OP_REGISTRY.setdefault(name, refill)
+    if not hasattr(Tensor, name):
+        setattr(Tensor, name, refill)
+    return refill
+
+
+def _uniform(x, min=-1.0, max=1.0, seed=0, name=None):
+    from .creation import uniform as u
+    return u(shape=x.shape, dtype=x.dtype, min=min, max=max)
+
+
+def _normal(x, mean=0.0, std=1.0, name=None):
+    from .creation import normal as nrm
+    return nrm(mean=mean, std=std, shape=x.shape)
+
+
+uniform_ = _random_refill("uniform_", _uniform)
+normal_ = _random_refill("normal_", _normal)
+
+
+def _exponential_sample(x, lam=1.0, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+    u = jax.random.uniform(random_mod.next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0)
+    return Tensor((-jnp.log(u) / lam).astype(x.dtype))
+
+
+exponential_ = _random_refill("exponential_", _exponential_sample)
+
+
+def _install_fill_diagonal():
+    # differentiable inplace (unlike the random refills, grads must keep
+    # flowing through the untouched entries — paddle has a grad kernel
+    # for fill_diagonal_)
+    from .tail import fill_diagonal
+    ip = _inplace_of(fill_diagonal, "fill_diagonal_")
+    import sys
+    setattr(sys.modules[__name__], "fill_diagonal_", ip)
+    __all__.append("fill_diagonal_")
+    OP_REGISTRY.setdefault("fill_diagonal_", ip)
+    if not hasattr(Tensor, "fill_diagonal_"):
+        Tensor.fill_diagonal_ = ip
+
+
+_install_fill_diagonal()

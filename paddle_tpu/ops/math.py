@@ -263,14 +263,9 @@ def cumprod(x, dim=None, dtype=None):
     return jnp.cumprod(x, axis=_axis(dim), dtype=dtype_mod.to_jax_dtype(dtype))
 
 
-@tensor_op
-def cummax(x, axis=-1):
-    return jax.lax.cummax(x, axis=axis)
-
-
-@tensor_op
-def cummin(x, axis=-1):
-    return jax.lax.cummin(x, axis=axis)
+# NOTE: paddle.cummax/cummin (the (values, indices) pair APIs) live in
+# ops/tail.py; the bare cumulative jax.lax forms were removed so import
+# order cannot decide which contract wins (ADVICE-style shadowing).
 
 
 @tensor_op(differentiable=False)

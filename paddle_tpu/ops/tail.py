@@ -1,0 +1,361 @@
+"""Round-4 long-tail tensor ops (reference: remaining surface of
+``python/paddle/tensor/{math,manipulation,stat,creation,search}.py`` † —
+paddle-matching signatures, one-expression jnp/lax bodies so XLA fuses
+them like every other framework op)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import tensor_op
+
+__all__ = [
+    # stacking / splitting families
+    "hstack", "vstack", "dstack", "row_stack", "column_stack",
+    "atleast_1d", "atleast_2d", "atleast_3d", "block_diag",
+    # diagonal / windows (diagflat lives in ops/creation.py)
+    "diagonal_scatter", "slice_scatter", "as_strided",
+    "unfold", "view", "fill_diagonal",
+    # cumulative / extremes
+    "cummax", "cummin",
+    # scalar math tail
+    "bitwise_left_shift", "bitwise_right_shift", "gammaln", "gammainc",
+    "gammaincc", "multigammaln", "isreal", "positive", "negative",
+    "logaddexp2", "erfc", "xlogy", "sinc_pi", "cosine_similarity_flat",
+    "cumulative_trapezoid", "histogramdd", "histogram_bin_edges",
+    # misc paddle base ops
+    "increment", "clip_by_norm", "crop", "moveaxis_single", "rot90_k",
+    "flip_lr", "flip_ud", "take_diag", "trace_offset", "count_unique",
+]
+
+
+# ------------------------------------------------- stacking / splitting
+@tensor_op
+def hstack(x, name=None):
+    return jnp.hstack(x)
+
+
+@tensor_op
+def vstack(x, name=None):
+    return jnp.vstack(x)
+
+
+@tensor_op
+def dstack(x, name=None):
+    return jnp.dstack(x)
+
+
+@tensor_op
+def row_stack(x, name=None):
+    return jnp.vstack(x)
+
+
+@tensor_op
+def column_stack(x, name=None):
+    return jnp.column_stack(x)
+
+
+@tensor_op
+def atleast_1d(*xs, name=None):
+    out = jnp.atleast_1d(*xs)
+    return out
+
+
+@tensor_op
+def atleast_2d(*xs, name=None):
+    return jnp.atleast_2d(*xs)
+
+
+@tensor_op
+def atleast_3d(*xs, name=None):
+    return jnp.atleast_3d(*xs)
+
+
+@tensor_op
+def block_diag(inputs, name=None):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+# ------------------------------------------------- diagonal / windows
+@tensor_op
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Functional fill_diagonal (paddle semantics): 2-D fills the
+    (wrapped) diagonal; >2-D requires all dims equal and fills the
+    all-indices-equal positions. Differentiable w.r.t. x (diagonal
+    entries' cotangent is zeroed by the select)."""
+    if x.ndim < 2:
+        raise ValueError("fill_diagonal needs >= 2 dims")
+    v = jnp.asarray(value, x.dtype)
+    if x.ndim == 2:
+        nr, nc = x.shape
+        n = nr * nc
+        step = nc + 1
+        start = offset if offset >= 0 else (-offset) * nc
+        d = max(min(nr - max(-offset, 0), nc - max(offset, 0)), 0)
+        stop = n if wrap else min(n, start + d * step)
+        flat_idx = jnp.arange(start, stop, step)
+        mask = jnp.zeros((n,), bool).at[flat_idx].set(True).reshape(nr, nc)
+        return jnp.where(mask, v, x)
+    if len(set(x.shape)) != 1:
+        raise ValueError("fill_diagonal on >2-D needs equal dims")
+    n = x.shape[0]
+    idx = (jnp.arange(n),) * x.ndim
+    mask = jnp.zeros(x.shape, bool).at[idx].set(True)
+    return jnp.where(mask, v, x)
+
+
+@tensor_op
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    n1, n2 = x.shape[axis1], x.shape[axis2]
+    k = min(n1 + min(offset, 0), n2 - max(offset, 0))  # diagonal length
+    rows = jnp.arange(k) - min(offset, 0)
+    cols = jnp.arange(k) + max(offset, 0)
+    xm = jnp.moveaxis(jnp.moveaxis(x, axis1, 0), axis2, 1)
+    ym = jnp.moveaxis(y, -1, 0) if y.ndim == xm.ndim - 1 else y
+    out = xm.at[rows, cols].set(ym)
+    return jnp.moveaxis(jnp.moveaxis(out, 1, axis2), 0, axis1)
+
+
+@tensor_op
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+@tensor_op
+def as_strided(x, shape, stride, offset=0, name=None):
+    flat = x.reshape(-1)
+    idx = jnp.full((), offset)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    lin = sum(g * s for g, s in zip(grids, stride)) + offset
+    return flat[lin.reshape(shape)]
+
+
+@tensor_op
+def unfold(x, axis, size, step, name=None):
+    n = x.shape[axis]
+    starts = jnp.arange(0, n - size + 1, step)
+    xm = jnp.moveaxis(x, axis, 0)
+    win = jax.vmap(
+        lambda s: jax.lax.dynamic_slice_in_dim(xm, s, size, 0))(starts)
+    # windows become the trailing dim (paddle/torch unfold contract)
+    win = jnp.moveaxis(win, 1, -1)            # [n_win, ..., size]
+    return jnp.moveaxis(win, 0, axis)
+
+
+def view(x, shape_or_dtype, name=None):
+    from .manipulation import cast, reshape
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+# ------------------------------------------------- cumulative extremes
+def _cum_extreme(x, axis, combine, dtype):
+    from ..core import dtype as dtype_mod
+    xi = x.reshape(-1) if axis is None else x
+    ax = 0 if axis is None else axis
+    values = jax.lax.associative_scan(combine, xi, axis=ax)
+    n = xi.shape[ax]
+    ar = jnp.arange(n).reshape([-1 if i == (ax % xi.ndim) else 1
+                                for i in range(xi.ndim)])
+    hit = jnp.where(xi == values, ar, -1)
+    indices = jax.lax.associative_scan(jnp.maximum, hit, axis=ax)
+    # honor the requested index dtype (int64 canonicalizes to int32 with
+    # x64 disabled — the environment-wide jax rule, not this op's)
+    return values, indices.astype(dtype_mod.to_jax_dtype(dtype))
+
+
+@tensor_op
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, jnp.maximum, dtype)
+
+
+@tensor_op
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, jnp.minimum, dtype)
+
+
+# ------------------------------------------------- scalar math tail
+@tensor_op(differentiable=False)
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return jnp.left_shift(x, y)
+
+
+@tensor_op(differentiable=False)
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return (jnp.right_shift(x, y) if is_arithmetic
+            else jnp.right_shift(x.view(jnp.uint32) if x.dtype == jnp.int32
+                                 else x, y))
+
+
+@tensor_op
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@tensor_op
+def gammainc(x, y, name=None):
+    return jax.scipy.special.gammainc(x, y)
+
+
+@tensor_op
+def gammaincc(x, y, name=None):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@tensor_op
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@tensor_op(differentiable=False)
+def isreal(x, name=None):
+    if jnp.iscomplexobj(x):
+        return jnp.imag(x) == 0
+    return jnp.ones(x.shape, bool)
+
+
+@tensor_op
+def positive(x, name=None):
+    return +x
+
+
+@tensor_op
+def negative(x, name=None):
+    return -x
+
+
+@tensor_op
+def logaddexp2(x, y, name=None):
+    return jnp.logaddexp2(x, y)
+
+
+@tensor_op
+def erfc(x, name=None):
+    return jax.scipy.special.erfc(x)
+
+
+@tensor_op
+def xlogy(x, y, name=None):
+    return jax.scipy.special.xlogy(x, y)
+
+
+@tensor_op
+def sinc_pi(x, name=None):
+    return jnp.sinc(x)
+
+
+@tensor_op
+def cosine_similarity_flat(x, y, eps=1e-8, name=None):
+    nx = jnp.maximum(jnp.linalg.norm(x, axis=-1), eps)
+    ny = jnp.maximum(jnp.linalg.norm(y, axis=-1), eps)
+    return jnp.sum(x * y, axis=-1) / (nx * ny)
+
+
+@tensor_op
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    ym = jnp.moveaxis(y, axis, -1)
+    mids = (ym[..., 1:] + ym[..., :-1]) / 2.0
+    if x is not None:
+        xs = jnp.moveaxis(x, axis, -1) if x.ndim == y.ndim else x
+        d = jnp.diff(xs, axis=-1)
+    else:
+        d = dx
+    return jnp.moveaxis(jnp.cumsum(mids * d, axis=-1), -1, axis)
+
+
+@tensor_op(differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return (h,) + tuple(edges)
+
+
+@tensor_op(differentiable=False)
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=rng)
+
+
+# ------------------------------------------------- misc paddle base ops
+@tensor_op
+def increment(x, value=1.0, name=None):
+    return x + jnp.asarray(value, x.dtype)
+
+
+@tensor_op
+def clip_by_norm(x, max_norm, name=None):
+    n = jnp.linalg.norm(x.reshape(-1))
+    return jnp.where(n > max_norm, x * (max_norm / jnp.maximum(n, 1e-12)), x)
+
+
+@tensor_op
+def crop(x, shape=None, offsets=None, name=None):
+    shape = list(shape if shape is not None else x.shape)
+    offsets = list(offsets if offsets is not None else [0] * x.ndim)
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@tensor_op
+def moveaxis_single(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@tensor_op
+def rot90_k(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@tensor_op
+def flip_lr(x, name=None):
+    return jnp.fliplr(x)
+
+
+@tensor_op
+def flip_ud(x, name=None):
+    return jnp.flipud(x)
+
+
+@tensor_op
+def take_diag(x, offset=0, name=None):
+    return jnp.diag(x, k=offset)
+
+
+@tensor_op
+def trace_offset(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@tensor_op(differentiable=False)
+def count_unique(x, name=None):
+    _, counts = jnp.unique(x, return_counts=True, size=x.size)
+    return jnp.sum(counts > 0)
+
+
+@tensor_op(differentiable=False)
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+@tensor_op
+def vecdot(x, y, axis=-1, name=None):
+    return jnp.sum(x * y, axis=axis)
+
+
+@tensor_op
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(x)
+
+
+def floor_mod(x, y, name=None):
+    from .math import remainder
+    return remainder(x, y)
+
+
+__all__ += ["isin", "vecdot", "matrix_exp", "floor_mod"]
