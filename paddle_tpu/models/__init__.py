@@ -13,4 +13,6 @@ from .llama import (LlamaConfig, LlamaForCausalLM, llama_7b, llama_13b,
                     llama_tiny)
 from .gpt import GPTConfig, GPTForCausalLM, gpt3_345m, gpt_tiny
 from .ernie_vil import ErnieViLConfig, ErnieViLModel, ernie_vil_base, ernie_vil_tiny
+from .ernie import (ErnieConfig, ErnieModel, ErnieForMaskedLM,
+                    ErnieForSequenceClassification, ernie_tiny)
 from .moe_gpt import MoEGPTConfig, MoEGPTForCausalLM, gshard_moe_8x, moe_tiny
