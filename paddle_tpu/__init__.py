@@ -63,6 +63,31 @@ from .nn.parallel import DataParallel  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
 from . import version  # noqa: E402
 
+
+def finfo(dtype):
+    """Float type info (reference ``paddle.finfo``): min/max/eps/tiny/
+    bits/dtype over the jax-canonicalized type. ml_dtypes (bfloat16,
+    float8_*) carry their own finfo, which numpy's rejects."""
+    import ml_dtypes as _ml
+    import numpy as _np
+
+    from .core import dtype as _dt
+    d = _np.dtype(_dt.to_jax_dtype(dtype))
+    try:
+        return _np.finfo(d)
+    except ValueError:
+        return _ml.finfo(d)
+
+
+def iinfo(dtype):
+    """Integer type info (reference ``paddle.iinfo``). NOTE: with x64
+    disabled, int64 canonicalizes to int32 — the returned bounds reflect
+    the type arithmetic actually runs in."""
+    import numpy as _np
+
+    from .core import dtype as _dt
+    return _np.iinfo(_np.dtype(_dt.to_jax_dtype(dtype)))
+
 __version__ = version.full_version
 
 

@@ -242,11 +242,23 @@ class Tensor:
     def cpu(self):
         return self
 
+    def cuda(self, device_id=None, blocking=True):
+        # reference API parity: placement is XLA's job on TPU; the method
+        # exists so ported scripts run, returning the same (device) tensor
+        return self
+
     def pin_memory(self):
         return self
 
     def contiguous(self):
         return self
+
+    def element_size(self):
+        return int(np.dtype(self._value.dtype).itemsize)
+
+    @property
+    def nbytes(self):
+        return self.element_size() * (self.size if self.size != -1 else 0)
 
     def block_until_ready(self):
         if hasattr(self._value, "block_until_ready"):

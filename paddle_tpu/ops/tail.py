@@ -359,3 +359,79 @@ def floor_mod(x, y, name=None):
 
 
 __all__ += ["isin", "vecdot", "matrix_exp", "floor_mod"]
+
+
+# ------------------------------------------------- paddle-base leftovers
+@tensor_op
+def exp2(x, name=None):
+    return jnp.exp2(x)
+
+
+@tensor_op
+def cartesian_prod(x, name=None):
+    if len(x) == 1:  # reference: a single input comes back 1-D unchanged
+        return x[0]
+    grids = jnp.meshgrid(*x, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@tensor_op
+def nanmin(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmin(x, axis=axis, keepdims=keepdim)
+
+
+@tensor_op
+def nanmax(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmax(x, axis=axis, keepdims=keepdim)
+
+
+@tensor_op
+def logdet(x, name=None):
+    # sign==0 (singular) -> -inf like the torch/paddle oracle; only a
+    # NEGATIVE determinant is undefined (nan)
+    sign, ld = jnp.linalg.slogdet(x)
+    return jnp.where(sign > 0, ld,
+                     jnp.where(sign == 0, -jnp.inf, jnp.nan))
+
+
+@tensor_op
+def vdot(x, y, name=None):
+    return jnp.vdot(x, y)
+
+
+@tensor_op
+def ravel(x, name=None):
+    return x.reshape(-1)
+
+
+def one_hot(x, num_classes, name=None):
+    # single implementation: nn.functional.one_hot (default-dtype aware)
+    from ..nn.functional import one_hot as f_one_hot
+    return f_one_hot(x, num_classes)
+
+
+@tensor_op
+def chain_matmul(matrices, name=None):
+    return jnp.linalg.multi_dot(matrices)
+
+
+@tensor_op(differentiable=False)
+def unique_with_counts(x, name=None):
+    # reference 3-tuple with EXACT shapes: data-dependent -> eager-only,
+    # same contract as ops.math.unique (host-synchronizing op)
+    import numpy as np
+    vals, inv, counts = np.unique(np.asarray(x).reshape(-1),
+                                  return_inverse=True, return_counts=True)
+    return (jnp.asarray(vals), jnp.asarray(inv, jnp.int32),
+            jnp.asarray(counts, jnp.int32))
+
+
+from ._op import OP_REGISTRY as _REG
+from .math import bitwise_not as bitwise_invert  # alias, one implementation
+
+_REG.setdefault("bitwise_invert", bitwise_invert)
+_REG.setdefault("one_hot", one_hot)
+
+__all__ += ["exp2", "cartesian_prod", "nanmin", "nanmax", "logdet",
+            "vdot", "bitwise_invert", "ravel", "one_hot", "chain_matmul",
+            "unique_with_counts"]
