@@ -3,11 +3,13 @@
 lives in ``paddle_tpu/parallel`` per this repo's layout)."""
 from ..parallel import *  # noqa: F401,F403
 from ..parallel import (DataParallel, Group, ParallelEnv, ReduceOp, all_gather,
-                        all_reduce, alltoall, barrier, broadcast,
+                        all_gather_object, all_reduce, alltoall, barrier,
+                        broadcast, broadcast_object_list,
+                        destroy_process_group, gather,
                         get_rank, get_world_size, init_parallel_env,
                         is_initialized, new_group, recv, reduce,
-                        reduce_scatter, scatter, send, spawn,
-                        batch_isend_irecv, irecv, isend, P2POp,
+                        reduce_scatter, scatter, scatter_object_list, send,
+                        spawn, wait, batch_isend_irecv, irecv, isend, P2POp,
                         load_state_dict, save_state_dict,
                         group_sharded_parallel, save_group_sharded_model)
 from . import fleet

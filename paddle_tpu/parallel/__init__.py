@@ -16,9 +16,12 @@ from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
                   is_initialized)
 from .mesh import Group, build_mesh, ensure_mesh, get_mesh, new_group, set_mesh
 from .communication import (ReduceOp, all_gather, all_reduce, alltoall,
-                            barrier, batch_isend_irecv, broadcast, irecv,
+                            barrier, batch_isend_irecv, broadcast,
+                            destroy_process_group, gather, irecv,
                             isend, P2POp, recv, reduce, reduce_scatter,
-                            scatter, send)
+                            scatter, send, wait)
+from .object_collectives import (all_gather_object, broadcast_object_list,
+                                 scatter_object_list)
 from ..nn.parallel import DataParallel
 
 from . import fleet  # noqa: E402

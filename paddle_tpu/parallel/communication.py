@@ -362,6 +362,34 @@ def batch_isend_irecv(p2p_op_list):
     return tasks
 
 
+def gather(tensor: Tensor, gather_list: Optional[List] = None, dst=0,
+           group: Optional[Group] = None, sync_op=True):
+    """Reference ``paddle.distributed.gather``: dst receives every rank's
+    tensor. Single-controller SPMD supersets this — the all_gather result
+    is globally addressable, so every rank (dst included) gets the list."""
+    out: List[Tensor] = []
+    task = all_gather(out, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None:
+        gather_list[:] = out
+    return task
+
+
+def wait(tensor: Tensor, group: Optional[Group] = None,
+         use_calc_stream=True):
+    """Reference ``paddle.distributed.wait``: fence the tensor's pending
+    work (jax dispatch is async; block_until_ready is the fence)."""
+    jax.block_until_ready(tensor.value if isinstance(tensor, Tensor)
+                          else tensor)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """Reference parity: tear down collective state (no-op per-group; the
+    mesh facades hold no persistent comm resources)."""
+    from . import env as env_mod
+    if group is None:
+        env_mod.destroy()
+
+
 def barrier(group: Optional[Group] = None):
     g = _group(group)
     x = jnp.zeros((), jnp.int32)

@@ -52,6 +52,12 @@ def is_initialized() -> bool:
     return _STATE["initialized"]
 
 
+def destroy() -> None:
+    """Teardown counterpart of init_parallel_env (the mesh/axis facades
+    hold no persistent comm resources — XLA owns transports)."""
+    _STATE["initialized"] = False
+
+
 def get_rank(group=None) -> int:
     """Logical rank. Per-process (host) rank in the multi-host model; inside a
     group, the caller's rank in that group's mesh ordering."""

@@ -1,0 +1,187 @@
+"""Object collectives (reference:
+``python/paddle/distributed/communication/`` ``all_gather_object`` /
+``broadcast_object_list`` / ``scatter_object_list`` † — pickle-based
+exchange of arbitrary Python objects between ranks, used for vocab maps,
+dataset metadata, rng state, etc.).
+
+TPU model: tensors ride XLA collectives, but OBJECTS are host-side — the
+natural transport is the launcher's rendezvous KV store (the same
+substrate the elastic manager and TCP rendezvous use), reached through
+``PADDLE_MASTER_KV`` which every launcher now exports to its trainers.
+Single-process runs (world size 1, the single-controller SPMD default)
+short-circuit without a store. A per-call sequence number keyed into the
+store keeps successive collectives from colliding; calls must occur in
+the same program order on every rank (the reference's contract too).
+"""
+from __future__ import annotations
+
+import base64
+import pickle
+import time
+from typing import List, Optional
+
+_SEQ = {"n": 0}
+
+
+def _next_seq() -> int:
+    _SEQ["n"] += 1
+    return _SEQ["n"]
+
+
+def _proc_rank_world():
+    """Objects are HOST-side state, so the collective's world is the
+    process count (one trainer process per host), not the chip count
+    (env.get_world_size): a single process driving 8 chips holds ONE copy
+    of the object. Falls back to the launcher env when jax.distributed is
+    not initialized (single-controller tests)."""
+    import os
+
+    import jax
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    return (int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+
+_CLIENTS = {}
+
+
+def _store():
+    import os
+    ep = os.environ.get("PADDLE_MASTER_KV")
+    if not ep:
+        raise RuntimeError(
+            "object collectives across processes need the rendezvous store "
+            "(run under paddle_tpu.distributed.launch, which exports "
+            "PADDLE_MASTER_KV)")
+    if ep not in _CLIENTS:  # one connection per process, not per call
+        from .launch.rendezvous import connect
+        _CLIENTS[ep] = connect(ep)
+    return _CLIENTS[ep]
+
+
+_RUN = {"id": None}
+
+
+def _run_id(store, rank: int, timeout: float = 60.0) -> str:
+    """Per-incarnation namespace: rank 0 publishes a fresh nonce at its
+    FIRST collective (same program order on all ranks), everyone adopts
+    it. An elastic restart re-runs this on every trainer, so the new
+    incarnation ignores the dead run's /objcol/<old>/ keys instead of
+    reading stale payloads."""
+    if _RUN["id"] is not None:
+        return _RUN["id"]
+    key = "/objcol_meta/run"
+    if rank == 0:
+        import os
+        _RUN["id"] = os.urandom(8).hex()
+        store.put(key, _RUN["id"])
+        return _RUN["id"]
+    deadline = time.time() + timeout
+    while True:
+        v = store.get(key)
+        if v:
+            _RUN["id"] = v.decode() if isinstance(v, bytes) else v
+            return _RUN["id"]
+        if time.time() > deadline:
+            raise TimeoutError("object collectives: rank 0 never "
+                               "published the run id")
+        time.sleep(0.02)
+
+
+def _enc(obj) -> str:
+    return base64.b64encode(pickle.dumps(obj, protocol=4)).decode()
+
+
+def _dec(s) -> object:
+    if isinstance(s, bytes):
+        s = s.decode()
+    return pickle.loads(base64.b64decode(s))
+
+
+def _exchange(store, rank: int, world: int, seq: int, payload: str,
+              timeout: float = 60.0, run: str = "r0") -> List[str]:
+    """Every rank publishes its payload under the call's sequence key and
+    polls until ALL EXPECTED rank keys exist (stale extra keys from a
+    larger dead world never satisfy the wait); returns them rank-ordered
+    and best-effort deletes this rank's key afterwards."""
+    prefix = f"/objcol/{run}/{seq}/"
+    mine = prefix + str(rank)
+    store.put(mine, payload)
+    want = [prefix + str(r) for r in range(world)]
+    deadline = time.time() + timeout
+    while True:
+        table = store.get_prefix(prefix)
+        if all(k in table for k in want):
+            out = [table[k] for k in want]
+            # DEFERRED cleanup: deleting this seq's own key now would race
+            # with peers still polling it — instead retire the key from
+            # two collectives ago (its peers completed before this one
+            # could start, by program order)
+            if seq > 2:
+                try:
+                    store.delete(f"/objcol/{run}/{seq - 2}/{rank}")
+                except Exception:
+                    pass
+            return out
+        if time.time() > deadline:
+            have = sum(k in table for k in want)
+            raise TimeoutError(
+                f"object collective seq={seq}: {have}/{world} ranks "
+                f"arrived within {timeout}s")
+        time.sleep(0.02)
+
+
+def _multi(rank, world, payload):
+    store = _store()
+    return _exchange(store, rank, world, _next_seq(), payload,
+                     run=_run_id(store, rank))
+
+
+def all_gather_object(object_list: list, obj, group=None) -> None:
+    """Fill ``object_list`` with every rank's ``obj`` (rank order)."""
+    rank, world = _proc_rank_world()
+    if world <= 1:
+        object_list[:] = [obj]
+        return
+    outs = _multi(rank, world, _enc(obj))
+    object_list[:] = [_dec(o) for o in outs]
+
+
+def broadcast_object_list(object_list: list, src: int = 0,
+                          group=None) -> None:
+    """In-place on every NON-src rank: ``object_list`` becomes ``src``'s.
+    src's own list (and the objects in it) stay untouched — the reference
+    contract; a pickle round-trip on src would silently replace objects
+    callers still hold references to."""
+    rank, world = _proc_rank_world()
+    if world <= 1:
+        return
+    payload = _enc(object_list if rank == src else None)
+    outs = _multi(rank, world, payload)
+    if rank != src:
+        object_list[:] = _dec(outs[src])
+
+
+def _validate_scatter_src(in_object_list, world):
+    if in_object_list is None or len(in_object_list) != world:
+        raise ValueError(
+            f"scatter_object_list: src needs one object per rank "
+            f"({world}), got "
+            f"{None if in_object_list is None else len(in_object_list)}")
+
+
+def scatter_object_list(out_object_list: list,
+                        in_object_list: Optional[list] = None,
+                        src: int = 0, group=None) -> None:
+    """Rank r receives ``in_object_list[r]`` from ``src``."""
+    rank, world = _proc_rank_world()
+    if world <= 1:
+        _validate_scatter_src(in_object_list, 1)
+        out_object_list[:] = [in_object_list[0]]
+        return
+    if rank == src:
+        _validate_scatter_src(in_object_list, world)
+    payload = _enc(in_object_list if rank == src else None)
+    outs = _multi(rank, world, payload)
+    out_object_list[:] = [_dec(outs[src])[rank]]
