@@ -1,3 +1,4 @@
 from . import lr
+from .lbfgs import LBFGS
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
                         Momentum, Optimizer, RMSProp)

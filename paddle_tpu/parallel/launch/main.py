@@ -323,13 +323,19 @@ def _probe_endpoint(candidates):
 
 def _drop_stale_ranks(kv_server, job_id):
     """Delete /job/<id>/rank/* so the next run's wait_world barrier cannot
-    be satisfied by dead endpoints (membership/heartbeat keys survive)."""
+    be satisfied by dead endpoints (membership/heartbeat keys survive).
+    Also wipes /objcol* (object-collective payloads + run id): the wipe
+    happens BEFORE the respawn — and before the elastic commit round other
+    nodes' spawns wait on — so a restarted incarnation can never adopt the
+    dead run's namespace or read its stale payloads."""
     if kv_server is None:
         return
     from .rendezvous import connect
     try:
         cli = connect(kv_server.endpoint)
         for key in cli.get_prefix(f"/job/{job_id}/rank/"):
+            cli.delete(key)
+        for key in cli.get_prefix("/objcol"):
             cli.delete(key)
     except Exception as e:
         logger.warning(f"stale-rank cleanup failed: {e}")

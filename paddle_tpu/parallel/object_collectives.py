@@ -138,8 +138,18 @@ def _multi(rank, world, payload):
                      run=_run_id(store, rank))
 
 
+def _check_group(group):
+    # global-world only for now: a subgroup call would poll for absent
+    # ranks and hang — fail fast instead
+    if group is not None:
+        raise NotImplementedError(
+            "object collectives currently support the default (global) "
+            "group only")
+
+
 def all_gather_object(object_list: list, obj, group=None) -> None:
     """Fill ``object_list`` with every rank's ``obj`` (rank order)."""
+    _check_group(group)
     rank, world = _proc_rank_world()
     if world <= 1:
         object_list[:] = [obj]
@@ -154,6 +164,7 @@ def broadcast_object_list(object_list: list, src: int = 0,
     src's own list (and the objects in it) stay untouched — the reference
     contract; a pickle round-trip on src would silently replace objects
     callers still hold references to."""
+    _check_group(group)
     rank, world = _proc_rank_world()
     if world <= 1:
         return
@@ -175,6 +186,7 @@ def scatter_object_list(out_object_list: list,
                         in_object_list: Optional[list] = None,
                         src: int = 0, group=None) -> None:
     """Rank r receives ``in_object_list[r]`` from ``src``."""
+    _check_group(group)
     rank, world = _proc_rank_world()
     if world <= 1:
         _validate_scatter_src(in_object_list, 1)
