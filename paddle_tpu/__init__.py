@@ -113,3 +113,9 @@ def is_grad_enabled_():
 def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
     return _summary(net, input_size, dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)

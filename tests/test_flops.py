@@ -1,0 +1,59 @@
+"""paddle.flops (reference ``python/paddle/hapi/dynamic_flops.py`` † —
+hook-based MAC counting over a dummy forward)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestFlops:
+    def test_mlp_hand_count(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        # batch 2: Linear1 = 2*8*(4+1) = 80, ReLU = 16, Linear2 = 2*2*(8+1)
+        assert paddle.flops(net, [2, 4]) == 80 + 16 + 36
+
+    def test_conv_count_and_custom_ops(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+        # conv: out elems 1*8*16*16 * (3*3*3 + 1) = 2048 * 28
+        want_conv = 8 * 16 * 16 * (27 + 1)
+        total = paddle.flops(net, [1, 3, 16, 16])
+        assert total == want_conv + 8 * 16 * 16
+
+        class Custom(nn.Layer):
+            def forward(self, x):
+                return x
+
+        net2 = nn.Sequential(nn.Linear(4, 4), Custom())
+        base = paddle.flops(net2, [1, 4])
+        with_custom = paddle.flops(
+            net2, [1, 4], custom_ops={Custom: lambda l, i, o: 1000})
+        assert with_custom == base + 1000
+
+    def test_resnet_scale_plausible(self):
+        paddle.seed(2)
+        from paddle_tpu.vision.models import resnet18
+        f64 = paddle.flops(resnet18(), [1, 3, 64, 64])
+        assert 1e8 < f64 < 3e8  # ~1.8 GMACs at 224 -> ~148M at 64
+
+    def test_restores_per_layer_training_mode_and_removes_hooks(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5),
+                            nn.BatchNorm1D(4))
+        net.train()
+        net[2].eval()  # deliberately frozen sublayer must STAY frozen
+        paddle.flops(net, [2, 4])
+        assert net.training and net[0].training
+        assert not net[2].training
+        hooks = sum(len(l._forward_post_hooks)
+                    for l in net.sublayers(include_self=True))
+        assert hooks == 0
+
+    def test_conv_transpose_count(self):
+        paddle.seed(4)
+        net = nn.Sequential(nn.Conv2DTranspose(8, 3, 3))
+        # MACs = in_elems * out_c/groups * k*k (+ bias * out_elems)
+        total = paddle.flops(net, [1, 8, 5, 5])
+        want = 8 * 5 * 5 * (3 * 3 * 3) + 3 * 7 * 7
+        assert total == want, (total, want)
