@@ -259,14 +259,17 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 @tensor_op
-def _alpha_dropout(x, key, p):
+def _alpha_dropout(x, key, p, mask_shape=None):
+    # mask_shape=None -> per-element; (B, C, 1, ...) -> whole-feature maps
+    # (feature_alpha_dropout shares this body, only the mask shape differs)
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
     keep = 1.0 - p
     a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
     b = -a * alpha_p * (1 - keep)
-    mask = jax.random.bernoulli(key, keep, x.shape)
+    mask = jax.random.bernoulli(key, keep,
+                                mask_shape if mask_shape else x.shape)
     return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
 
 
@@ -1316,3 +1319,241 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         # reference divides per-sample loss by its label length first
         return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
     return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------- r4 parity batch
+# (reference: the remaining python/paddle/nn/functional/ surface †)
+@tensor_op
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+@tensor_op
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channel maps: same affine as
+    alpha_dropout, mask shared per (batch, channel)."""
+    if not training or p == 0.0:
+        return x
+    mask_shape = tuple(x.shape[:2]) + (1,) * (len(x.shape) - 2)
+    return _alpha_dropout(x, random_mod.next_key(), float(p),
+                          mask_shape=mask_shape)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, name=None):
+    from ..ops import squeeze, unsqueeze
+    out = lp_pool2d(unsqueeze(x, -1), norm_type,
+                    (_pair(kernel_size, 1)[0], 1),
+                    (_pair(stride, 1)[0], 1) if stride is not None else None,
+                    padding=(_pair(padding, 1)[0], 0), ceil_mode=ceil_mode)
+    return squeeze(out, -1)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """(sum |x|^p over window)^(1/p) — p=inf is max pooling."""
+    p = float(norm_type)
+    if p == float("inf"):
+        return max_pool2d(x, kernel_size, stride, padding,
+                          ceil_mode=ceil_mode)
+    kh, kw = _pair(kernel_size)
+    powed = x.abs().pow(p) if hasattr(x, "abs") else abs(x) ** p
+    # divisor_override pins the divisor to the FULL kernel area, so
+    # s * kh*kw is the true window sum even for padding/ceil overhang
+    # windows (exclusive averaging there would overscale the sum)
+    s = avg_pool2d(powed, kernel_size, stride, padding, ceil_mode=ceil_mode,
+                   divisor_override=kh * kw)
+    return (s * float(kh * kw)).pow(1.0 / p)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW", name=None):
+    if return_mask:  # same precedent as max_pool3d above
+        raise NotImplementedError("adaptive_max_pool3d return_mask")
+    return _adaptive_max_pool3d_impl(
+        x, tuple(output_size) if isinstance(output_size, (list, tuple))
+        else (output_size,) * 3)
+
+
+@tensor_op
+def _adaptive_max_pool3d_impl(x, out_size):
+    od, oh, ow = out_size
+    D, H, W = x.shape[-3], x.shape[-2], x.shape[-1]
+    if D % od == 0 and H % oh == 0 and W % ow == 0:
+        xr = x.reshape(x.shape[:-3] + (od, D // od, oh, H // oh, ow, W // ow))
+        return jnp.max(xr, axis=(-5, -3, -1))
+    planes = [jnp.max(x[..., (i * D) // od:-(-(i + 1) * D // od), :, :],
+                      axis=-3, keepdims=True) for i in range(od)]
+    xd = jnp.concatenate(planes, axis=-3)
+    rows = [jnp.max(xd[..., :, (i * H) // oh:-(-(i + 1) * H // oh), :],
+                    axis=-2, keepdims=True) for i in range(oh)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.max(xh[..., :, :, (j * W) // ow:-(-(j + 1) * W // ow)],
+                    axis=-1, keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@tensor_op
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        B, C, H, W = x.shape
+        xr = x.reshape(B, C, H // r, r, W // r, r)
+        return xr.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * r * r,
+                                                      H // r, W // r)
+    B, H, W, C = x.shape
+    xr = x.reshape(B, H // r, r, W // r, r, C)
+    return xr.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // r, W // r,
+                                                  C * r * r)
+
+
+@tensor_op
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift: within each segment group, shift 1/ratio of channels one
+    step back/forward in time (zero-padded edges)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("temporal_shift supports NCHW")
+    NT, C, H, W = x.shape
+    N, T = NT // seg_num, seg_num
+    v = x.reshape(N, T, C, H, W)
+    fold = int(C * shift_ratio)
+    back = jnp.concatenate(
+        [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+        axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest], axis=2).reshape(NT, C, H, W)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """x1 [B, in1], x2 [B, in2], weight [out, in1, in2] -> [B, out]."""
+    out = _bilinear_impl(x1, x2, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@tensor_op
+def _bilinear_impl(x1, x2, w):
+    return jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+
+
+@tensor_op(differentiable=False)
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry walk (reference gather_tree): ids/parents
+    [T, B, W]; walk parents backwards from the last step so each beam
+    column holds its full token path."""
+    T = ids.shape[0]
+
+    def step(beam_idx, t):
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        nxt = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return nxt, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[-1]), ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+@tensor_op
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [B, 2, 3] -> sampling grid [B, H, W, 2] (normalized xy)."""
+    B = theta.shape[0]
+    H, W = int(out_shape[-2]), int(out_shape[-1])
+
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        return (jnp.arange(n) * 2.0 + 1.0) / n - 1.0
+
+    ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,bik->bhwi", base, theta)
+
+
+@tensor_op
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [B, C, H, W], grid [B, Hg, Wg, 2] normalized xy -> [B, C, Hg, Wg]."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r} (zeros/border "
+            f"supported; reflection pending)")
+    B, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, n):
+        if align_corners:
+            return (g + 1.0) * (n - 1) / 2.0
+        return ((g + 1.0) * n - 1.0) / 2.0
+
+    fx, fy = unnorm(gx, W), unnorm(gy, H)
+
+    def fetch(ix, iy):
+        inside = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+        if padding_mode == "border":
+            ix, iy = jnp.clip(ix, 0, W - 1), jnp.clip(iy, 0, H - 1)
+            inside = jnp.ones_like(inside)
+        ixc, iyc = jnp.clip(ix, 0, W - 1), jnp.clip(iy, 0, H - 1)
+        v = x[jnp.arange(B)[:, None, None], :, iyc, ixc]  # [B, Hg, Wg, C]
+        return jnp.where(inside[..., None], v, 0.0)
+
+    if mode == "nearest":
+        out = fetch(jnp.round(fx).astype(jnp.int32),
+                    jnp.round(fy).astype(jnp.int32))
+        return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    wx = (fx - x0)[..., None]
+    wy = (fy - y0)[..., None]
+    v00, v01 = fetch(x0, y0), fetch(x0 + 1, y0)
+    v10, v11 = fetch(x0, y0 + 1), fetch(x0 + 1, y0 + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    out = top * (1 - wy) + bot * wy
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference margin_cross_entropy,
+    single-group form): cos(m1*theta + m2) - m3 on the target logit."""
+    out = _margin_ce_impl(logits, label, float(margin1), float(margin2),
+                          float(margin3), float(scale), reduction,
+                          bool(return_softmax))
+    return out
+
+
+@tensor_op
+def _margin_ce_impl(logits, label, m1, m2, m3, s, reduction, return_softmax):
+    if label.ndim == 2 and label.shape[-1] == 1:  # paddle [N,1] labels
+        label = label[:, 0]
+    lf = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+    # theta branch clips strictly inside (-1, 1): d(arccos)/dx -> -inf at
+    # the boundary would NaN the backward for any exact-match logit
+    theta = jnp.arccos(jnp.clip(lf, -1.0 + 1e-6, 1.0 - 1e-6))
+    target = jnp.cos(m1 * theta + m2) - m3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=jnp.float32)
+    adj = (onehot * target + (1.0 - onehot) * lf) * s
+    lse = jax.scipy.special.logsumexp(adj, axis=-1)
+    picked = jnp.sum(adj * onehot, axis=-1)
+    loss = lse - picked
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=-1)
+    return loss

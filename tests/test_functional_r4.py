@@ -1,0 +1,186 @@
+"""Round-4 nn.functional parity batch vs torch oracles (reference: the
+remaining ``python/paddle/nn/functional/`` surface †)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+TF = torch.nn.functional
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestActivationsAndPads:
+    def test_thresholded_relu_and_log_sigmoid(self):
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            F.thresholded_relu(_t(x), threshold=0.3).numpy(),
+            TF.threshold(torch.tensor(x), 0.3, 0.0).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            F.log_sigmoid(_t(x)).numpy(),
+            TF.logsigmoid(torch.tensor(x)).numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_zeropad2d(self):
+        x = np.random.RandomState(1).randn(1, 2, 3, 3).astype(np.float32)
+        got = F.zeropad2d(_t(x), [1, 2, 0, 1]).numpy()
+        want = TF.pad(torch.tensor(x), (1, 2, 0, 1)).numpy()
+        np.testing.assert_allclose(got, want)
+
+
+class TestPools:
+    def test_lp_pool2d_matches_torch(self):
+        x = np.abs(np.random.RandomState(2).randn(1, 2, 6, 6)) \
+            .astype(np.float32)
+        got = F.lp_pool2d(_t(x), 2.0, 2, stride=2).numpy()
+        want = TF.lp_pool2d(torch.tensor(x), 2.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_lp_pool1d_matches_torch(self):
+        x = np.abs(np.random.RandomState(3).randn(2, 3, 8)) \
+            .astype(np.float32)
+        got = F.lp_pool1d(_t(x), 3.0, 2, stride=2).numpy()
+        want = TF.lp_pool1d(torch.tensor(x), 3.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_adaptive_max_pool3d(self):
+        x = np.random.RandomState(4).randn(1, 2, 6, 8, 4).astype(np.float32)
+        got = F.adaptive_max_pool3d(_t(x), [3, 4, 2]).numpy()
+        want = TF.adaptive_max_pool3d(torch.tensor(x), (3, 4, 2)).numpy()
+        np.testing.assert_allclose(got, want)
+        got_odd = F.adaptive_max_pool3d(_t(x), [4, 3, 3]).numpy()
+        want_odd = TF.adaptive_max_pool3d(torch.tensor(x), (4, 3, 3)).numpy()
+        np.testing.assert_allclose(got_odd, want_odd)
+
+
+class TestShapeOps:
+    def test_pixel_unshuffle_roundtrips_shuffle(self):
+        x = np.random.RandomState(5).randn(2, 3, 8, 8).astype(np.float32)
+        sh = F.pixel_shuffle(F.pixel_unshuffle(_t(x), 2), 2).numpy()
+        np.testing.assert_allclose(sh, x)
+        want = TF.pixel_unshuffle(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(F.pixel_unshuffle(_t(x), 2).numpy(),
+                                   want)
+
+    def test_temporal_shift(self):
+        x = np.random.RandomState(6).randn(4, 8, 2, 2).astype(np.float32)
+        got = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        want = v.copy()
+        want[:, :, :2] = np.concatenate(
+            [v[:, 1:, :2], np.zeros_like(v[:, :1, :2])], axis=1)
+        want[:, :, 2:4] = np.concatenate(
+            [np.zeros_like(v[:, :1, 2:4]), v[:, :-1, 2:4]], axis=1)
+        np.testing.assert_allclose(got, want.reshape(4, 8, 2, 2))
+
+
+class TestSampling:
+    def test_affine_grid_and_grid_sample_identity(self):
+        """Identity theta must reproduce the input through grid_sample."""
+        x = np.random.RandomState(7).randn(1, 2, 5, 7).astype(np.float32)
+        theta = np.asarray([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(_t(theta), [1, 2, 5, 7], align_corners=True)
+        out = F.grid_sample(_t(x), grid, align_corners=True).numpy()
+        np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+    def test_grid_sample_matches_torch(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(2, 3, 6, 5).astype(np.float32)
+        grid = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1)
+        for mode in ("bilinear", "nearest"):
+            got = F.grid_sample(_t(x), _t(grid), mode=mode,
+                                align_corners=True).numpy()
+            want = TF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                                  mode=mode, align_corners=True).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_affine_grid_matches_torch(self):
+        theta = np.asarray([[[0.8, 0.1, 0.2], [-0.1, 1.1, -0.3]]],
+                           np.float32)
+        got = F.affine_grid(_t(theta), [1, 1, 4, 6],
+                            align_corners=True).numpy()
+        want = TF.affine_grid(torch.tensor(theta), (1, 1, 4, 6),
+                              align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestMiscOps:
+    def test_bilinear_matches_torch(self):
+        rng = np.random.RandomState(9)
+        x1 = rng.randn(4, 3).astype(np.float32)
+        x2 = rng.randn(4, 5).astype(np.float32)
+        w = rng.randn(2, 3, 5).astype(np.float32)
+        b = rng.randn(2).astype(np.float32)
+        got = F.bilinear(_t(x1), _t(x2), _t(w), _t(b)).numpy()
+        want = TF.bilinear(torch.tensor(x1), torch.tensor(x2),
+                           torch.tensor(w), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_gather_tree_walks_parents(self):
+        # T=3, B=1, W=2 beam: final beams trace ancestry through parents
+        ids = np.asarray([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.asarray([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        got = F.gather_tree(_t(ids), _t(parents)).numpy()
+        # beam 0 at t=2 has parent 1: path = ids[0][par(par)]..: [2, 4, 5]?
+        # walk: t2 tok ids[2,0,[0,1]]=[5,6]; parents -> [1,0]
+        #       t1 tok ids[1,0,[1,0]]=[4,3]; parents[1,0,[1,0]] = [0,0]
+        #       t0 tok ids[0,0,[0,0]]=[1,1]
+        want = np.asarray([[[1, 1]], [[4, 3]], [[5, 6]]], np.int32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        rng = np.random.RandomState(10)
+        # cosine-similarity logits in [-1, 1]
+        logits = (rng.rand(6, 10).astype(np.float32) * 2 - 1) * 0.9
+        label = rng.randint(0, 10, 6).astype(np.int32)
+        got = float(F.margin_cross_entropy(
+            _t(logits), _t(label), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=4.0))
+        want = float(F.cross_entropy(_t(logits) * 4.0, _t(label)))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        # with a margin the loss must strictly increase
+        harder = float(F.margin_cross_entropy(
+            _t(logits), _t(label), margin2=0.5, scale=4.0))
+        assert harder > got
+
+    def test_lp_pool_padded_windows_not_overscaled(self):
+        """Padding windows must use the true window SUM (divisor pinned to
+        the kernel area), not an exclusive average times the area."""
+        x = np.ones((1, 1, 4, 4), np.float32)
+        got = F.lp_pool2d(_t(x), 1.0, 2, stride=2, padding=1).numpy()
+        # corner window holds exactly one real element -> sum 1.0
+        assert got[0, 0, 0, 0] == 1.0, got[0, 0]
+
+    def test_margin_ce_column_labels_and_finite_grads(self):
+        rng = np.random.RandomState(12)
+        logits = (rng.rand(4, 6).astype(np.float32) * 2 - 1) * 0.9
+        logits[0, 3] = 1.0  # exact-match cosine must not NaN the backward
+        lab = rng.randint(0, 6, (4, 1)).astype(np.int32)
+        lt = _t(logits)
+        lt.stop_gradient = False
+        loss = F.margin_cross_entropy(lt, _t(lab), margin2=0.3, scale=8.0)
+        flat = float(F.margin_cross_entropy(_t(logits), _t(lab[:, 0]),
+                                            margin2=0.3, scale=8.0))
+        np.testing.assert_allclose(float(loss), flat, rtol=1e-5)
+        loss.backward()
+        assert np.isfinite(lt.grad.numpy()).all()
+
+    def test_grid_sample_rejects_reflection(self):
+        x = _t(np.ones((1, 1, 4, 4), np.float32))
+        g = _t(np.zeros((1, 2, 2, 2), np.float32))
+        with pytest.raises(NotImplementedError, match="reflection"):
+            F.grid_sample(x, g, padding_mode="reflection")
+
+    def test_feature_alpha_dropout_masks_whole_channels(self):
+        paddle.seed(11)
+        x = paddle.to_tensor(np.ones((2, 8, 4, 4), np.float32))
+        out = F.feature_alpha_dropout(x, p=0.5, training=True).numpy()
+        # each channel map is either all-original-scaled or all-alpha'd
+        per_chan = out.reshape(2, 8, -1)
+        assert all(np.unique(per_chan[b, c]).size == 1
+                   for b in range(2) for c in range(8))
+        same = F.feature_alpha_dropout(x, p=0.5, training=False)
+        np.testing.assert_allclose(same.numpy(), x.numpy())
