@@ -8,14 +8,18 @@ from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 from .layer import Layer, LayerList, ParameterList, Sequential
 from .layers.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                 Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
-                                LogSoftmax, Mish, PReLU, ReLU, ReLU6, RReLU,
-                                Sigmoid, Silu, Softmax, Softplus, Softshrink,
-                                Softsign, Swish, Tanh, Tanhshrink)
+                                LogSigmoid, LogSoftmax, Mish, PReLU, ReLU,
+                                ReLU6, RReLU, Sigmoid, Silu, Softmax,
+                                Softplus, Softshrink, Softsign, Swish, Tanh,
+                                Tanhshrink, ThresholdedReLU)
 from .layers.common import (AlphaDropout, Bilinear, ChannelShuffle,
-                            CosineSimilarity, Dropout, Dropout2D, Embedding,
-                            Flatten, Fold, Identity, Linear, Maxout, Pad1D,
+                            CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                            Embedding, FeatureAlphaDropout, Flatten, Fold,
+                            Identity, Linear, Maxout, Pad1D,
                             Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
-                            Softmax2D, Unfold, Upsample, ZeroPad2D)
+                            PixelUnshuffle, Softmax2D, Unfold, Upsample,
+                            UpsamplingBilinear2D, UpsamplingNearest2D,
+                            ZeroPad2D)
 from .layers.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                           Conv3D, Conv3DTranspose)
 from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
@@ -28,7 +32,8 @@ from .layers.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
                           SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
                           TripletMarginWithDistanceLoss)
 from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
-                          GroupNorm, InstanceNorm2D, LayerNorm,
+                          GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                          InstanceNorm3D, LayerNorm,
                           LocalResponseNorm, RMSNorm, SpectralNorm,
                           SyncBatchNorm)
 from .layers.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,

@@ -862,6 +862,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     size = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
     method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
               "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+    if align_corners and method == "linear":
+        # corner-anchored sampling (out_i -> in coord i*(n-1)/(out-1));
+        # jax.image.resize is half-pixel only, so this path interpolates
+        # explicitly — separable per-dim lerp, exact
+        return _interp_align_corners(x, tuple(size), data_format)
     return _interp(x, tuple(size), method, data_format)
 
 
@@ -872,6 +877,27 @@ def _interp(x, size, method, data_format):
     else:
         out_shape = (x.shape[0],) + size + (x.shape[-1],)
     return jax.image.resize(x, out_shape, method=method)
+
+
+@tensor_op
+def _interp_align_corners(x, size, data_format):
+    axes = (range(2, x.ndim) if data_format.startswith("NC")
+            else range(1, x.ndim - 1))
+    out = x
+    for ax, osz in zip(axes, size):
+        n = out.shape[ax]
+        if osz == n:
+            continue
+        c = jnp.arange(osz) * ((n - 1) / max(osz - 1, 1))
+        lo = jnp.floor(c).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n - 1)
+        w = (c - lo).astype(out.dtype)
+        wshape = [1] * out.ndim
+        wshape[ax] = osz
+        a = jnp.take(out, lo, axis=ax)
+        b = jnp.take(out, hi, axis=ax)
+        out = a + (b - a) * w.reshape(wshape)
+    return out
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

@@ -68,3 +68,21 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+        self.value = value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold, self.value)
+
+
+class LogSigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.log_sigmoid(x)

@@ -188,3 +188,12 @@ class SpectralNorm(Layer):
                  name=None):
         super().__init__()
         raise NotImplementedError("SpectralNorm: planned (low-priority parity item)")
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """[N, C, L] instance norm — the functional normalizes over all
+    trailing spatial dims, so the 2D body applies unchanged."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """[N, C, D, H, W] instance norm (same reduction rule)."""

@@ -184,3 +184,61 @@ class TestMiscOps:
                    for b in range(2) for c in range(8))
         same = F.feature_alpha_dropout(x, p=0.5, training=False)
         np.testing.assert_allclose(same.numpy(), x.numpy())
+
+
+class TestNewLayerClasses:
+    """Layer-class wrappers over the r4 functional batch + the norm/
+    upsample family completions."""
+
+    def test_layer_wrappers_match_functionals(self):
+        import paddle_tpu.nn as nn
+        rng = np.random.RandomState(13)
+        x = _t(rng.randn(1, 4, 4, 4).astype(np.float32))
+        np.testing.assert_allclose(
+            nn.PixelUnshuffle(2)(x).numpy(),
+            F.pixel_unshuffle(x, 2).numpy())
+        np.testing.assert_allclose(
+            nn.ThresholdedReLU(0.5)(x).numpy(),
+            F.thresholded_relu(x, 0.5).numpy())
+        np.testing.assert_allclose(
+            nn.LogSigmoid()(x).numpy(), F.log_sigmoid(x).numpy())
+        # align_corners bilinear vs the TORCH oracle (the functional used
+        # to silently ignore align_corners — this pins the real contract)
+        up = nn.UpsamplingBilinear2D(scale_factor=2)(x)
+        want = TF.interpolate(torch.tensor(np.asarray(x.numpy())),
+                              scale_factor=2, mode="bilinear",
+                              align_corners=True).numpy()
+        np.testing.assert_allclose(up.numpy(), want, rtol=1e-4, atol=1e-5)
+        upn = nn.UpsamplingNearest2D(scale_factor=2)(x)
+        np.testing.assert_allclose(
+            upn.numpy(),
+            F.interpolate(x, scale_factor=2, mode="nearest").numpy())
+
+    def test_instance_norm_family(self):
+        import paddle_tpu.nn as nn
+        rng = np.random.RandomState(14)
+        x1 = rng.randn(2, 3, 8).astype(np.float32)
+        x3 = rng.randn(2, 3, 4, 4, 4).astype(np.float32)
+        got1 = nn.InstanceNorm1D(3)(_t(x1)).numpy()
+        want1 = TF.instance_norm(torch.tensor(x1)).numpy()
+        np.testing.assert_allclose(got1, want1, rtol=1e-4, atol=1e-4)
+        got3 = nn.InstanceNorm3D(3)(_t(x3)).numpy()
+        want3 = TF.instance_norm(torch.tensor(x3)).numpy()
+        np.testing.assert_allclose(got3, want3, rtol=1e-4, atol=1e-4)
+
+    def test_dropout3d_and_feature_alpha_layers(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(15)
+        x = _t(np.ones((2, 4, 2, 2, 2), np.float32))
+        d = nn.Dropout3D(0.5)
+        d.train()
+        out = d(x).numpy()
+        per_chan = out.reshape(2, 4, -1)
+        assert all(np.unique(per_chan[b, c]).size == 1
+                   for b in range(2) for c in range(4))
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        fa = nn.FeatureAlphaDropout(0.3)
+        fa.eval()
+        np.testing.assert_allclose(fa(_t(np.ones((1, 2, 3, 3),
+                                               np.float32))).numpy(), 1.0)
