@@ -851,7 +851,7 @@ def _sdpa(q, k, v, attn_mask, dropout_p, is_causal, training, drop_key):
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    if data_format == "NCHW":
+    if data_format.startswith("NC"):  # NCHW/NCDHW/NCL channels-first
         spatial = x.shape[2:]
     else:
         spatial = x.shape[1:-1]
@@ -867,6 +867,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         # jax.image.resize is half-pixel only, so this path interpolates
         # explicitly — separable per-dim lerp, exact
         return _interp_align_corners(x, tuple(size), data_format)
+    if align_corners and method == "cubic":
+        raise NotImplementedError(
+            "interpolate(mode='bicubic', align_corners=True) is not "
+            "implemented (jax.image.resize is half-pixel only); use "
+            "align_corners=False or a linear mode")
     return _interp(x, tuple(size), method, data_format)
 
 
@@ -1583,3 +1588,78 @@ def _margin_ce_impl(logits, label, m1, m2, m3, s, reduction, return_softmax):
     if return_softmax:
         return loss, jax.nn.softmax(adj, axis=-1)
     return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference ``python/paddle/nn/functional/loss.py``
+    rnnt_loss † wrapping warp-transducer; here the full log-space lattice
+    DP runs as XLA ops).
+
+    input: [B, T, U+1, V] UN-normalized logits (log_softmax applied
+    internally, reference contract), label: [B, U] int, lengths [B].
+    alpha[t, u] = logadd(alpha[t-1, u] + blank(t-1, u),
+                         alpha[t, u-1] + emit(t, u-1)); the u-recursion is
+    a log-semiring prefix scan (associative), the t-recursion a lax.scan.
+
+    NOTE: ``fastemit_lambda`` is accepted for signature parity but the
+    FastEmit gradient reweighting is not applied (the plain transducer
+    NLL is returned).
+    """
+    return _rnnt_impl(input, label, input_lengths, label_lengths,
+                      int(blank), float(fastemit_lambda), reduction)
+
+
+@tensor_op
+def _rnnt_impl(logits, label, in_len, lab_len, blank, fastemit_lambda,
+               reduction="mean"):
+    B, T, U1, V = logits.shape
+    U = U1 - 1
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    NEG = -1e30
+    # per-(t,u) transition log-probs
+    lp_blank = lp[..., blank]                          # [B, T, U+1]
+    lab_idx = jnp.concatenate(
+        [label.astype(jnp.int32),
+         jnp.zeros((B, 1), jnp.int32)], axis=1)        # pad u=U slot
+    lp_emit = jnp.take_along_axis(
+        lp, lab_idx[:, None, :, None], axis=-1)[..., 0]  # [B, T, U+1]
+    u_valid = jnp.arange(U1)[None, :] <= lab_len[:, None]   # u <= U_b
+    emit_valid = jnp.arange(U1)[None, :] < lab_len[:, None]  # emit from u<U_b
+
+    def row(alpha_prev, t):
+        # horizontal step: alpha_prev[u] + blank at (t-1, u)
+        from_top = jnp.where(
+            (t > 0)[:, None],
+            alpha_prev + jnp.take_along_axis(
+                lp_blank, jnp.maximum(t - 1, 0)[:, None, None],
+                axis=1)[:, 0], jnp.where(jnp.arange(U1)[None] == 0, 0.0, NEG))
+        from_top = jnp.where(u_valid, from_top, NEG)
+        # vertical (emit) chain within the row: log-semiring prefix scan
+        e_row = jnp.where(
+            emit_valid,
+            jnp.take_along_axis(lp_emit, t[:, None, None], axis=1)[:, 0],
+            NEG)  # emit prob at (t, u), used moving u -> u+1
+        # alpha[t,u] = logadd(from_top[u], alpha[t,u-1] + e_row[u-1])
+        # == log-semiring linear recurrence; solve with associative_scan
+        # over pairs (a, b): x_u = logadd(b_u, x_{u-1} + a_u)
+        a = jnp.concatenate([jnp.full((B, 1), NEG), e_row[:, :-1]], axis=1)
+        b = from_top
+
+        def combine(l, r):
+            al, bl = l
+            ar, br = r
+            return al + ar, jnp.logaddexp(bl + ar, br)
+
+        _, alpha = jax.lax.associative_scan(combine, (a, b), axis=1)
+        return alpha, alpha
+
+    alpha0 = jnp.full((B, U1), NEG)
+    ts = jnp.broadcast_to(jnp.arange(T)[:, None], (T, B))
+    _, alphas = jax.lax.scan(lambda c, t: row(c, t), alpha0, ts)
+    # alphas: [T, B, U+1]; loss = -(alpha[T_b-1, U_b] + blank(T_b-1, U_b))
+    tb = jnp.clip(in_len - 1, 0, T - 1)
+    aT = alphas[tb, jnp.arange(B)]                      # [B, U+1]
+    a_final = jnp.take_along_axis(aT, lab_len[:, None], axis=1)[:, 0]
+    blank_final = lp_blank[jnp.arange(B), tb, lab_len]
+    return _reduce(-(a_final + blank_final), reduction)

@@ -242,3 +242,88 @@ class TestNewLayerClasses:
         fa.eval()
         np.testing.assert_allclose(fa(_t(np.ones((1, 2, 3, 3),
                                                np.float32))).numpy(), 1.0)
+
+
+class TestInterpolateFixes:
+    def test_ncdhw_scale_factor_uses_true_spatial_dims(self):
+        x = _t(np.random.RandomState(16).randn(1, 2, 3, 3, 3)
+               .astype(np.float32))
+        out = F.interpolate(x, scale_factor=2, mode="trilinear",
+                            data_format="NCDHW")
+        assert out.shape == [1, 2, 6, 6, 6], out.shape
+
+    def test_trilinear_align_corners_matches_torch(self):
+        x = np.random.RandomState(17).randn(1, 2, 3, 4, 5).astype(np.float32)
+        got = F.interpolate(_t(x), scale_factor=2, mode="trilinear",
+                            align_corners=True,
+                            data_format="NCDHW").numpy()
+        want = TF.interpolate(torch.tensor(x), scale_factor=2,
+                              mode="trilinear", align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_bicubic_align_corners_rejected_not_silently_wrong(self):
+        x = _t(np.ones((1, 1, 4, 4), np.float32))
+        with pytest.raises(NotImplementedError, match="bicubic"):
+            F.interpolate(x, scale_factor=2, mode="bicubic",
+                          align_corners=True)
+
+
+class TestRNNTLoss:
+    """paddle.nn.functional.rnnt_loss (reference wraps warp-transducer †;
+    here a log-semiring lattice DP) vs a brute-force numpy oracle."""
+
+    @staticmethod
+    def _np_rnnt(logits, label, T, U, blank=0):
+        m = logits.max(-1, keepdims=True)
+        lp = logits - (m + np.log(np.exp(logits - m).sum(-1, keepdims=True)))
+        alpha = np.full((T, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+
+        def la(a, b):
+            if a == -np.inf:
+                return b
+            if b == -np.inf:
+                return a
+            mm = max(a, b)
+            return mm + np.log(np.exp(a - mm) + np.exp(b - mm))
+
+        for t in range(T):
+            for u in range(U + 1):
+                if t == 0 and u == 0:
+                    continue
+                v = -np.inf
+                if t > 0:
+                    v = la(v, alpha[t - 1, u] + lp[t - 1, u, blank])
+                if u > 0:
+                    v = la(v, alpha[t, u - 1] + lp[t, u - 1, label[u - 1]])
+                alpha[t, u] = v
+        return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+    def test_matches_numpy_dp_ragged(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 6, 4, 8
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        label = rng.randint(1, V, (B, U)).astype(np.int32)
+        in_len = np.asarray([6, 5, 4], np.int32)
+        lab_len = np.asarray([4, 3, 2], np.int32)
+        want = [self._np_rnnt(logits[b, :in_len[b]], label[b],
+                              int(in_len[b]), int(lab_len[b]))
+                for b in range(B)]
+        got = F.rnnt_loss(_t(logits), _t(label), _t(in_len), _t(lab_len),
+                          reduction="none").numpy()
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4)
+        mean = float(F.rnnt_loss(_t(logits), _t(label), _t(in_len),
+                                 _t(lab_len)))
+        np.testing.assert_allclose(mean, np.mean(want), rtol=1e-4)
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(1)
+        logits = _t(rng.randn(2, 5, 4, 6).astype(np.float32))
+        logits.stop_gradient = False
+        loss = F.rnnt_loss(
+            logits, _t(rng.randint(1, 6, (2, 3)).astype(np.int32)),
+            _t(np.asarray([5, 4], np.int32)),
+            _t(np.asarray([3, 2], np.int32)))
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
