@@ -1,0 +1,129 @@
+"""Vision transform r4 batch (reference
+``python/paddle/vision/transforms/transforms.py`` †) — torch(vision)-free
+oracles: hand-computable invariants + torch functional where available."""
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+def _img(seed=0, h=8, w=10):
+    return (np.random.RandomState(seed).rand(h, w, 3) * 255) \
+        .astype(np.uint8)
+
+
+class TestColorOps:
+    def test_adjust_brightness_scales(self):
+        img = _img()
+        out = T.adjust_brightness(img, 2.0)
+        np.testing.assert_array_equal(
+            out, np.clip(img.astype(np.float32) * 2, 0, 255)
+            .astype(np.uint8))
+
+    def test_adjust_contrast_identity_and_zero(self):
+        img = _img(1)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img)
+        flat = T.adjust_contrast(img, 0.0).astype(np.float32)
+        assert flat.std() < 1.0  # collapses to the mean gray
+
+    def test_adjust_saturation_zero_is_grayscale(self):
+        img = _img(2)
+        out = T.adjust_saturation(img, 0.0).astype(np.float32)
+        np.testing.assert_allclose(out[..., 0], out[..., 1], atol=1.0)
+        np.testing.assert_allclose(out[..., 1], out[..., 2], atol=1.0)
+
+    def test_adjust_hue_roundtrip_and_identity(self):
+        img = _img(3)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2.0)
+        # full-turn rotation (0.5 twice) returns close to the original
+        twice = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+        np.testing.assert_allclose(twice, img, atol=3.0)
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_hue_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        try:
+            from torchvision.transforms import functional as TVF
+        except Exception:
+            pytest.skip("torchvision unavailable")
+        img = _img(4)
+        got = T.adjust_hue(img, 0.2).astype(np.float32)
+        want = np.asarray(TVF.adjust_hue(
+            torch.tensor(img.transpose(2, 0, 1)), 0.2)) \
+            .transpose(1, 2, 0).astype(np.float32)
+        np.testing.assert_allclose(got, want, atol=3.0)
+
+    def test_grayscale(self):
+        img = _img(5)
+        g1 = T.Grayscale(1)(img)
+        assert g1.shape == (8, 10, 1)
+        g3 = T.Grayscale(3)(img)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+
+class TestGeometry:
+    def test_pad_constant_and_modes(self):
+        img = _img(6)
+        out = T.Pad((1, 2, 3, 4), fill=7)(img)  # l, t, r, b
+        assert out.shape == (8 + 2 + 4, 10 + 1 + 3, 3)
+        assert (out[0] == 7).all() and (out[:, 0] == 7).all()
+        edge = T.Pad(2, padding_mode="edge")(img)
+        np.testing.assert_array_equal(edge[0, 2:-2], img[0])
+
+    def test_rotate_90_matches_rot90(self):
+        img = _img(7, h=9, w=9)
+        out = T.rotate(img, 90, interpolation="nearest")
+        np.testing.assert_array_equal(out, np.rot90(img, 1))
+
+    def test_rotate_zero_identity_bilinear(self):
+        img = _img(8)
+        np.testing.assert_allclose(
+            T.rotate(img, 0.0, interpolation="bilinear"), img, atol=1e-3)
+
+    def test_random_rotation_bounds(self):
+        img = _img(9)
+        out = T.RandomRotation(0.0)(img)  # zero range = identity
+        np.testing.assert_array_equal(out, img)
+
+    def test_random_erasing(self):
+        img = np.full((16, 16, 3), 200, np.uint8)
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        assert (out == 0).any() and (out == 200).any()
+        same = T.RandomErasing(prob=0.0)(img)
+        np.testing.assert_array_equal(same, img)
+
+    def test_gaussian_blur_preserves_mean_and_smooths(self):
+        rng = np.random.RandomState(10)
+        img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        out = T.GaussianBlur(5, sigma=1.5)(img).astype(np.float32)
+        assert abs(out.mean() - img.astype(np.float32).mean()) < 3.0
+        # variance must drop under smoothing
+        assert out.std() < img.astype(np.float32).std()
+
+
+class TestComposedJitter:
+    def test_color_jitter_runs_and_stays_in_range(self):
+        img = _img(11)
+        out = T.ColorJitter(0.3, 0.3, 0.3, 0.2)(img)
+        a = np.asarray(out)
+        assert a.shape == img.shape
+        assert a.min() >= 0 and a.max() <= 255
+
+    def test_color_jitter_accepts_range_tuples(self):
+        img = _img(12)
+        out = T.ColorJitter(brightness=(0.5, 1.5), contrast=(0.8, 1.2),
+                            saturation=(0.9, 1.1), hue=(-0.1, 0.1))(img)
+        assert np.asarray(out).shape == img.shape
+
+    def test_rotate_expand_enlarges_canvas(self):
+        img = _img(13, h=8, w=12)
+        out = T.rotate(img, 45, expand=True)
+        assert out.shape[0] > 8 and out.shape[1] > 12
+        # 90-degree expand swaps dimensions exactly
+        out90 = T.rotate(img, 90, expand=True, interpolation="nearest")
+        assert out90.shape[:2] == (12, 8)
+
+    def test_gaussian_blur_rejects_even_kernel(self):
+        with pytest.raises(ValueError, match="odd"):
+            T.GaussianBlur(4)
