@@ -13,8 +13,9 @@ import jax.numpy as jnp
 
 from ..ops._op import tensor_op
 
-__all__ = ["nms", "box_iou", "box_area", "roi_align", "box_coder",
-           "distribute_fpn_proposals"]
+__all__ = ["nms", "box_iou", "box_area", "roi_align", "roi_pool",
+           "box_coder", "distribute_fpn_proposals", "prior_box",
+           "yolo_box"]
 
 
 def _iou_matrix(boxes_a, boxes_b):
@@ -182,3 +183,174 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = jnp.sqrt(jnp.maximum(w * h, 1e-9))
     lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-9)) + refer_level
     return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """RoIPool (reference roi_pool): max pooling over quantized roi bins —
+    the pre-RoIAlign detector op. x [N,C,H,W], boxes [R,4] (x1,y1,x2,y2).
+
+    Reference quantization: rounded roi corners, roi span end-start+1,
+    per-cell [floor(i*bin), ceil((i+1)*bin)) ranges clamped to the
+    feature map (cells can OVERLAP), empty cells output 0."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    if boxes_num is None and x.shape[0] != 1:
+        raise ValueError(
+            f"roi_pool: boxes_num is required when the batch has "
+            f"{x.shape[0]} images (otherwise every roi would read image 0)")
+    return _roi_pool_impl(x, boxes, boxes_num, oh, ow, float(spatial_scale))
+
+
+@tensor_op
+def _roi_pool_impl(x, boxes, boxes_num, oh, ow, spatial_scale):
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of = jnp.repeat(jnp.arange(boxes_num.shape[0]),
+                            boxes_num, total_repeat_length=R)
+    NEG = jnp.asarray(-3.4e38, jnp.float32)
+
+    def one_roi(args):
+        box, img = args
+        x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        bin_h, bin_w = roi_h / oh, roi_w / ow
+        iy = jnp.arange(oh, dtype=jnp.float32)
+        ix = jnp.arange(ow, dtype=jnp.float32)
+        y0 = jnp.clip(y1 + jnp.floor(iy * bin_h).astype(jnp.int32), 0, H)
+        ye = jnp.clip(y1 + jnp.ceil((iy + 1) * bin_h).astype(jnp.int32),
+                      0, H)
+        x0 = jnp.clip(x1 + jnp.floor(ix * bin_w).astype(jnp.int32), 0, W)
+        xe = jnp.clip(x1 + jnp.ceil((ix + 1) * bin_w).astype(jnp.int32),
+                      0, W)
+        ys, xs = jnp.arange(H), jnp.arange(W)
+        my = (ys[:, None] >= y0[None]) & (ys[:, None] < ye[None])  # [H,oh]
+        mx = (xs[:, None] >= x0[None]) & (xs[:, None] < xe[None])  # [W,ow]
+        feat = x[img].astype(jnp.float32)                          # [C,H,W]
+        # separable masked max: rows first ([C,oh,W]), then cols
+        rows = jnp.max(jnp.where(my.T[None, :, :, None],
+                                 feat[:, None, :, :], NEG), axis=2)
+        out = jnp.max(jnp.where(mx.T[None, None, :, :],
+                                rows[:, :, None, :], NEG), axis=3)
+        return jnp.where(out <= NEG / 2, 0.0, out).astype(x.dtype)
+
+    # lax.map (sequential over rois) bounds live memory at one roi's
+    # [C, oh, H, W] mask product instead of R of them
+    return jax.lax.map(one_roi, (boxes, img_of))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference prior_box): one box per
+    (feature cell, size/aspect combo), normalized (x1,y1,x2,y2) + per-box
+    variances."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    fh, fw = (int(input.shape[2]), int(input.shape[3]))
+    ih, iw = (int(image.shape[2]), int(image.shape[3]))
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for s, ms in enumerate(min_sizes):
+        whs = []
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[s])
+            if min_max_aspect_ratios_order:
+                whs.insert(1, (big, big))   # Caffe order: [min, max, ars]
+            else:
+                whs.append((big, big))      # default: [min, ars..., max]
+        boxes.append(whs)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = []
+    for y in cy:
+        row = []
+        for x_ in cx:
+            cell = []
+            for whs in boxes:
+                for (w, h) in whs:
+                    cell.append([(x_ - w / 2) / iw, (y - h / 2) / ih,
+                                 (x_ + w / 2) / iw, (y + h / 2) / ih])
+            row.append(cell)
+        out.append(row)
+    arr = np.asarray(out, np.float32)  # [fh, fw, P, 4]
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          arr.shape).copy()
+    return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """YOLOv3 head decode (reference yolo_box): raw feature map
+    [N, A*(5+C), H, W] -> boxes [N, H*W*A, 4] + scores [N, H*W*A, C]."""
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box iou_aware=True (the [N, A*(6+C), H, W] layout) is "
+            "not implemented")
+    return _yolo_box_impl(x, img_size, tuple(anchors), int(class_num),
+                          float(conf_thresh), int(downsample_ratio),
+                          bool(clip_bbox), float(scale_x_y))
+
+
+@tensor_op
+def _yolo_box_impl(x, img_size, anchors, class_num, conf_thresh,
+                   downsample_ratio, clip_bbox, scale_x_y):
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    v = x.reshape(N, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (gx + sig(v[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1.0) / 2.0) / W
+    by = (gy + sig(v[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1.0) / 2.0) / H
+    bw = jnp.exp(v[:, :, 2]) * an[None, :, 0, None, None] \
+        / (downsample_ratio * W)
+    bh = jnp.exp(v[:, :, 3]) * an[None, :, 1, None, None] \
+        / (downsample_ratio * H)
+    conf = sig(v[:, :, 4])
+    probs = sig(v[:, :, 5:]) * conf[:, :, None]
+    # to absolute pixel corners against per-image (h, w)
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    keep = conf > conf_thresh
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    probs = jnp.where(keep[..., None], jnp.moveaxis(probs, 2, -1), 0.0)
+    boxes = boxes.reshape(N, A * H * W, 4)
+    scores = probs.reshape(N, A * H * W, class_num)
+    return boxes, scores

@@ -141,3 +141,107 @@ class TestLuUnpack:
         assert L is None and U is None and P is not None
         P2, L2, U2 = paddle.lu_unpack(lu_mat, piv, unpack_pivots=False)
         assert P2 is None and L2 is not None
+
+
+class TestDetectionOpsR4:
+    """roi_pool / prior_box / yolo_box (reference detection ops †)."""
+
+    def test_roi_pool_hand_checked_reference_quantization(self):
+        """Reference bins: roi span end-start+1 = 5, bin 2.5, cells
+        [floor(i*2.5), ceil((i+1)*2.5)) = [0,3) and [2,5) (overlapping)."""
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        boxes = np.asarray([[0., 0., 4., 4.]], np.float32)
+        out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                            output_size=2).numpy()
+        np.testing.assert_allclose(out.reshape(2, 2),
+                                   [[18., 20.], [34., 36.]])
+
+    def test_roi_pool_overflow_and_empty_guarded(self):
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        # roi extends past the 8x8 map: clamped, never the NEG sentinel
+        out = vops.roi_pool(paddle.to_tensor(x),
+                            paddle.to_tensor(
+                                np.asarray([[0., 0., 20., 20.]], np.float32)),
+                            output_size=3).numpy()
+        assert np.isfinite(out).all() and out.min() >= 0
+        assert out.max() == 63.0
+        # batch>1 without boxes_num must raise like roi_align
+        import pytest as _pt
+        with _pt.raises(ValueError, match="boxes_num"):
+            vops.roi_pool(paddle.to_tensor(np.zeros((2, 1, 8, 8),
+                                                    np.float32)),
+                          paddle.to_tensor(
+                              np.asarray([[0., 0., 2., 2.]], np.float32)))
+
+    def test_roi_pool_batched_with_boxes_num(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        boxes = np.asarray([[0, 0, 8, 8], [2, 2, 6, 6], [0, 0, 4, 4]],
+                           np.float32)
+        out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           boxes_num=paddle.to_tensor(
+                               np.asarray([2, 1], np.int32)),
+                           output_size=2).numpy()
+        assert out.shape == (3, 3, 2, 2)
+        # roi 2 reads image 1; reference cell (1,1) spans rows/cols [2,5)
+        np.testing.assert_allclose(out[2, :, 1, 1],
+                                   x[1, :, 2:5, 2:5].max(axis=(1, 2)))
+
+    def test_prior_box_shapes_and_geometry(self):
+        feat = paddle.to_tensor(np.zeros((1, 3, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        pb, pv = vops.prior_box(feat, img, min_sizes=[8.0],
+                               aspect_ratios=[2.0], flip=True)
+        assert pb.shape == [4, 4, 3, 4] and pv.shape == [4, 4, 3, 4]
+        b = pb.numpy()
+        # first prior of cell (0,0): square of size 8 centered at 4px
+        np.testing.assert_allclose(
+            b[0, 0, 0], [0.0, 0.0, 8 / 32, 8 / 32], atol=1e-6)
+        # aspect-2 prior is wider than tall
+        ar2 = b[0, 0, 1]
+        assert (ar2[2] - ar2[0]) > (ar2[3] - ar2[1])
+        # variances broadcast the given 4-vector
+        np.testing.assert_allclose(pv.numpy()[2, 3, 1],
+                                   [0.1, 0.1, 0.2, 0.2])
+        # max-size prior position honors min_max_aspect_ratios_order:
+        # default False -> [min, ars..., max]; True -> [min, max, ars...]
+        pb_f, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0])
+        pb_t, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0],
+                                 min_max_aspect_ratios_order=True)
+        big = np.sqrt(8.0 * 16.0) / 32
+        bf, bt = pb_f.numpy()[0, 0], pb_t.numpy()[0, 0]
+        np.testing.assert_allclose(bf[-1][2] - bf[-1][0], big, atol=1e-6)
+        np.testing.assert_allclose(bt[1][2] - bt[1][0], big, atol=1e-6)
+
+    def test_yolo_box_iou_aware_rejected(self):
+        import pytest as _pt
+        with _pt.raises(NotImplementedError, match="iou_aware"):
+            vops.yolo_box(paddle.to_tensor(np.zeros((1, 27, 4, 4),
+                                                    np.float32)),
+                          paddle.to_tensor(np.asarray([[64, 64]], np.int32)),
+                          anchors=[10, 13, 16, 30, 33, 23], class_num=4,
+                          iou_aware=True)
+
+    def test_yolo_box_decode_properties(self):
+        rng = np.random.RandomState(1)
+        A, C, H, W = 3, 4, 4, 4
+        x = rng.randn(2, A * (5 + C), H, W).astype(np.float32)
+        img_size = np.asarray([[64, 64], [32, 48]], np.int32)
+        b, s = vops.yolo_box(paddle.to_tensor(x),
+                            paddle.to_tensor(img_size),
+                            anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+                            conf_thresh=0.0)
+        assert b.shape == [2, A * H * W, 4] and s.shape == [2, A * H * W, C]
+        bn, sn = b.numpy(), s.numpy()
+        # clipped into each image's pixel bounds
+        assert bn[0].min() >= 0 and bn[0, :, [0, 2]].max() <= 63
+        assert bn[1, :, [1, 3]].max() <= 31 and bn[1, :, [0, 2]].max() <= 47
+        # scores are sigmoid(conf)*sigmoid(cls) in [0, 1]
+        assert sn.min() >= 0 and sn.max() <= 1
+        # high conf_thresh zeroes everything
+        b0, s0 = vops.yolo_box(paddle.to_tensor(x),
+                              paddle.to_tensor(img_size),
+                              anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+                              conf_thresh=1.1)
+        assert float(np.abs(b0.numpy()).max()) == 0.0
