@@ -1442,8 +1442,11 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
         return xr.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * r * r,
                                                       H // r, W // r)
     B, H, W, C = x.shape
+    # channel-last kernel emits (c, ry, rx) channel order — same per-pixel
+    # ordering as the NCHW branch, so the two layouts are transposes of
+    # each other (advisor r4: (ry, rx, c) here was silently wrong)
     xr = x.reshape(B, H // r, r, W // r, r, C)
-    return xr.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // r, W // r,
+    return xr.transpose(0, 1, 3, 5, 2, 4).reshape(B, H // r, W // r,
                                                   C * r * r)
 
 

@@ -186,9 +186,13 @@ def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
 
 @tensor_op(differentiable=False)
 def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
-    return (jnp.right_shift(x, y) if is_arithmetic
-            else jnp.right_shift(x.view(jnp.uint32) if x.dtype == jnp.int32
-                                 else x, y))
+    if is_arithmetic or not jnp.issubdtype(x.dtype, jnp.signedinteger):
+        return jnp.right_shift(x, y)
+    # logical shift: reinterpret any signed dtype as its same-width
+    # unsigned counterpart so the shift fills with zeros, then view back
+    # (advisor r4: the int32-only special case sign-extended int8/16/64)
+    u = jnp.dtype(f"uint{x.dtype.itemsize * 8}")
+    return jnp.right_shift(x.view(u), y.astype(u)).view(x.dtype)
 
 
 @tensor_op

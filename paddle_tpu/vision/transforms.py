@@ -282,8 +282,10 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         else (center[1], center[0])
     c, s = np.cos(theta), np.sin(theta)
     if expand:
-        oh = int(np.ceil(abs(h * c) + abs(w * s)))
-        ow = int(np.ceil(abs(w * c) + abs(h * s)))
+        # epsilon before ceil: cos(90deg) is ~6e-17, not 0, and without it
+        # a right-angle rotation grows the canvas by a phantom pixel
+        oh = int(np.ceil(abs(h * c) + abs(w * s) - 1e-9))
+        ow = int(np.ceil(abs(w * c) + abs(h * s) - 1e-9))
         ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
     else:
         oh, ow, ocy, ocx = h, w, cy, cx
@@ -444,13 +446,14 @@ class RandomRotation(BaseTransform):
             degrees = (-degrees, degrees)
         self.degrees = degrees
         self.interpolation = interpolation
+        self.expand = expand
         self.center = center
         self.fill = fill
 
     def _apply_image(self, img):
         angle = random.uniform(*self.degrees)
-        return rotate(img, angle, self.interpolation, center=self.center,
-                      fill=self.fill)
+        return rotate(img, angle, self.interpolation, expand=self.expand,
+                      center=self.center, fill=self.fill)
 
 
 class RandomErasing(BaseTransform):

@@ -65,6 +65,16 @@ class TestShapeOps:
         np.testing.assert_allclose(F.pixel_unshuffle(_t(x), 2).numpy(),
                                    want)
 
+    def test_pixel_unshuffle_nhwc_matches_nchw(self):
+        # advisor r4: the NHWC branch emitted (ry, rx, c) channel order —
+        # the channel-last kernel orders channels (c, ry, rx), identical
+        # per-pixel values to the NCHW branch
+        x = np.random.RandomState(7).randn(2, 3, 8, 8).astype(np.float32)
+        nchw = F.pixel_unshuffle(_t(x), 2).numpy()
+        nhwc = F.pixel_unshuffle(_t(x.transpose(0, 2, 3, 1)), 2,
+                                 data_format="NHWC").numpy()
+        np.testing.assert_allclose(nhwc.transpose(0, 3, 1, 2), nchw)
+
     def test_temporal_shift(self):
         x = np.random.RandomState(6).randn(4, 8, 2, 2).astype(np.float32)
         got = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()

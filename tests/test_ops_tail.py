@@ -213,6 +213,16 @@ class TestTailOps:
             paddle.bitwise_right_shift(_t(a), _t(np.int32(1))).numpy(),
             a >> 1)
         assert paddle.isreal(_t(np.ones(3, np.float32))).numpy().all()
+        # logical shift zero-fills for EVERY signed width (advisor r4: only
+        # int32 was reinterpreted; int8/int16/int64 sign-extended)
+        for dt in (np.int8, np.int16, np.int32):  # int64->int32 (no x64)
+            neg = np.asarray([-8, -1, 5], dt)
+            got = paddle.bitwise_right_shift(
+                _t(neg), _t(dt(1)), is_arithmetic=False).numpy()
+            bits = neg.dtype.itemsize * 8
+            udt = np.dtype(f"uint{bits}")
+            want = (neg.view(udt) >> udt.type(1)).view(neg.dtype)
+            np.testing.assert_array_equal(got, want)
         np.testing.assert_array_equal(
             paddle.isin(_t(a), _t(np.asarray([2, 4], np.int32))).numpy(),
             np.isin(a, [2, 4]))

@@ -86,6 +86,12 @@ class TestGeometry:
         out = T.RandomRotation(0.0)(img)  # zero range = identity
         np.testing.assert_array_equal(out, img)
 
+    def test_random_rotation_forwards_expand(self):
+        # advisor r4: expand=True was accepted but silently dropped
+        img = _img(10, h=8, w=16)
+        out = T.RandomRotation((90, 90), expand=True)(img)
+        assert out.shape[:2] == (16, 8), out.shape
+
     def test_random_erasing(self):
         img = np.full((16, 16, 3), 200, np.uint8)
         out = T.RandomErasing(prob=1.0, value=0)(img)
