@@ -53,6 +53,7 @@ from . import metric  # noqa: E402
 from . import callbacks  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
+from . import geometric  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402

@@ -8,12 +8,22 @@ import jax.numpy as jnp
 from .ops._op import tensor_op
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
-           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
-           "rfftfreq", "fftshift", "ifftshift"]
+           "rfft2", "irfft2", "hfft2", "ihfft2", "fftn", "ifftn", "rfftn",
+           "irfftn", "hfftn", "ihfftn", "fftfreq", "rfftfreq", "fftshift",
+           "ifftshift"]
 
 
 def _norm(norm):
     return None if norm in (None, "backward") else norm
+
+
+def _swap_norm(norm):
+    """Hermitian transforms are built on the conjugate C2R/R2C identities
+    hfft(x) = irfft(conj(x)) with the norm direction swapped (and ihfft
+    the converse) — numpy's own 1-D hfft/ihfft definition, extended to
+    2/n-D the way the reference's fft_c2r/fft_r2c kernels † are."""
+    return {"backward": "forward", "forward": "backward",
+            None: "forward"}.get(norm, norm)
 
 
 def _mk1(jfn):
@@ -51,6 +61,26 @@ fftn = _mkn(jnp.fft.fftn)
 ifftn = _mkn(jnp.fft.ifftn)
 rfftn = _mkn(jnp.fft.rfftn)
 irfftn = _mkn(jnp.fft.irfftn)
+
+
+@tensor_op(name="fft.hfft2")
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(jnp.conj(x), s=s, axes=axes, norm=_swap_norm(norm))
+
+
+@tensor_op(name="fft.ihfft2")
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.conj(jnp.fft.rfft2(x, s=s, axes=axes, norm=_swap_norm(norm)))
+
+
+@tensor_op(name="fft.hfftn")
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes, norm=_swap_norm(norm))
+
+
+@tensor_op(name="fft.ihfftn")
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes, norm=_swap_norm(norm)))
 
 
 @tensor_op(name="fft.fftfreq")

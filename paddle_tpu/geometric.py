@@ -1,0 +1,114 @@
+"""paddle.geometric — graph message-passing and segment ops (reference:
+``python/paddle/geometric/`` wrapping the graph_send_recv / segment_pool
+CUDA kernels †).
+
+TPU-native design: every op lowers to ``jax.ops.segment_*`` — XLA compiles
+these to sorted-scatter reductions that vectorize on the VPU — instead of
+the reference's atomic-add CUDA kernels (atomics don't exist on TPU; the
+scatter-reduce HLO is the idiomatic equivalent).
+
+Segment/out sizes are shapes, so they must be concrete. The row count is
+inferred from the (eager, concrete) index data BEFORE the op enters the
+autograd tracer, then passed into the jnp body as a static python int —
+under a jit trace the indices are abstract, so pass ``out_size``
+explicitly (send_* ops; the same constraint the reference's static mode
+solves with an ``out_size`` input).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops._op import tensor_op, unwrap
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    try:
+        return int(jnp.max(jnp.asarray(unwrap(ids)))) + 1
+    except (jax.errors.ConcretizationTypeError, jax.errors.TracerArrayConversionError, TypeError) as e:
+        raise ValueError(
+            "segment/send ops need a concrete output row count: under "
+            "jit, pass out_size= explicitly (eager mode infers it from "
+            "the indices)") from e
+
+
+def _segment(data, ids, n, kind):
+    ids = jnp.asarray(ids, jnp.int32)
+    if kind == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=n)
+    counts = jax.ops.segment_sum(jnp.ones_like(ids, data.dtype), ids,
+                                 num_segments=n)
+    counts = counts.reshape((n,) + (1,) * (data.ndim - 1))
+    if kind == "mean":
+        return jax.ops.segment_sum(data, ids, num_segments=n) \
+            / jnp.maximum(counts, 1)
+    red = jax.ops.segment_max if kind == "max" else jax.ops.segment_min
+    out = red(data, ids, num_segments=n)
+    # reference contract: rows no edge points at are 0, not +/-inf
+    return jnp.where(counts > 0, out, jnp.zeros_like(out))
+
+
+def _seg_op(kind):
+    @tensor_op(name=f"geometric.segment_{kind}")
+    def impl(data, segment_ids, n):
+        return _segment(data, segment_ids, n, kind)
+
+    def op(data, segment_ids, name=None):
+        return impl(data, segment_ids, _num_segments(segment_ids, None))
+
+    op.__name__ = op.__qualname__ = f"segment_{kind}"
+    op.__doc__ = (f"Segment {kind} over sorted non-negative segment ids "
+                  f"(reference segment_pool kernel †).")
+    return op
+
+
+segment_sum = _seg_op("sum")
+segment_mean = _seg_op("mean")
+segment_max = _seg_op("max")
+segment_min = _seg_op("min")
+
+
+_MSG_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+
+
+@tensor_op(name="geometric.send_u_recv")
+def _send_u_recv_impl(x, src_index, dst_index, reduce_op, n):
+    msg = jnp.take(x, jnp.asarray(src_index, jnp.int32), axis=0)
+    return _segment(msg, dst_index, n, reduce_op)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather ``x`` rows at ``src_index`` and reduce them into
+    ``dst_index`` rows (reference graph_send_recv kernel †)."""
+    return _send_u_recv_impl(x, src_index, dst_index, reduce_op,
+                             _num_segments(dst_index, out_size))
+
+
+@tensor_op(name="geometric.send_ue_recv")
+def _send_ue_recv_impl(x, y, src_index, dst_index, message_op, reduce_op, n):
+    msg = _MSG_OPS[message_op](
+        jnp.take(x, jnp.asarray(src_index, jnp.int32), axis=0), y)
+    return _segment(msg, dst_index, n, reduce_op)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node-feature gather combined with edge features ``y`` (one row per
+    edge) by ``message_op``, then reduced into ``dst_index`` rows."""
+    return _send_ue_recv_impl(x, y, src_index, dst_index, message_op,
+                              reduce_op, _num_segments(dst_index, out_size))
+
+
+@tensor_op(name="geometric.send_uv")
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message: ``x[src] (op) y[dst]`` — no reduction."""
+    return _MSG_OPS[message_op](
+        jnp.take(x, jnp.asarray(src_index, jnp.int32), axis=0),
+        jnp.take(y, jnp.asarray(dst_index, jnp.int32), axis=0))

@@ -1282,6 +1282,67 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 
 
 @tensor_op
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """Focal loss on sigmoid logits (reference
+    ``python/paddle/nn/functional/loss.py`` sigmoid_focal_loss †):
+    FL = -alpha_t (1 - p_t)^gamma log(p_t), computed in log-space via
+    log_sigmoid so large negative logits don't underflow."""
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1.0 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    a_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference
+    ``python/paddle/nn/functional/activation.py``
+    adaptive_log_softmax_with_loss †): frequent classes score in a small
+    head matmul; rare classes live in cluster tails whose log-prob chains
+    through the head's cluster logit.
+
+    TPU-first shape discipline: every example computes EVERY cluster's
+    gather (masked where it doesn't apply) instead of the reference's
+    per-cluster index_select loop — no data-dependent shapes under jit.
+    Returns (per-example log-prob of its own label, mean NLL loss)."""
+    flat = [w for pair in tail_weights for w in pair]
+    return _adaptive_lsm_impl(input, label, head_weight, head_bias,
+                              tuple(int(c) for c in cutoffs), *flat)
+
+
+@tensor_op
+def _adaptive_lsm_impl(input, label, head_weight, head_bias, cutoffs,
+                       *tail_weights):
+    n_clusters = len(cutoffs)
+    shortlist = cutoffs[0]
+    head = input @ head_weight + (head_bias if head_bias is not None else 0.0)
+    head_lp = jax.nn.log_softmax(head, axis=-1)   # [N, shortlist+n_clusters]
+    lab = label.astype(jnp.int32)
+    # shortlist branch: label's own head log-prob
+    out = jnp.take_along_axis(
+        head_lp, jnp.clip(lab, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+    lo = shortlist
+    for i, (w1, w2) in enumerate(zip(tail_weights[::2], tail_weights[1::2])):
+        hi = cutoffs[i + 1] if i + 1 < n_clusters else None
+        hi = hi if hi is not None else lo + w2.shape[1]
+        in_tail = (lab >= lo) & (lab < hi)
+        # low-rank tail projection: [N,H] @ [H,r] @ [r,cluster_size]
+        tail_lp = jax.nn.log_softmax((input @ w1) @ w2, axis=-1)
+        rel = jnp.clip(lab - lo, 0, w2.shape[1] - 1)
+        cluster_lp = head_lp[:, shortlist + i] + jnp.take_along_axis(
+            tail_lp, rel[:, None], axis=1)[:, 0]
+        out = jnp.where(in_tail, cluster_lp, out)
+        lo = hi
+    return out, -jnp.mean(out)
+
+
+@tensor_op
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     sim = anchor @ positive.T  # [N, N]
     lab = labels.reshape(-1)

@@ -211,9 +211,47 @@ def bernoulli(x, name=None):
     return Tensor((jax.random.uniform(random_mod.next_key(), v.shape) < v).astype(v.dtype))
 
 
+def standard_gamma(x, name=None):
+    """Gamma(alpha=x, scale=1) samples, one per element (reference
+    paddle.standard_gamma †)."""
+    v = unwrap(x)
+    return Tensor(jax.random.gamma(random_mod.next_key(), v).astype(v.dtype))
+
+
+def binomial(count, prob, name=None):
+    """Binomial(n, p) samples with elementwise-broadcast n/p (reference
+    paddle.binomial †, int64 output — int32 here, x64 is disabled)."""
+    n = unwrap(count)
+    p = unwrap(prob)
+    n, p = jnp.broadcast_arrays(jnp.asarray(n), jnp.asarray(p))
+    out = jax.random.binomial(random_mod.next_key(), n.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return Tensor(out.astype(dtype_mod.long_dtype()))
+
+
 def _shape(shape):
     if isinstance(shape, Tensor):
         return tuple(int(s) for s in np.asarray(shape.value))
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(unwrap(s)) for s in shape)
+
+
+def _register_creation_ops():
+    """Creation/random functions are plain functions (their shape args are
+    static, not tensors, so the tensor_op tracer adds nothing), but they
+    ARE framework ops in the reference's registry (``full``, ``arange``,
+    ``uniform`` etc. each have an OpMaker †) — record them so the op
+    registry reflects the real surface."""
+    from ._op import OP_REGISTRY
+    for name in ("to_tensor", "zeros", "ones", "full", "empty",
+                 "zeros_like", "ones_like", "full_like", "empty_like",
+                 "arange", "linspace", "logspace", "eye", "diag",
+                 "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+                 "rand", "randn", "standard_normal", "normal", "uniform",
+                 "randint", "randperm", "multinomial", "bernoulli",
+                 "standard_gamma", "binomial"):
+        OP_REGISTRY.setdefault(name, globals()[name])
+
+
+_register_creation_ops()

@@ -59,7 +59,9 @@ def _inplace_of(fn, name):
 
 
 def _install():
-    from . import extra, manipulation, math
+    # tail/creation imported for their OP_REGISTRY side effects (gammaln
+    # family, tril/triu) — ops/__init__ imports this module before them
+    from . import creation, extra, manipulation, math, tail  # noqa: F401
     from .math import clip as _clip
 
     sources = {
@@ -93,6 +95,15 @@ def _install():
         "put_along_axis_": manipulation.put_along_axis,
         "renorm_": extra.renorm,
     }
+    # the reference's 2.6-era inplace batch (trig/log/special/triangular —
+    # same ``x.op_()`` generated surface in python/paddle/tensor/math.py †)
+    for base in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+                 "cosh", "asinh", "acosh", "atanh", "expm1", "log", "log2",
+                 "log10", "log1p", "digamma", "lgamma", "i0", "gammaln",
+                 "gammainc", "gammaincc", "hypot", "ldexp", "copysign"):
+        sources[base + "_"] = OP_REGISTRY[base]
+    sources["tril_"] = creation.tril
+    sources["triu_"] = creation.triu
     import sys
     mod = sys.modules[__name__]
     for name, fn in sources.items():
@@ -154,18 +165,101 @@ def _exponential_sample(x, lam=1.0, name=None):
 exponential_ = _random_refill("exponential_", _exponential_sample)
 
 
+def _bernoulli_sample(x, p=0.5, name=None):
+    import jax
+
+    from ..core import random as random_mod
+    u = jax.random.uniform(random_mod.next_key(), tuple(x.shape))
+    return Tensor((u < p).astype(x.dtype))
+
+
+def _cauchy_sample(x, loc=0, scale=1, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+    u = jax.random.uniform(random_mod.next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    return Tensor((loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x.dtype))
+
+
+def _geometric_sample(x, probs, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+    u = jax.random.uniform(random_mod.next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0)
+    # paddle.Tensor.geometric_: number of Bernoulli(p) trials to first
+    # success (support starts at 1)
+    return Tensor(jnp.ceil(jnp.log(u) / jnp.log1p(-probs)).astype(x.dtype))
+
+
+def _log_normal_sample(x, mean=1.0, std=2.0, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+    z = jax.random.normal(random_mod.next_key(), tuple(x.shape))
+    return Tensor(jnp.exp(mean + std * z).astype(x.dtype))
+
+
+bernoulli_ = _random_refill("bernoulli_", _bernoulli_sample)
+cauchy_ = _random_refill("cauchy_", _cauchy_sample)
+geometric_ = _random_refill("geometric_", _geometric_sample)
+log_normal_ = _random_refill("log_normal_", _log_normal_sample)
+
+
+def _fill_value(x, value, name=None):
+    import jax.numpy as jnp
+    return Tensor(jnp.full(tuple(x.shape), value, dtype=x.value.dtype))
+
+
+def _zero_value(x, name=None):
+    import jax.numpy as jnp
+    return Tensor(jnp.zeros(tuple(x.shape), dtype=x.value.dtype))
+
+
+# deterministic whole-tensor refills: every output entry is independent of
+# the previous contents, so severing the grad history (refill semantics)
+# is exactly the reference's non-differentiable fill_/zero_ kernels †
+fill_ = _random_refill("fill_", _fill_value)
+zero_ = _random_refill("zero_", _zero_value)
+
+
+def where_(condition, x, y, name=None):
+    """Inplace where: mutates ``x`` (the reference's Tensor.where_ † —
+    note the mutated operand is the SECOND argument)."""
+    from .manipulation import where as _where
+    if not isinstance(x, Tensor):
+        raise TypeError(f"where_ mutates a Tensor, got {type(x).__name__}")
+    return _rebind(x, _where(condition, graph_alias(x), y))
+
+
+def _tensor_where_(self, condition, y, name=None):
+    return where_(condition, self, y)
+
+
+__all__.append("where_")
+OP_REGISTRY.setdefault("where_", where_)
+if not hasattr(Tensor, "where_"):
+    Tensor.where_ = _tensor_where_
+
+
 def _install_fill_diagonal():
     # differentiable inplace (unlike the random refills, grads must keep
     # flowing through the untouched entries — paddle has a grad kernel
     # for fill_diagonal_)
-    from .tail import fill_diagonal
-    ip = _inplace_of(fill_diagonal, "fill_diagonal_")
+    from .tail import fill_diagonal, fill_diagonal_tensor
     import sys
-    setattr(sys.modules[__name__], "fill_diagonal_", ip)
-    __all__.append("fill_diagonal_")
-    OP_REGISTRY.setdefault("fill_diagonal_", ip)
-    if not hasattr(Tensor, "fill_diagonal_"):
-        Tensor.fill_diagonal_ = ip
+    for base, name in ((fill_diagonal, "fill_diagonal_"),
+                       (fill_diagonal_tensor, "fill_diagonal_tensor_")):
+        ip = _inplace_of(base, name)
+        setattr(sys.modules[__name__], name, ip)
+        __all__.append(name)
+        OP_REGISTRY.setdefault(name, ip)
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, ip)
 
 
 _install_fill_diagonal()

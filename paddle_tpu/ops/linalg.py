@@ -179,6 +179,69 @@ def svd_lowrank(x, q=6, niter=2, M=None):
     return _T(u[..., :k]), _T(s[..., :k]), _T(jnp.swapaxes(vt, -2, -1)[..., :k])
 
 
+@tensor_op
+def cholesky_inverse(x, upper=False):
+    """Inverse of A from its Cholesky factor x (reference
+    paddle.linalg.cholesky_inverse †): one cho_solve against I — no
+    explicit inverse-of-triangular round trip."""
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    return jax.scipy.linalg.cho_solve((x, not upper), eye)
+
+
+@tensor_op
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply ``y`` by the orthogonal Q implied by the Householder
+    reflectors ``(x, tau)`` (geqrf output; reference paddle.linalg.ormqr
+    †) WITHOUT materializing Q: each reflector applies as a rank-1
+    update — k small matmuls instead of an m x m product."""
+    k = tau.shape[-1]
+    m = x.shape[-2]
+    idx = jnp.arange(m)
+
+    def apply_h(i, v_y, right_side):
+        # v_i = [0..0, 1, x[i+1:, i]]
+        col = x[..., :, i]
+        v = jnp.where(idx < i, 0.0, jnp.where(idx == i, 1.0, col))
+        t = tau[..., i]
+        if right_side:  # y <- y - (y v) tau v^T
+            yv = jnp.einsum("...nk,...k->...n", v_y, v)
+            return v_y - t[..., None, None] * yv[..., :, None] * v[..., None, :]
+        vy = jnp.einsum("...m,...mk->...k", v, v_y)
+        return v_y - t[..., None, None] * v[..., :, None] * vy[..., None, :]
+
+    # Q = H_0 H_1 ... H_{k-1}; application order follows from which side
+    # and whether Q is transposed (H_i are symmetric for real tau/v)
+    if left:
+        order = range(k) if transpose else range(k - 1, -1, -1)
+        out = y
+        for i in order:
+            out = apply_h(i, out, right_side=False)
+        return out
+    order = range(k - 1, -1, -1) if transpose else range(k)
+    out = y
+    for i in order:
+        out = apply_h(i, out, right_side=True)
+    return out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Rank-q PCA (reference paddle.linalg.pca_lowrank †): optional
+    centering, then svd_lowrank's exact-SVD-then-truncate path."""
+    from ._op import unwrap
+    v = jnp.asarray(unwrap(x))
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    k = min(6, v.shape[-2], v.shape[-1]) if q is None else int(q)
+    return svd_lowrank(v, q=k, niter=niter)
+
+
 # reference exposes these under paddle.linalg as well as paddle.*
 from .extra import (cholesky_solve, eigvals, householder_product, inv, lu,  # noqa: E402
                     lu_unpack, multi_dot)
+
+
+# plain-function ops (static args) recorded in the registry like the
+# creation family — real reference surface, not tensor_op-traced
+from ._op import OP_REGISTRY as _REG  # noqa: E402
+_REG.setdefault("svd_lowrank", svd_lowrank)
+_REG.setdefault("pca_lowrank", pca_lowrank)

@@ -158,6 +158,25 @@ def addmm(input, x, y, beta=1.0, alpha=1.0):
 
 
 @tensor_op
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    """Batched addmm: beta*input + alpha*(x @ y) over [B, M, K] x [B, K, N]
+    (reference paddle.baddbmm †)."""
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@tensor_op
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference paddle.reduce_as † — the
+    broadcast-adjoint reduction)."""
+    tshape = tuple(target.shape)
+    lead = x.ndim - len(tshape)
+    axes = tuple(range(lead)) + tuple(
+        lead + i for i, t in enumerate(tshape) if t == 1 and x.shape[lead + i] != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)
+
+
+@tensor_op
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
     return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
 

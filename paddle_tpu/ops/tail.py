@@ -15,17 +15,16 @@ __all__ = [
     "atleast_1d", "atleast_2d", "atleast_3d", "block_diag",
     # diagonal / windows (diagflat lives in ops/creation.py)
     "diagonal_scatter", "slice_scatter", "as_strided",
-    "unfold", "view", "fill_diagonal",
+    "unfold", "view", "fill_diagonal", "fill_diagonal_tensor",
     # cumulative / extremes
     "cummax", "cummin",
     # scalar math tail
     "bitwise_left_shift", "bitwise_right_shift", "gammaln", "gammainc",
     "gammaincc", "multigammaln", "isreal", "positive", "negative",
-    "logaddexp2", "erfc", "xlogy", "sinc_pi", "cosine_similarity_flat",
+    "logaddexp2", "erfc", "xlogy",
     "cumulative_trapezoid", "histogramdd", "histogram_bin_edges",
     # misc paddle base ops
-    "increment", "clip_by_norm", "crop", "moveaxis_single", "rot90_k",
-    "flip_lr", "flip_ud", "take_diag", "trace_offset", "count_unique",
+    "increment", "clip_by_norm", "crop",
 ]
 
 
@@ -102,6 +101,15 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
     idx = (jnp.arange(n),) * x.ndim
     mask = jnp.zeros(x.shape, bool).at[idx].set(True)
     return jnp.where(mask, v, x)
+
+
+@tensor_op
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal of ``x``
+    (reference paddle.fill_diagonal_tensor † — same scatter as
+    diagonal_scatter with paddle's dim naming)."""
+    return diagonal_scatter.raw_fn(x, y, offset=offset, axis1=dim1,
+                                   axis2=dim2)
 
 
 @tensor_op
@@ -248,18 +256,6 @@ def xlogy(x, y, name=None):
 
 
 @tensor_op
-def sinc_pi(x, name=None):
-    return jnp.sinc(x)
-
-
-@tensor_op
-def cosine_similarity_flat(x, y, eps=1e-8, name=None):
-    nx = jnp.maximum(jnp.linalg.norm(x, axis=-1), eps)
-    ny = jnp.maximum(jnp.linalg.norm(y, axis=-1), eps)
-    return jnp.sum(x * y, axis=-1) / (nx * ny)
-
-
-@tensor_op
 def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
     ym = jnp.moveaxis(y, axis, -1)
     mids = (ym[..., 1:] + ym[..., :-1]) / 2.0
@@ -304,42 +300,6 @@ def crop(x, shape=None, offsets=None, name=None):
     shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
     idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
-
-
-@tensor_op
-def moveaxis_single(x, source, destination, name=None):
-    return jnp.moveaxis(x, source, destination)
-
-
-@tensor_op
-def rot90_k(x, k=1, axes=(0, 1), name=None):
-    return jnp.rot90(x, k=k, axes=axes)
-
-
-@tensor_op
-def flip_lr(x, name=None):
-    return jnp.fliplr(x)
-
-
-@tensor_op
-def flip_ud(x, name=None):
-    return jnp.flipud(x)
-
-
-@tensor_op
-def take_diag(x, offset=0, name=None):
-    return jnp.diag(x, k=offset)
-
-
-@tensor_op
-def trace_offset(x, offset=0, axis1=0, axis2=1, name=None):
-    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
-
-
-@tensor_op(differentiable=False)
-def count_unique(x, name=None):
-    _, counts = jnp.unique(x, return_counts=True, size=x.size)
-    return jnp.sum(counts > 0)
 
 
 @tensor_op(differentiable=False)

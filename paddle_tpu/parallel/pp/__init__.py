@@ -118,6 +118,64 @@ def pipeline_spmd(stage_fn, stacked_params, x, *, num_microbatches,
         M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
 
 
+def _pack_stages(params_tuple):
+    """Pack arbitrary per-stage pytrees into per-dtype flat buffers.
+
+    Returns (bufs, metas): ``bufs[dtype_key]`` is [S, L_dtype] (each row a
+    stage's concatenated raveled leaves of that dtype, zero-padded to the
+    longest stage); ``metas[i]`` rebuilds stage i's pytree from row i via
+    static (offset, shape) slices. Non-array leaves (python scalars /
+    config values) stay static in the meta. Differentiable end-to-end:
+    ravel/concat/stack adjoints are slices, so grads land back on the
+    caller's original per-stage leaves."""
+    import numpy as np
+
+    metas = []
+    stage_bufs = []          # per stage: dtype_key -> 1-D array
+    for p in params_tuple:
+        leaves, treedef = jax.tree.flatten(p)
+        parts = {}
+        meta_leaves = []
+        for leaf in leaves:
+            if not isinstance(leaf, (jnp.ndarray, np.ndarray)):
+                meta_leaves.append(("static", leaf))
+                continue
+            arr = jnp.asarray(leaf)
+            key = str(arr.dtype)
+            off = sum(int(a.size) for a in parts.get(key, []))
+            parts.setdefault(key, []).append(arr.reshape(-1))
+            meta_leaves.append(("buf", key, off, tuple(arr.shape)))
+        stage_bufs.append({k: jnp.concatenate(v) for k, v in parts.items()})
+        metas.append((treedef, meta_leaves))
+    keys = sorted({k for b in stage_bufs for k in b})
+    bufs = {}
+    for k in keys:
+        lmax = max(int(b[k].size) if k in b else 0 for b in stage_bufs)
+        rows = []
+        for b in stage_bufs:
+            r = b.get(k, jnp.zeros((0,), dtype=jnp.dtype(k)))
+            rows.append(jnp.pad(r, (0, lmax - int(r.size))))
+        bufs[k] = jnp.stack(rows)
+    return bufs, metas
+
+
+def _unpack_stage(meta, bufs):
+    """Rebuild one stage's pytree from its per-dtype flat buffers using
+    the static layout recorded by _pack_stages."""
+    treedef, meta_leaves = meta
+    leaves = []
+    for m in meta_leaves:
+        if m[0] == "static":
+            leaves.append(m[1])
+        else:
+            _, key, off, shape = m
+            size = 1
+            for d in shape:
+                size *= d
+            leaves.append(bufs[key][off:off + size].reshape(shape))
+    return jax.tree.unflatten(treedef, leaves)
+
+
 def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
                   mesh=None, axis=PP_AXIS, remat=False):
     """Pipelined application of *heterogeneous* stages (general
@@ -132,10 +190,11 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
     dim sharded ``P('pp')`` — each device HOLDS only its own stage's
     weights, like the reference's per-rank PipelineLayer ownership †; only
     the fn dispatch remains a ``lax.switch``. Structurally heterogeneous
-    stages fall back to pp-replicated params (arbitrary per-stage pytrees
-    can't be mesh-sharded on a stage dim); gradients are correct either
-    way — shard_map's autodiff psums replicated-in cotangents over 'pp',
-    and sharded-in params keep per-shard cotangents.
+    stages (embed -> blocks -> head) get the same residency through
+    per-dtype flat packing: each stage's leaves ravel into zero-padded
+    [S, L] buffers sharded ``P('pp')``, and each branch statically
+    unpacks its own layout (no replication either way; grads flow back
+    through the pack's slice adjoints to the original leaves).
     """
     mesh = mesh if mesh is not None else mesh_mod.get_mesh()
     S = _pp_degree(mesh, axis)
@@ -179,16 +238,27 @@ def pipeline_1f1b(stage_fns, stage_params, x, *, num_microbatches,
             jax.tree.map(lambda _: P(axis), stacked), x,
             M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
 
-    def apply_switch(params_all, a):
+    # Structurally heterogeneous stages (embed -> blocks -> head): pack
+    # each stage's leaves into per-dtype flat buffers, zero-pad to the
+    # longest stage, and stack [S, L] sharded P('pp') — each device holds
+    # ONLY its own stage's bytes (reference per-rank PipelineLayer
+    # ownership †), and every branch statically unpacks ITS stage's
+    # (offset, shape) layout from the local buffer. This removes the r4
+    # fallback that replicated all stages' weights onto every device.
+    bufs, metas = _pack_stages(params_tuple)
+
+    def apply_packed(bufs_local, a):
         s = jax.lax.axis_index(axis)
+        mine = {k: b[0] for k, b in bufs_local.items()}
         branches = [
-            (lambda a, i=i: stage_fns[i](params_all[i], a)) for i in range(S)
+            (lambda a, i=i: stage_fns[i](_unpack_stage(metas[i], mine), a))
+            for i in range(S)
         ]
         return jax.lax.switch(s, branches, a)
 
     return _run_schedule(
-        apply_switch, params_tuple,
-        jax.tree.map(lambda _: P(), params_tuple), x,
+        apply_packed, bufs,
+        jax.tree.map(lambda _: P(axis), bufs), x,
         M=int(num_microbatches), S=S, mesh=mesh, axis=axis, remat=remat)
 
 

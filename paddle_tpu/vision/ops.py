@@ -15,17 +15,19 @@ from ..ops._op import tensor_op
 
 __all__ = ["nms", "box_iou", "box_area", "roi_align", "roi_pool",
            "box_coder", "distribute_fpn_proposals", "prior_box",
-           "yolo_box"]
+           "yolo_box", "deform_conv2d", "psroi_pool", "matrix_nms"]
 
 
-def _iou_matrix(boxes_a, boxes_b):
-    area_a = ((boxes_a[:, 2] - boxes_a[:, 0]) *
-              (boxes_a[:, 3] - boxes_a[:, 1]))
-    area_b = ((boxes_b[:, 2] - boxes_b[:, 0]) *
-              (boxes_b[:, 3] - boxes_b[:, 1]))
+def _iou_matrix(boxes_a, boxes_b, norm=0.0):
+    """Pairwise IoU; ``norm=1.0`` is the reference's un-normalized
+    (integer pixel) convention where spans are end - start + 1."""
+    area_a = ((boxes_a[:, 2] - boxes_a[:, 0] + norm) *
+              (boxes_a[:, 3] - boxes_a[:, 1] + norm))
+    area_b = ((boxes_b[:, 2] - boxes_b[:, 0] + norm) *
+              (boxes_b[:, 3] - boxes_b[:, 1] + norm))
     lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
     rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
-    wh = jnp.clip(rb - lt, 0)
+    wh = jnp.clip(rb - lt + norm, 0)
     inter = wh[..., 0] * wh[..., 1]
     return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
                                1e-9)
@@ -354,3 +356,189 @@ def _yolo_box_impl(x, img_size, anchors, class_num, conf_thresh,
     boxes = boxes.reshape(N, A * H * W, 4)
     scores = probs.reshape(N, A * H * W, class_num)
     return boxes, scores
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference deform_conv2d over the
+    deformable_conv CUDA kernel †). TPU formulation: the per-tap bilinear
+    sampling is four flat gathers (take_along_axis) and the convolution
+    itself collapses to one einsum over (in-channel, tap) — gathers feed
+    the MXU contraction instead of the reference's im2col+atomics.
+
+    x [B,Cin,H,W]; offset [B, 2*dg*kh*kw, Ho, Wo] laid out (group, tap,
+    (dy,dx)); mask [B, dg*kh*kw, Ho, Wo] enables the v2 modulated path."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    return _deform_conv2d_impl(x, offset, weight, bias, mask,
+                               sh, sw, ph, pw, dh, dw,
+                               int(deformable_groups), int(groups))
+
+
+@tensor_op
+def _deform_conv2d_impl(x, offset, weight, bias, mask, sh, sw, ph, pw,
+                        dh, dw, dg, groups):
+    B, Cin, H, W = x.shape
+    Cout, Cg, kh, kw = weight.shape
+    T = kh * kw
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    off = offset.reshape(B, dg, T, 2, Ho, Wo)
+    # sampling positions per (batch, dgroup, tap, out-pixel)
+    tap_dy = (jnp.arange(kh) * dh)[:, None].repeat(kw, 1).reshape(T)
+    tap_dx = (jnp.arange(kw) * dw)[None, :].repeat(kh, 0).reshape(T)
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None]
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :]
+    py = base_y[None, None, None] + tap_dy[None, None, :, None, None] \
+        + off[:, :, :, 0]
+    px = base_x[None, None, None] + tap_dx[None, None, :, None, None] \
+        + off[:, :, :, 1]                       # [B, dg, T, Ho, Wo]
+
+    Cd = Cin // dg
+    xg = x.reshape(B, dg, Cd, H * W)
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    out = 0.0
+    for cy, wy in ((y0, 1.0 - (py - y0)), (y0 + 1, py - y0)):
+        for cx, wx in ((x0, 1.0 - (px - x0)), (x0 + 1, px - x0)):
+            valid = (cy >= 0) & (cy < H) & (cx >= 0) & (cx < W)
+            idx = (jnp.clip(cy, 0, H - 1) * W
+                   + jnp.clip(cx, 0, W - 1)).astype(jnp.int32)
+            g = jnp.take_along_axis(
+                xg, idx.reshape(B, dg, 1, T * Ho * Wo), axis=-1)
+            w = jnp.where(valid, wy * wx, 0.0).reshape(B, dg, 1, T * Ho * Wo)
+            out = out + g * w.astype(x.dtype)
+    sampled = out.reshape(B, dg, Cd, T, Ho, Wo)
+    if mask is not None:  # v2 modulation, one scalar per (dgroup, tap)
+        sampled = sampled * mask.reshape(B, dg, 1, T, Ho, Wo).astype(x.dtype)
+    sampled = sampled.reshape(B, groups, Cin // groups, T, Ho, Wo)
+    wg = weight.reshape(groups, Cout // groups, Cg, T)
+    res = jnp.einsum("goct,bgcthw->bgohw", wg, sampled,
+                     preferred_element_type=jnp.float32)
+    res = res.reshape(B, Cout, Ho, Wo).astype(x.dtype)
+    if bias is not None:
+        res = res + bias.reshape(1, Cout, 1, 1)
+    return res
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool † — the R-FCN
+    head): input channel (c_out, i, j) average-pools bin (i, j) of each
+    roi. Same masked-mean static-shape scheme as _roi_pool_impl."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    if x.shape[1] % (oh * ow):
+        raise ValueError(
+            f"psroi_pool: channels {x.shape[1]} not divisible by "
+            f"output_size^2 {oh * ow}")
+    return _psroi_pool_impl(x, boxes, boxes_num, oh, ow,
+                            float(spatial_scale))
+
+
+@tensor_op
+def _psroi_pool_impl(x, boxes, boxes_num, oh, ow, spatial_scale):
+    N, C, H, W = x.shape
+    Co = C // (oh * ow)
+    R = boxes.shape[0]
+    img_of = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                        total_repeat_length=R)
+
+    def one_roi(args):
+        box, img = args
+        # reference: rounded corners, end exclusive at x2+1, min span 0.1
+        x1 = jnp.round(box[0]) * spatial_scale
+        y1 = jnp.round(box[1]) * spatial_scale
+        x2 = jnp.round(box[2] + 1.0) * spatial_scale
+        y2 = jnp.round(box[3] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h, bin_w = rh / oh, rw / ow
+        iy = jnp.arange(oh, dtype=jnp.float32)
+        ix = jnp.arange(ow, dtype=jnp.float32)
+        y0 = jnp.clip(jnp.floor(y1 + iy * bin_h), 0, H).astype(jnp.int32)
+        ye = jnp.clip(jnp.ceil(y1 + (iy + 1) * bin_h), 0, H).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(x1 + ix * bin_w), 0, W).astype(jnp.int32)
+        xe = jnp.clip(jnp.ceil(x1 + (ix + 1) * bin_w), 0, W).astype(jnp.int32)
+        ys, xs = jnp.arange(H), jnp.arange(W)
+        my = (ys[:, None] >= y0[None]) & (ys[:, None] < ye[None])  # [H,oh]
+        mx = (xs[:, None] >= x0[None]) & (xs[:, None] < xe[None])  # [W,ow]
+        feat = x[img].reshape(Co, oh, ow, H, W).astype(jnp.float32)
+        # bin (i,j) reads channel slice (c, i, j): mask both spatial dims
+        m = (my.T[None, :, None, :, None] * mx.T[None, None, :, None, :])
+        s = jnp.sum(feat * m, axis=(3, 4))
+        cnt = jnp.maximum(jnp.sum(m, axis=(3, 4)), 1e-9)
+        return (s / cnt).astype(x.dtype)                   # [Co, oh, ow]
+
+    return jax.lax.map(one_roi, (boxes, img_of))
+
+
+@tensor_op(differentiable=False)
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms †, the SOLOv2 parallel soft-NMS):
+    per class, every box's score decays by min_j f(iou_ij)/f(iou_max_j)
+    over higher-scored boxes j — one IoU matrix instead of the greedy
+    suppression loop, which is exactly the TPU-friendly formulation.
+
+    Static-shape contract (cf. nms above): per image the top
+    ``kk = min(keep_top_k, C * nms_top_k)`` rows (all candidates when
+    keep_top_k = -1) come back as [label, score, x1, y1, x2, y2] with
+    label = -1 on padding rows; out [N, kk, 6], index [N, kk] (flat
+    class*M+box, -1 pad), rois_num [N]."""
+    N, M, _ = bboxes.shape
+    C = scores.shape[1]
+    k = min(int(nms_top_k), M) if nms_top_k > 0 else M
+    # keep_top_k=-1 is the reference's keep-everything; also clamp to the
+    # candidate pool so small inputs under the default 200 don't fault
+    kk = min(int(keep_top_k), C * k) if keep_top_k > 0 else C * k
+    iou_norm = 0.0 if normalized else 1.0
+
+    def one_image(args):
+        box, sc = args                         # [M,4], [C,M]
+        cls_valid = jnp.arange(C) != background_label
+
+        def one_class(s):
+            vals, order = jax.lax.top_k(s, k)
+            sel = box[order]
+            iou = _iou_matrix(sel, sel, norm=iou_norm)
+            higher = jnp.tril(jnp.ones((k, k), bool), -1)  # j above i
+            iou = jnp.where(higher, iou, 0.0)
+            iou_max = jnp.max(iou, axis=1)     # compensation per j
+            if use_gaussian:
+                decay = jnp.exp((iou_max[None, :] ** 2 - iou ** 2)
+                                / gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / jnp.maximum(
+                    1.0 - iou_max[None, :], 1e-10)
+            decay = jnp.min(jnp.where(higher, decay, 1.0), axis=1)
+            new_s = jnp.where(vals > score_threshold, vals * decay, -1.0)
+            new_s = jnp.where(new_s > post_threshold, new_s, -1.0)
+            return new_s, order
+
+        cs, orders = jax.vmap(one_class)(sc)    # [C,k], [C,k]
+        cs = jnp.where(cls_valid[:, None], cs, -1.0)
+        flat_s = cs.reshape(-1)
+        top_s, top_i = jax.lax.top_k(flat_s, kk)
+        cls_of = (top_i // k).astype(jnp.float32)
+        box_of = jnp.take(orders.reshape(-1), top_i)
+        good = top_s > 0
+        out = jnp.concatenate(
+            [jnp.where(good, cls_of, -1.0)[:, None], top_s[:, None],
+             jnp.where(good[:, None], box[box_of], 0.0)], axis=1)
+        idx = jnp.where(good, cls_of.astype(jnp.int32) * M + box_of, -1)
+        return out, idx, jnp.sum(good.astype(jnp.int32))
+
+    out, idx, num = jax.lax.map(one_image, (bboxes, scores))
+    res = [out]
+    if return_index:
+        res.append(idx)
+    if return_rois_num:
+        res.append(num)
+    return tuple(res) if len(res) > 1 else out

@@ -1,0 +1,370 @@
+"""Round-5 surface batch: hermitian fft family, paddle.geometric, linalg
+tail (ormqr/cholesky_inverse/pca_lowrank), baddbmm/reduce_as, the 2.6-era
+inplace batch, random refills, fill_diagonal_tensor, sigmoid_focal_loss,
+adaptive_log_softmax_with_loss, deform_conv2d/psroi_pool/matrix_nms —
+every name checked against a torch/numpy oracle (reference:
+``python/paddle/tensor/``, ``python/paddle/geometric/``,
+``python/paddle/vision/ops.py`` †)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestHermitianFFT:
+    @pytest.mark.parametrize("norm", ["backward", "forward", "ortho"])
+    def test_hfft_family_matches_torch(self, norm):
+        rng = np.random.RandomState(0)
+        x = (rng.randn(4, 5, 6) + 1j * rng.randn(4, 5, 6)).astype(np.complex64)
+        xr = rng.randn(4, 5, 8).astype(np.float32)
+        for ours, theirs, arg in [
+                (paddle.fft.hfft2, torch.fft.hfft2, x),
+                (paddle.fft.ihfft2, torch.fft.ihfft2, xr),
+                (paddle.fft.hfftn, torch.fft.hfftn, x),
+                (paddle.fft.ihfftn, torch.fft.ihfftn, xr)]:
+            np.testing.assert_allclose(
+                ours(_t(arg), norm=norm).numpy(),
+                theirs(torch.tensor(arg), norm=norm).numpy(),
+                rtol=2e-4, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_reductions(self):
+        data = _t(np.arange(12, dtype=np.float32).reshape(4, 3))
+        ids = _t(np.asarray([0, 0, 1, 3], np.int32))
+        G = paddle.geometric
+        np.testing.assert_allclose(
+            G.segment_sum(data, ids).numpy()[0], [3, 5, 7])
+        np.testing.assert_allclose(
+            G.segment_mean(data, ids).numpy()[0], [1.5, 2.5, 3.5])
+        # empty segment 2 -> 0, not +/-inf
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy()[2],
+                                   [0, 0, 0])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy()[0],
+                                   [0, 1, 2])
+
+    def test_send_recv_and_grad(self):
+        G = paddle.geometric
+        x = _t(np.arange(6, dtype=np.float32).reshape(3, 2))
+        src = _t(np.asarray([0, 1, 2, 0], np.int32))
+        dst = _t(np.asarray([1, 2, 1, 0], np.int32))
+        np.testing.assert_allclose(
+            G.send_u_recv(x, src, dst).numpy(),
+            [[0, 1], [4, 6], [2, 3]])
+        e = _t(np.ones((4, 2), np.float32))
+        np.testing.assert_allclose(
+            G.send_ue_recv(x, e, src, dst, "add", "max").numpy(),
+            [[1, 2], [5, 6], [3, 4]])
+        np.testing.assert_allclose(
+            G.send_uv(x, x, src, dst, "mul").numpy(),
+            [[0, 3], [8, 15], [8, 15], [0, 1]])
+        xx = _t(np.arange(6, dtype=np.float32).reshape(3, 2))
+        xx.stop_gradient = False
+        loss = paddle.sum(G.send_u_recv(xx, src, dst) ** 2)
+        loss.backward()
+        assert np.abs(xx.grad.numpy()).sum() > 0
+
+
+class TestLinalgTail:
+    def test_cholesky_inverse_matches_torch(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 5)
+        A = (a @ a.T + 5 * np.eye(5)).astype(np.float32)
+        L = np.linalg.cholesky(A).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky_inverse(_t(L)).numpy(),
+            torch.cholesky_inverse(torch.tensor(L)).numpy(),
+            rtol=1e-3, atol=1e-4)
+        U = np.ascontiguousarray(L.T)
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky_inverse(_t(U), upper=True).numpy(),
+            torch.cholesky_inverse(torch.tensor(U), upper=True).numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("left", [True, False])
+    @pytest.mark.parametrize("transpose", [True, False])
+    def test_ormqr_matches_torch(self, left, transpose):
+        rng = np.random.RandomState(1)
+        m, n, k = 6, 4, 5
+        qr = torch.geqrf(torch.tensor(rng.randn(m, n).astype(np.float32)))
+        xg, tau = qr.a.numpy(), qr.tau.numpy()
+        y = rng.randn(*((m, k) if left else (k, m))).astype(np.float32)
+        got = paddle.linalg.ormqr(_t(xg), _t(tau), _t(y), left=left,
+                                  transpose=transpose).numpy()
+        want = torch.ormqr(torch.tensor(xg), torch.tensor(tau),
+                           torch.tensor(y), left=left,
+                           transpose=transpose).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_pca_lowrank_reconstructs(self):
+        rng = np.random.RandomState(2)
+        X = rng.randn(20, 8).astype(np.float32)
+        u, s, v = paddle.linalg.pca_lowrank(_t(X), q=8)
+        Xc = X - X.mean(0, keepdims=True)
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ v.numpy().T, Xc, rtol=1e-3, atol=1e-4)
+
+
+class TestMathTail:
+    def test_baddbmm_matches_torch(self):
+        rng = np.random.RandomState(3)
+        inp = rng.randn(2, 3, 5).astype(np.float32)
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        y = rng.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.baddbmm(_t(inp), _t(x), _t(y), beta=0.5, alpha=2.0).numpy(),
+            torch.baddbmm(torch.tensor(inp), torch.tensor(x),
+                          torch.tensor(y), beta=0.5, alpha=2.0).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_reduce_as_is_broadcast_adjoint(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        tgt = np.zeros((4, 1), np.float32)
+        np.testing.assert_allclose(
+            paddle.reduce_as(_t(x), _t(tgt)).numpy(),
+            x.sum(axis=(0, 2), keepdims=False).reshape(4, 1), rtol=1e-5)
+
+
+class TestInplaceBatch:
+    def test_elementwise_inplace_rebinds_and_keeps_grad(self):
+        x = _t(np.asarray([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        w = x * 2
+        w.lgamma_()
+        paddle.sum(w).backward()
+        # d lgamma(2x)/dx = 2 digamma(2x)
+        from scipy.special import digamma
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 * digamma([2.0, 4.0]), rtol=1e-4)
+
+    def test_trig_and_triangular_inplace(self):
+        x = _t(np.asarray([0.5, 0.2], np.float32))
+        x.sin_()
+        np.testing.assert_allclose(x.numpy(), np.sin([0.5, 0.2]), rtol=1e-6)
+        y = _t(np.eye(3, dtype=np.float32))
+        y.tril_(-1)
+        assert y.numpy().sum() == 0
+        z = _t(np.ones((3, 3), np.float32))
+        z.triu_()
+        assert z.numpy().sum() == 6
+
+    def test_where_inplace_mutates_x(self):
+        c = _t(np.asarray([True, False, True]))
+        a = _t(np.asarray([1.0, 2.0, 3.0], np.float32))
+        b = _t(np.asarray([9.0, 9.0, 9.0], np.float32))
+        out = a.where_(c, b)
+        assert out is a
+        np.testing.assert_allclose(a.numpy(), [1.0, 9.0, 3.0])
+
+    def test_fill_zero_refills(self):
+        k = _t(np.ones(5, np.float32))
+        k.zero_()
+        assert k.numpy().sum() == 0
+        k.fill_(7.0)
+        assert (k.numpy() == 7).all()
+
+
+class TestRandomTail:
+    def test_refill_distributions(self):
+        paddle.seed(7)
+        f = _t(np.zeros(4000, np.float32))
+        f.log_normal_(0.0, 0.25)
+        assert f.numpy().min() > 0
+        g = _t(np.zeros(4000, np.float32))
+        g.geometric_(0.5)
+        assert g.numpy().min() >= 1 and abs(g.numpy().mean() - 2.0) < 0.15
+        b = _t(np.zeros(4000, np.float32))
+        b.bernoulli_(0.3)
+        assert abs(b.numpy().mean() - 0.3) < 0.05
+        c = _t(np.zeros(4000, np.float32))
+        c.cauchy_()
+        assert abs(np.median(c.numpy())) < 0.2  # heavy tails, median ~ loc
+
+    def test_sampling_functions(self):
+        paddle.seed(8)
+        s = paddle.standard_gamma(_t(np.full(4000, 3.0, np.float32)))
+        assert abs(s.numpy().mean() - 3.0) < 0.25
+        n = paddle.binomial(_t(np.full(4000, 10.0, np.float32)),
+                            _t(np.full(4000, 0.4, np.float32)))
+        assert abs(n.numpy().mean() - 4.0) < 0.25
+
+
+class TestFillDiagonalTensor:
+    def test_offset_and_inplace(self):
+        x = np.zeros((4, 5), np.float32)
+        y = np.arange(1, 5, dtype=np.float32)
+        got = paddle.fill_diagonal_tensor(_t(x), _t(y), offset=1).numpy()
+        want = np.zeros((4, 5), np.float32)
+        for i in range(4):
+            want[i, i + 1] = y[i]
+        np.testing.assert_allclose(got, want)
+        z = _t(np.zeros((3, 3), np.float32))
+        z.fill_diagonal_tensor_(_t(np.ones(3, np.float32)))
+        np.testing.assert_allclose(z.numpy(), np.eye(3))
+
+
+class TestNewLosses:
+    def test_sigmoid_focal_loss(self):
+        rng = np.random.RandomState(0)
+        logit = rng.randn(6, 4).astype(np.float32)
+        label = (rng.rand(6, 4) > 0.7).astype(np.float32)
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        pt = p * label + (1 - p) * (1 - label)
+        at = 0.25 * label + 0.75 * (1 - label)
+        want = (at * (1 - pt) ** 2.0 * ce).sum()
+        np.testing.assert_allclose(
+            float(F.sigmoid_focal_loss(_t(logit), _t(label))), want,
+            rtol=1e-4)
+
+    def test_adaptive_log_softmax_matches_torch(self):
+        rng = np.random.RandomState(1)
+        H, n_classes, cutoffs = 16, 30, [10, 20]
+        m = torch.nn.AdaptiveLogSoftmaxWithLoss(H, n_classes, cutoffs,
+                                                div_value=2.0)
+        x = rng.randn(12, H).astype(np.float32)
+        y = rng.randint(0, n_classes, 12).astype(np.int64)
+        with torch.no_grad():
+            tout = m(torch.tensor(x), torch.tensor(y))
+        head_w = m.head.weight.detach().numpy().T
+        tails = [[_t(p.weight.detach().numpy().T) for p in seq]
+                 for seq in m.tail]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            _t(x), _t(y.astype(np.int32)), _t(head_w), tails, cutoffs)
+        np.testing.assert_allclose(out.numpy(), tout.output.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(loss), float(tout.loss), rtol=1e-4)
+
+
+class TestDeformConv2d:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        got = vops.deform_conv2d(_t(x), _t(off), _t(w), _t(b)).numpy()
+        want = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_fractional_offset_and_mask_brute_force(self):
+        rng = np.random.RandomState(1)
+        B, Cin, H, W, Cout, k = 1, 2, 6, 6, 3, 3
+        Ho = Wo = H - k + 1
+        x = rng.randn(B, Cin, H, W).astype(np.float32)
+        w = rng.randn(Cout, Cin, k, k).astype(np.float32)
+        off = ((rng.rand(B, 2 * k * k, Ho, Wo) - 0.5) * 2).astype(np.float32)
+        msk = rng.rand(B, k * k, Ho, Wo).astype(np.float32)
+        got = vops.deform_conv2d(_t(x), _t(off), _t(w), mask=_t(msk)).numpy()
+
+        def bil(img, py, px):
+            y0, x0 = int(np.floor(py)), int(np.floor(px))
+            v = 0.0
+            for yy, wy in ((y0, 1 - (py - y0)), (y0 + 1, py - y0)):
+                for xx, wx in ((x0, 1 - (px - x0)), (x0 + 1, px - x0)):
+                    if 0 <= yy < H and 0 <= xx < W:
+                        v += img[yy, xx] * wy * wx
+            return v
+
+        want = np.zeros_like(got)
+        for co in range(Cout):
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    acc = 0.0
+                    for ci in range(Cin):
+                        for i in range(k):
+                            for j in range(k):
+                                tap = i * k + j
+                                py = ho + i + off[0, 2 * tap, ho, wo]
+                                px = wo + j + off[0, 2 * tap + 1, ho, wo]
+                                acc += (w[co, ci, i, j] * msk[0, tap, ho, wo]
+                                        * bil(x[0, ci], py, px))
+                    want[0, co, ho, wo] = acc
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestPSRoIPoolAndMatrixNMS:
+    def test_psroi_pool_brute_force(self):
+        rng = np.random.RandomState(0)
+        xp = rng.randn(1, 8, 8, 8).astype(np.float32)  # 2 out-ch x 2x2 bins
+        boxes = np.asarray([[0, 0, 5, 5], [2, 2, 7, 7]], np.float32)
+        got = vops.psroi_pool(_t(xp), _t(boxes),
+                              _t(np.asarray([2], np.int32)), 2).numpy()
+        for r, box in enumerate(boxes):
+            x1, y1 = round(box[0]), round(box[1])
+            x2, y2 = round(box[2] + 1), round(box[3] + 1)
+            bh, bw = max(y2 - y1, 0.1) / 2, max(x2 - x1, 0.1) / 2
+            for co in range(2):
+                for i in range(2):
+                    for j in range(2):
+                        hs = int(np.clip(np.floor(y1 + i * bh), 0, 8))
+                        he = int(np.clip(np.ceil(y1 + (i + 1) * bh), 0, 8))
+                        ws = int(np.clip(np.floor(x1 + j * bw), 0, 8))
+                        we = int(np.clip(np.ceil(x1 + (j + 1) * bw), 0, 8))
+                        reg = xp[0, (co * 2 + i) * 2 + j, hs:he, ws:we]
+                        np.testing.assert_allclose(
+                            got[r, co, i, j],
+                            reg.mean() if reg.size else 0.0,
+                            rtol=1e-4, atol=1e-5)
+
+    def test_matrix_nms_decay_formula(self):
+        bx = np.asarray([[[0, 0, 10, 10], [0, 0, 10.5, 10],
+                          [20, 20, 30, 30]]], np.float32)
+        sc = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+        out, num = vops.matrix_nms(_t(bx), _t(sc), score_threshold=0.05,
+                                   post_threshold=0.0, nms_top_k=3,
+                                   keep_top_k=3, background_label=-1)
+        out = out.numpy()[0]
+        assert int(num.numpy()[0]) == 3
+        # rows sorted by decayed score: 0.9 (lead), 0.7 (distinct box),
+        # near-dup decayed by exactly (1 - iou)
+        iou = vops.box_iou(_t(bx[0, :2]), _t(bx[0, :2])).numpy()[0, 1]
+        np.testing.assert_allclose(out[:, 1],
+                                   [0.9, 0.7, 0.8 * (1 - iou)], rtol=1e-5)
+        # gaussian path runs and keeps ordering
+        out2, idx, num2 = vops.matrix_nms(
+            _t(bx), _t(sc), 0.05, 0.0, 3, 3, use_gaussian=True,
+            background_label=-1, return_index=True)
+        assert int(num2.numpy()[0]) == 3
+        assert (idx.numpy()[0] >= 0).all()
+        # defaults must not fault on small inputs (keep_top_k=200 > C*k)
+        # and keep_top_k=-1 means keep-everything; background class 0 is
+        # skipped by default (reference background_label=0)
+        sc2 = np.concatenate([np.full((1, 1, 3), 0.99, np.float32), sc],
+                             axis=1)  # class 0 = background
+        out3, num3 = vops.matrix_nms(_t(bx), _t(sc2), 0.05)
+        assert not (out3.numpy()[0][:, 0] == 0).any()   # bg never emitted
+        out4, num4 = vops.matrix_nms(_t(bx), _t(sc2), 0.05, keep_top_k=-1)
+        assert int(num4.numpy()[0]) == int(num3.numpy()[0])
+        # normalized=False uses +1 pixel spans in the IoU
+        out5, _ = vops.matrix_nms(_t(bx), _t(sc), 0.05, nms_top_k=3,
+                                  keep_top_k=3, background_label=-1,
+                                  normalized=False)
+        a0 = (10 + 1) * (10 + 1)
+        a1 = (10.5 + 1) * (10 + 1)
+        inter = (10 + 1) * (10 + 1)
+        iou_px = inter / (a0 + a1 - inter)
+        np.testing.assert_allclose(
+            sorted(out5.numpy()[0][:, 1])[0], 0.8 * (1 - iou_px), rtol=1e-5)
+
+
+class TestRegistryHonesty:
+    def test_invented_names_gone(self):
+        for bad in ("sinc_pi", "cosine_similarity_flat", "moveaxis_single",
+                    "rot90_k", "flip_lr", "flip_ud", "take_diag",
+                    "trace_offset", "count_unique"):
+            assert not hasattr(paddle, bad), bad
+
+    def test_registry_crosses_500(self):
+        from paddle_tpu.ops._op import OP_REGISTRY
+        assert len(OP_REGISTRY) >= 500, len(OP_REGISTRY)

@@ -149,16 +149,88 @@ class TestPipeline1F1BHeterogeneous:
             np.testing.assert_allclose(
                 np.asarray(y), np.asarray(fns[1](w1, fns[0](w0, x))),
                 rtol=1e-5, atol=1e-6)
-            # heterogeneous STRUCTURE falls back to replication
+            # heterogeneous STRUCTURE: packed per-dtype buffers, still
+            # sharded P('pp') — no replication (VERDICT r4 item 7)
             captured.clear()
             fns2 = [lambda p, h: jnp.tanh(h @ p),
                     lambda p, h: jax.nn.relu(h @ p[0] @ p[1])]
             p2 = (w0, (w1, jnp.eye(H)))
-            jax.jit(lambda p, x: pipeline_1f1b(
+            y2 = jax.jit(lambda p, x: pipeline_1f1b(
                 fns2, p, x, num_microbatches=4, mesh=hcg.mesh))(p2, x)
-            assert all(s == P() for s in jax.tree.leaves(captured["specs"]))
+            assert all(s == P("pp")
+                       for s in jax.tree.leaves(captured["specs"]))
+            np.testing.assert_allclose(
+                np.asarray(y2),
+                np.asarray(fns2[1]((w1, jnp.eye(H)), fns2[0](w0, x))),
+                rtol=1e-5, atol=1e-6)
         finally:
             pp_mod._run_schedule = _saved
+
+    def test_heterogeneous_three_stage_residency_and_grads(self):
+        """VERDICT r4 item 7: an embed->block->head pipeline with three
+        DIFFERENT per-stage pytree structures must give every device only
+        its own stage's weights (packed [S, L] buffers sharded 'pp' — no
+        replication), with parity + grads vs serial."""
+        from paddle_tpu.parallel import pp as pp_mod
+        hcg = _reset_fleet(pp_degree=4, dp_degree=2)
+        rng = np.random.RandomState(11)
+        V, H = 12, 8
+        emb = jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1
+        mkblk = lambda: {
+            "w": jnp.asarray(rng.randn(H, H).astype(np.float32)) * 0.1,
+            "b": jnp.zeros((H,), jnp.float32)}
+        head = (jnp.asarray(rng.randn(H, V).astype(np.float32)) * 0.1,)
+        # handoff contract: all stages map [B, H] float activations, so
+        # the embed gather happens outside the pipeline; stage 0 is a
+        # plain projection with the embedding matrix as its (unique) param
+        blk_fn = lambda p, h: jnp.tanh(h @ p["w"] + p["b"])
+        fns = [lambda p, h: h @ p, blk_fn, blk_fn,
+               lambda p, h: jnp.sin(h @ p[0] @ jnp.ones((V, H)) * 0.1)]
+        params = (emb, mkblk(), mkblk(), head)
+        x = jnp.asarray(rng.randn(6, H).astype(np.float32))
+
+        def serial(ps, x):
+            h = x
+            for f, p in zip(fns, ps):
+                h = f(p, h)
+            return h
+
+        captured = {}
+        orig = pp_mod._run_schedule
+
+        def spy(apply_fn, params, params_in_specs, *a, **k):
+            captured["specs"] = params_in_specs
+            return orig(apply_fn, params, params_in_specs, *a, **k)
+
+        pp_mod._run_schedule = spy
+        try:
+            y = jax.jit(lambda p, x: pipeline_1f1b(
+                fns, p, x, num_microbatches=6, mesh=hcg.mesh))(params, x)
+        finally:
+            pp_mod._run_schedule = orig
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(serial(params, x)),
+                                   rtol=1e-5, atol=1e-6)
+        # every packed buffer is [S, L] sharded P('pp')
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        assert captured["specs"] and all(
+            s == P("pp") for s in jax.tree.leaves(captured["specs"]))
+        # residency on device arrays: place the packed buffers with the
+        # schedule's sharding and check each device holds 1/S rows
+        bufs, _metas = pp_mod._pack_stages(params)
+        assert jax.tree.leaves(bufs)
+        for buf in jax.tree.leaves(bufs):
+            placed = jax.device_put(
+                buf, NamedSharding(hcg.mesh, P("pp")))
+            for sh in placed.addressable_shards:
+                assert sh.data.shape[0] == buf.shape[0] // 4
+        # grads flow through the pack/unpack to the ORIGINAL leaves
+        g_pipe = jax.jit(jax.grad(lambda p, x: jnp.sum(pipeline_1f1b(
+            fns, p, x, num_microbatches=6, mesh=hcg.mesh) ** 2)))(params, x)
+        g_ser = jax.grad(lambda p, x: jnp.sum(serial(p, x) ** 2))(params, x)
+        for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ser)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
 
     def test_switch_stages_grads(self):
         hcg = _reset_fleet(pp_degree=2, dp_degree=4)
