@@ -25,16 +25,21 @@ __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "send_uv"]
 
 
-def _num_segments(ids, out_size):
+def _num_segments(ids, out_size, has_out_size=True):
     if out_size is not None:
         return int(out_size)
     try:
         return int(jnp.max(jnp.asarray(unwrap(ids)))) + 1
-    except (jax.errors.ConcretizationTypeError, jax.errors.TracerArrayConversionError, TypeError) as e:
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError, TypeError) as e:
+        hint = ("pass out_size= explicitly" if has_out_size else
+                "the segment_* API has no out_size (paddle parity), so "
+                "call it eagerly, or use send_u_recv(x, iota, ids, "
+                "out_size=...) which is the same reduction")
         raise ValueError(
-            "segment/send ops need a concrete output row count: under "
-            "jit, pass out_size= explicitly (eager mode infers it from "
-            "the indices)") from e
+            "segment/send ops need a concrete output row count; under "
+            f"jit the indices are abstract — {hint} (eager mode infers "
+            "it from the index data)") from e
 
 
 def _segment(data, ids, n, kind):
@@ -59,7 +64,8 @@ def _seg_op(kind):
         return _segment(data, segment_ids, n, kind)
 
     def op(data, segment_ids, name=None):
-        return impl(data, segment_ids, _num_segments(segment_ids, None))
+        return impl(data, segment_ids,
+                    _num_segments(segment_ids, None, has_out_size=False))
 
     op.__name__ = op.__qualname__ = f"segment_{kind}"
     op.__doc__ = (f"Segment {kind} over sorted non-negative segment ids "

@@ -394,12 +394,12 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
     stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
     pp_deg = (int(mesh.shape["pp"]) if mesh is not None and
               "pp" in mesh.axis_names else 1)
-    # CP composes inside the pipeline: the ring/ulysses shard_map re-binds
-    # to the context AbstractMesh when it runs inside the schedule's
-    # manual 'pp' region (sp_attention.ring_attention). Caveat: the Shardy
-    # partitioner cannot yet TRANSPOSE nested partial-manual regions
-    # ("axis already bound by parent"), so training this combination needs
-    # jax.config.update("jax_use_shardy_partitioner", False).
+    # CP composes inside the pipeline: the ring shard_map re-binds to the
+    # context AbstractMesh when it runs inside the schedule's manual 'pp'
+    # region (sp_attention.ring_attention), and the ring position arrives
+    # as a P('sep')-sharded iota instead of jax.lax.axis_index — the one
+    # lowering Shardy rejects in nested partial-manual regions — so BOTH
+    # partitioners compile fwd+bwd (tests/_cp_pp_child.py runs each).
     if use_cp and pp_deg > 1 and pipeline_microbatches > 0:
         if context_parallel == "ulysses":
             raise ValueError(
@@ -407,13 +407,6 @@ def _llama_forward(input_ids, labels, nh, nkv, hd, eps, theta, remat, tied,
                 "schedule: XLA cannot partition the head-scatter all_to_all "
                 "inside a nested manual region (GSPMD CHECK "
                 "IsManualSubgroup); use context_parallel='ring'")
-        if jax.config.jax_use_shardy_partitioner:
-            import warnings
-            warnings.warn(
-                "context_parallel inside the pipeline schedule: backward "
-                "requires the legacy partitioner — set jax.config.update("
-                "'jax_use_shardy_partitioner', False) before compiling, or "
-                "the grad lowering fails with 'axis already bound'")
     if pipeline_microbatches > 0 and pp_deg > 1:
         # real pipeline: stage-resident weight slices + ppermute handoffs
         from ..parallel.pp import pipeline_interleaved, pipeline_spmd

@@ -211,13 +211,13 @@ log_normal_ = _random_refill("log_normal_", _log_normal_sample)
 
 
 def _fill_value(x, value, name=None):
-    import jax.numpy as jnp
-    return Tensor(jnp.full(tuple(x.shape), value, dtype=x.value.dtype))
+    from .creation import full_like
+    return full_like(x, value)
 
 
 def _zero_value(x, name=None):
-    import jax.numpy as jnp
-    return Tensor(jnp.zeros(tuple(x.shape), dtype=x.value.dtype))
+    from .creation import zeros_like
+    return zeros_like(x)
 
 
 # deterministic whole-tensor refills: every output entry is independent of

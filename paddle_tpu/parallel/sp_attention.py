@@ -53,10 +53,16 @@ def _chunk_attn_stats(q, k, v, rows_g, cols_g, scale, causal):
     return o, m_safe, l
 
 
-def _ring_local(q, k, v, *, axis_name, causal, scale):
-    """Per-shard body: q/k/v [B, H, S_local, D] (seq-sharded over the ring)."""
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+def _ring_local(q, k, v, idx_arr, *, axis_name, n, causal, scale):
+    """Per-shard body: q/k/v [B, H, S_local, D] (seq-sharded over the ring).
+
+    ``idx_arr`` is this shard's slice of a P(axis)-sharded iota — the ring
+    position. Passing it as data instead of calling
+    ``jax.lax.axis_index`` keeps the region Shardy-compatible: axis_index
+    lowers to an sdy.manual_computation binding every OTHER mesh axis,
+    which Shardy rejects inside an enclosing manual region (the pipeline's
+    'pp' shard_map); a sharded input has no such lowering."""
+    idx = idx_arr[0]
     B, H, S_l, D = q.shape
     rows_g = idx * S_l + jnp.arange(S_l)
 
@@ -106,15 +112,17 @@ def ring_attention(q, k, v, causal=True, mesh=None, axis_name=SEQ_AXIS):
                            jnp.swapaxes(v, 1, 2), causal)
         return jnp.swapaxes(o, 1, 2)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    n = int(mesh.shape[axis_name])
     # partial-manual: only the ring axis is manual; dp/sharding/mp stay in
     # GSPMD's hands so any batch/head sharding composes unchanged
     spec = P(None, None, axis_name, None)
-    fn = functools.partial(_ring_local, axis_name=axis_name, causal=causal,
-                           scale=scale)
+    fn = functools.partial(_ring_local, axis_name=axis_name, n=n,
+                           causal=causal, scale=scale)
     return jax.shard_map(fn, mesh=_nesting_mesh(mesh, axis_name),
                          axis_names={axis_name},
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+                         in_specs=(spec, spec, spec, P(axis_name)),
+                         out_specs=spec, check_vma=False)(
+        q, k, v, jnp.arange(n, dtype=jnp.int32))
 
 
 # ------------------------------------------------------------------ ulysses

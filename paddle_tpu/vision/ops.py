@@ -512,8 +512,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             iou = jnp.where(higher, iou, 0.0)
             iou_max = jnp.max(iou, axis=1)     # compensation per j
             if use_gaussian:
+                # reference kernel (SOLOv2): exp(-sigma*iou^2) /
+                # exp(-sigma*comp^2) — sigma MULTIPLIES the exponent
                 decay = jnp.exp((iou_max[None, :] ** 2 - iou ** 2)
-                                / gaussian_sigma)
+                                * gaussian_sigma)
             else:
                 decay = (1.0 - iou) / jnp.maximum(
                     1.0 - iou_max[None, :], 1e-10)

