@@ -1281,6 +1281,47 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
 
 
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample ``num_samples`` class centers containing every positive
+    class in ``label`` (reference
+    ``python/paddle/nn/functional/common.py`` class_center_sample †, the
+    PLSC partial-FC primitive). Returns (remapped_label,
+    sampled_class_center) with the sampled ids sorted ascending (the
+    reference's output order); negatives come from a seeded shuffle.
+    Eager-only: the sampled set depends on the label DATA (same
+    constraint as the reference's dygraph path)."""
+    if group is not None:
+        raise NotImplementedError(
+            "class_center_sample(group=...) — the model-parallel local-"
+            "shard sampling + allgathered remap of the reference — is not "
+            "implemented; sample on the full class dim (group=None) and "
+            "shard the centers afterwards")
+    if num_samples > num_classes:
+        raise ValueError(
+            f"num_samples ({num_samples}) must be <= num_classes "
+            f"({num_classes})")
+    lab = np.asarray(unwrap(label))
+    if lab.size and (lab.min() < 0 or lab.max() >= num_classes):
+        raise ValueError(
+            f"label values must be in [0, {num_classes}), got range "
+            f"[{lab.min()}, {lab.max()}]")
+    pos = np.unique(lab)
+    if len(pos) > num_samples:
+        raise ValueError(
+            f"num_samples {num_samples} < number of positive classes "
+            f"{len(pos)}")
+    perm = np.asarray(jax.random.permutation(random_mod.next_key(),
+                                             num_classes))
+    neg = perm[~np.isin(perm, pos)][:num_samples - len(pos)]
+    sampled = np.sort(np.concatenate([pos, neg]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(num_samples)
+    dt = jnp.asarray(unwrap(label)).dtype
+    return (Tensor(jnp.asarray(remap[lab], dt)),
+            Tensor(jnp.asarray(sampled, dt)))
+
+
 @tensor_op
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
                        gamma=2.0, reduction="sum", name=None):

