@@ -39,8 +39,10 @@ from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
 from .layers.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                              AdaptiveAvgPool3D, AdaptiveMaxPool1D,
                              AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
-                             AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
-                             MaxUnPool2D)
+                             AvgPool3D, FractionalMaxPool2D,
+                             FractionalMaxPool3D, MaxPool1D, MaxPool2D,
+                             MaxPool3D, MaxUnPool1D, MaxUnPool2D,
+                             MaxUnPool3D)
 from .layers.transformer import (MultiHeadAttention, Transformer,
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)

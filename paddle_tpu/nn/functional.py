@@ -492,7 +492,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     from ..ops import unsqueeze, squeeze
     out = max_pool2d(unsqueeze(x, -1), (_pair(kernel_size, 1)[0], 1),
                      (_pair(stride, 1)[0], 1) if stride is not None else None,
-                     padding=(_pair(padding, 1)[0], 0), ceil_mode=ceil_mode)
+                     padding=(_pair(padding, 1)[0], 0), ceil_mode=ceil_mode,
+                     return_mask=return_mask)
+    if return_mask:  # W=1, so the flat H*W index IS the length index
+        return squeeze(out[0], -1), squeeze(out[1], -1)
     return squeeze(out, -1)
 
 
@@ -978,21 +981,44 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 @tensor_op
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
-    if return_mask:
-        raise NotImplementedError("max_pool3d return_mask")
     k = _pair(kernel_size, 3)
     s = _pair(stride, 3) if stride is not None else k
     pads = _conv_padding(padding, 3)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     if isinstance(pads, str):
+        if return_mask:
+            raise NotImplementedError("return_mask with string padding")
         return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
                                      (1, 1) + s, padding=pads)
     extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], pads[i][0])
               if ceil_mode else 0) for i in range(3)]
     pad_cfg = [(0, 0), (0, 0)] + [(pads[i][0], pads[i][1] + extra[i])
                                   for i in range(3)]
-    return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
-                                 padding=pad_cfg)
+    out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
+                                padding=pad_cfg)
+    if not return_mask:
+        return out
+    # mask = flattened D*H*W input index of each window max (paddle
+    # semantics) — same explicit-patch scheme as max_pool2d above
+    N, C, D, H, W = x.shape
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    OD, OH, OW = patches.shape[2], patches.shape[3], patches.shape[4]
+    pr = patches.reshape(N, C, k[0] * k[1] * k[2], OD, OH, OW)
+    widx = jnp.argmax(pr, axis=2)
+    wd = widx // (k[1] * k[2])
+    wi = (widx // k[2]) % k[1]
+    wj = widx % k[2]
+    od = jnp.arange(OD)[None, None, :, None, None]
+    oh = jnp.arange(OH)[None, None, None, :, None]
+    ow = jnp.arange(OW)[None, None, None, None, :]
+    in_d = od * s[0] - pads[0][0] + wd
+    in_i = oh * s[1] - pads[1][0] + wi
+    in_j = ow * s[2] - pads[2][0] + wj
+    mask = ((in_d * H + in_i) * W + in_j).astype(dtype_mod.long_dtype())
+    return out, mask
 
 
 @tensor_op
@@ -1074,6 +1100,143 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     out = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
                   idx].set(x.reshape(N, C, IH * IW))
     return out.reshape(N, C, OH, OW)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    """Inverse of max_pool1d(return_mask=True) (reference max_unpool1d †):
+    the 2-D scatter with a singleton width."""
+    from ..ops import squeeze, unsqueeze
+    out = max_unpool2d(unsqueeze(x, -1), unsqueeze(indices, -1),
+                       (kernel_size, 1),
+                       (stride, 1) if stride is not None else None,
+                       (padding, 0),
+                       None if output_size is None
+                       else (output_size[-1], 1))
+    return squeeze(out, -1)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    """Inverse of max_pool3d(return_mask=True): scatters pooled values to
+    their argmax positions (indices flattened D*H*W, paddle layout)."""
+    k = _pair(kernel_size, 3)
+    s = _pair(stride, 3) if stride is not None else k
+    p = _pair(padding, 3)
+    N, C, ID, IH, IW = x.shape
+    if output_size is None:
+        OD = (ID - 1) * s[0] - 2 * p[0] + k[0]
+        OH = (IH - 1) * s[1] - 2 * p[1] + k[1]
+        OW = (IW - 1) * s[2] - 2 * p[2] + k[2]
+    else:
+        OD, OH, OW = output_size[-3], output_size[-2], output_size[-1]
+    return _max_unpool3d_impl(x, indices, OD, OH, OW)
+
+
+@tensor_op
+def _max_unpool3d_impl(x, indices, OD, OH, OW):
+    N, C, ID, IH, IW = x.shape
+    flat = jnp.zeros((N, C, OD * OH * OW), x.dtype)
+    idx = indices.reshape(N, C, ID * IH * IW).astype(jnp.int32)
+    out = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+                  idx].set(x.reshape(N, C, ID * IH * IW))
+    return out.reshape(N, C, OD, OH, OW)
+
+
+def _fractional_bounds(in_size, out_size, u, kernel):
+    """Graham fractional-pooling index sequence (reference kernel:
+    start = ceil(alpha*(i+u) - 1), end = ceil(alpha*(i+1+u) - 1), the
+    optional kernel_size overriding each region's extent)."""
+    alpha = in_size / out_size
+    bounds = []
+    for i in range(out_size):
+        lo = max(int(math.ceil(alpha * (i + u) - 1)), 0)
+        hi = (lo + kernel if kernel
+              else max(int(math.ceil(alpha * (i + 1 + u) - 1)), lo + 1))
+        bounds.append((lo, min(hi, in_size)))
+    return bounds
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference fractional_max_pool2d †, Graham
+    2014): pooling regions follow the pseudo-random sequence
+    ceil(alpha*(i+u)); one shared u (paddle semantics), drawn uniformly
+    when random_u is None."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else (output_size[-2], output_size[-1]))
+    kh, kw = ((kernel_size, kernel_size)
+              if isinstance(kernel_size, int) else
+              (kernel_size if kernel_size else (None, None)))
+    if random_u is None:
+        u = float(jax.random.uniform(random_mod.next_key(), ()))
+    else:
+        u = float(random_u)
+    N, C, H, W = x.shape
+    hb = _fractional_bounds(H, oh, u, kh)
+    wb = _fractional_bounds(W, ow, u, kw)
+    return _fractional_pool_nd(x, (hb, wb), (H, W), return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else (output_size[-3], output_size[-2], output_size[-1]))
+    kd, kh, kw = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+                  else (kernel_size if kernel_size else (None,) * 3))
+    if random_u is None:
+        u = float(jax.random.uniform(random_mod.next_key(), ()))
+    else:
+        u = float(random_u)
+    N, C, D, H, W = x.shape
+    db = _fractional_bounds(D, od, u, kd)
+    hb = _fractional_bounds(H, oh, u, kh)
+    wb = _fractional_bounds(W, ow, u, kw)
+    return _fractional_pool_nd(x, (db, hb, wb), (D, H, W), return_mask)
+
+
+def _fractional_pool_nd(x, bounds, in_sizes, return_mask):
+    if return_mask:
+        out, mask = _fractional_pool_impl_mask(x, bounds, in_sizes)
+        return out, mask
+    return _fractional_pool_impl(x, bounds, in_sizes)
+
+
+@tensor_op
+def _fractional_pool_impl(x, bounds, in_sizes):
+    # separable: max pooling factorizes per axis, so the op count is
+    # O(sum of output sizes), not O(their product)
+    out = x
+    for ax_i, b in enumerate(bounds):
+        axis = 2 + ax_i
+        out = jnp.concatenate(
+            [jnp.max(jax.lax.slice_in_dim(out, lo, hi, axis=axis),
+                     axis=axis, keepdims=True) for lo, hi in b], axis=axis)
+    return out
+
+
+@tensor_op
+def _fractional_pool_impl_mask(x, bounds, in_sizes):
+    # mask variant keeps the per-region argmax (the separable trick does
+    # not compose for multi-axis argmax); values stay DIFFERENTIABLE —
+    # the int mask output is auto-marked stop-gradient by the dispatcher
+    import itertools
+    lead = x.shape[:2]
+    out_shape = tuple(len(b) for b in bounds)
+    vals, idxs = [], []
+    for region in itertools.product(*bounds):
+        sl = (Ellipsis,) + tuple(slice(lo, hi) for lo, hi in region)
+        dims = [hi - lo for lo, hi in region]
+        patch = x[sl].reshape(lead + (-1,))
+        vals.append(jnp.max(patch, axis=-1))
+        coords = jnp.unravel_index(jnp.argmax(patch, axis=-1), dims)
+        flat = jnp.zeros_like(coords[0])
+        for (lo, _hi), c, full in zip(region, coords, in_sizes):
+            flat = flat * full + (c + lo)
+        idxs.append(flat)
+    out = jnp.stack(vals, axis=-1).reshape(lead + out_shape)
+    mask = jnp.stack(idxs, axis=-1).reshape(lead + out_shape)
+    return out, mask.astype(jnp.int32)
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,

@@ -157,3 +157,61 @@ class MaxUnPool2D(Layer):
     def forward(self, x, indices):
         return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
                               self.padding, self.output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)

@@ -15,3 +15,163 @@ def vector_to_parameters(vec, parameters):
         n = int(np.prod(p.shape)) if p.shape else 1
         p.set_value(v[offset:offset + n].reshape(p.shape))
         offset += n
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every parameter's gradient to [-clip_value, clip_value]
+    in place (reference paddle.nn.utils.clip_grad_value_ †)."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad.value, -cv, cv))
+
+
+def _norm_except(v, dim):
+    """L2 norm over every axis except ``dim`` (keepdims, so the result
+    broadcasts straight back onto v); dim=None -> full norm."""
+    from ... import ops
+    if dim is None:
+        return ops.sqrt(ops.sum(v * v))
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return ops.sqrt(ops.sum(v * v, axis=axes, keepdim=True))
+
+
+class _WeightNormHook:
+    """Forward-pre-hook recomputing ``name`` from the (g, v)
+    reparameterization so gradients flow to g and v (reference
+    paddle.nn.utils.weight_norm †: weight = g * v / ||v||). ``g`` is
+    stored with the reference's 1-D shape [w.shape[dim]] (scalar for
+    dim=None) so state_dicts interchange; the broadcast reshape happens
+    here."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        from ... import ops
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        if self.dim is not None:
+            bshape = [1] * len(v.shape)
+            bshape[self.dim] = v.shape[self.dim]
+            g = ops.reshape(g, bshape)
+        return v * (g / _norm_except(v, self.dim))
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as magnitude g times direction
+    v/||v|| (reference weight_norm †). The original Parameter is replaced
+    by ``<name>_g`` / ``<name>_v``; a forward-pre-hook rebuilds the
+    effective weight each call so autograd reaches both."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Parameter
+    w = getattr(layer, name)
+    hook = _WeightNormHook(name, dim)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", Parameter(w.value))
+    g0 = _norm_except(w, dim).value
+    layer.add_parameter(name + "_g", Parameter(jnp.ravel(g0)
+                                               if dim is not None else g0))
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_handles"):
+        object.__setattr__(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (hook, handle)
+    hook(layer, None)  # materialize immediately (paddle does too)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g/v back into a plain Parameter and drop the hook."""
+    from ...core.tensor import Parameter
+    hook, handle = layer._weight_norm_handles.pop(name)
+    w = hook.compute(layer)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    object.__setattr__(layer, name, None)
+    layer.add_parameter(name, Parameter(w.value))
+    return layer
+
+
+class _SpectralNormHook:
+    """Forward-pre-hook dividing ``name`` by its largest singular value,
+    estimated by persistent power iteration (reference
+    paddle.nn.utils.spectral_norm †). The iteration runs in jnp under
+    ``stop_gradient`` (trace-safe: works inside jit/TrainStep), but
+    sigma itself is the TENSOR contraction u^T W v — so backward carries
+    the d(sigma)/dW = u v^T term exactly as the reference's no-grad-u/v
+    formulation does. The persistent u refreshes only on eager calls
+    (inside a trace the update would be an abstract value; the compiled
+    step then re-runs the n iterations from the frozen u each call)."""
+
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = max(1, int(n_power_iterations))
+        self.eps = eps
+        self.dim = dim
+        self.u = None
+
+    def compute(self, layer):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ... import ops
+        w = getattr(layer, self.name + "_orig")
+        wv = w.value
+        h = wv.shape[self.dim]
+        wm = jax.lax.stop_gradient(
+            jnp.moveaxis(wv, self.dim, 0).reshape(h, -1)
+        ).astype(jnp.float32)
+        if self.u is None:
+            rng = np.random.RandomState(0)
+            u0 = rng.randn(h)
+            self.u = u0 / (np.linalg.norm(u0) + self.eps)
+        u = jnp.asarray(self.u, jnp.float32)
+        for _ in range(self.n):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        try:  # concrete (eager) -> persist the iterate; tracer -> keep old
+            self.u = np.asarray(u)
+        except Exception:
+            pass
+        # sigma = u^T W v as a tensor contraction against constants u, v:
+        # sum(W * (u outer v)) in the original layout
+        uv = jnp.moveaxis(jnp.outer(u, v).reshape(
+            (h,) + tuple(np.delete(np.array(wv.shape), self.dim))),
+            0, self.dim)
+        sigma = ops.sum(w * uv)
+        return w / sigma
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Spectral normalization wrapper (reference spectral_norm †):
+    ``layer.<name>`` becomes W / sigma_max(W), sigma estimated by a
+    persistent power iteration refreshed every forward."""
+    from ...core.tensor import Parameter
+    w = getattr(layer, name)
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(w.value))
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_spectral_norm_handles"):
+        object.__setattr__(layer, "_spectral_norm_handles", {})
+    layer._spectral_norm_handles[name] = (hook, handle)
+    hook(layer, None)
+    return layer

@@ -391,6 +391,178 @@ class TestClassCenterSample:
         np.testing.assert_allclose(y.numpy(), [1, 2, 6])
 
 
+class TestPoolingTail:
+    def test_max_pool1d_mask_and_unpool_match_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8).astype(np.float32)
+        p, m = F.max_pool1d(_t(x), 2, stride=2, return_mask=True)
+        tw = torch.nn.functional.max_pool1d(torch.tensor(x), 2, 2,
+                                            return_indices=True)
+        np.testing.assert_allclose(p.numpy(), tw[0].numpy())
+        np.testing.assert_allclose(m.numpy(), tw[1].numpy())
+        u = F.max_unpool1d(p, m, 2, stride=2)
+        np.testing.assert_allclose(
+            u.numpy(),
+            torch.nn.functional.max_unpool1d(*tw, 2, 2).numpy())
+
+    def test_max_pool3d_mask_and_unpool_match_torch(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 2, 4, 4, 4).astype(np.float32)
+        p, m = F.max_pool3d(_t(x), 2, stride=2, return_mask=True)
+        tw = torch.nn.functional.max_pool3d(torch.tensor(x), 2, 2,
+                                            return_indices=True)
+        np.testing.assert_allclose(p.numpy(), tw[0].numpy())
+        np.testing.assert_allclose(m.numpy(), tw[1].numpy())
+        u = F.max_unpool3d(p, m, 2, stride=2)
+        np.testing.assert_allclose(
+            u.numpy(),
+            torch.nn.functional.max_unpool3d(*tw, 2, 2).numpy())
+
+    def test_fractional_max_pool_degenerate_and_mask(self):
+        # integer alpha + u=0.5: regions collapse to kernel2/stride2
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        got = F.fractional_max_pool2d(_t(x), 4, random_u=0.5).numpy()
+        np.testing.assert_allclose(
+            got, torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2))
+        out, mask = F.fractional_max_pool2d(_t(x), 4, random_u=0.5,
+                                            return_mask=True)
+        g = x.reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(g, mask.numpy().reshape(2, 3, -1),
+                               -1).reshape(out.shape), out.numpy())
+        x3 = rng.randn(2, 2, 4, 4, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            F.fractional_max_pool3d(_t(x3), 2, random_u=0.5).numpy(),
+            torch.nn.functional.max_pool3d(torch.tensor(x3), 2, 2))
+        # ragged output size + grads
+        xx = _t(x)
+        xx.stop_gradient = False
+        out = F.fractional_max_pool2d(xx, 3, random_u=0.3)
+        assert out.shape == [2, 3, 3, 3]
+        paddle.sum(out * out).backward()
+        assert np.abs(xx.grad.numpy()).sum() > 0
+        # the return_mask variant backprops through the VALUES too
+        # (r5 review: differentiable=False silently severed training)
+        xm = _t(x)
+        xm.stop_gradient = False
+        vals, _mask = F.fractional_max_pool2d(xm, 4, random_u=0.5,
+                                              return_mask=True)
+        paddle.sum(vals).backward()
+        assert np.abs(xm.grad.numpy()).sum() > 0
+
+    def test_layer_wrappers(self):
+        from paddle_tpu import nn
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        out = nn.FractionalMaxPool2D(4, random_u=0.5)(_t(x))
+        assert out.shape == [1, 2, 4, 4]
+        x1 = rng.randn(1, 2, 8).astype(np.float32)
+        p, m = F.max_pool1d(_t(x1), 2, stride=2, return_mask=True)
+        assert nn.MaxUnPool1D(2, stride=2)(p, m).shape == [1, 2, 8]
+        x3 = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+        p3, m3 = F.max_pool3d(_t(x3), 2, stride=2, return_mask=True)
+        assert nn.MaxUnPool3D(2, stride=2)(p3, m3).shape == [1, 2, 4, 4, 4]
+
+
+class TestNNUtilsReparam:
+    def test_weight_norm_parity_grads_and_removal(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 3)
+        W = rng.randn(4, 3).astype(np.float32)
+        lin.weight.set_value(W)
+        lin.bias.set_value(np.zeros(3, np.float32))
+        weight_norm(lin, dim=1)  # per-output column (torch Linear dim=0)
+        x = rng.randn(2, 4).astype(np.float32)
+        got = lin(_t(x)).numpy()
+        tl = torch.nn.Linear(4, 3, bias=False)
+        with torch.no_grad():
+            tl.weight.copy_(torch.tensor(W.T))
+        tl = torch.nn.utils.weight_norm(tl, dim=0)
+        np.testing.assert_allclose(got, tl(torch.tensor(x)).detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert lin.weight_g.shape == [3]  # reference 1-D g (state_dict)
+        loss = paddle.sum(lin(_t(x)) ** 2)
+        loss.backward()
+        assert np.abs(lin.weight_g.grad.numpy()).sum() > 0
+        assert lin.weight_v.grad is not None
+        eff = np.asarray(lin.weight.value).copy()
+        remove_weight_norm(lin)
+        assert "weight" in lin._parameters
+        assert "weight_g" not in lin._parameters
+        np.testing.assert_allclose(np.asarray(lin.weight.value), eff,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(lin(_t(x)).numpy(), got, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_norm_unit_sigma(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import spectral_norm
+        rng = np.random.RandomState(1)
+        lin = nn.Linear(6, 5)
+        lin.weight.set_value((rng.randn(6, 5) * 3).astype(np.float32))
+        spectral_norm(lin, n_power_iterations=20)
+        _ = lin(_t(rng.randn(2, 6).astype(np.float32)))
+        s = np.linalg.svd(np.asarray(lin.weight.value), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_spectral_norm_grad_matches_torch(self):
+        """The d(sigma)/dW term must flow: grads of sum(W_sn @ x) match
+        torch's spectral_norm (same u seed via enough power iterations
+        to converge both to the dominant singular vectors)."""
+        rng = np.random.RandomState(2)
+        W = (rng.randn(4, 3) * 2).astype(np.float32)  # paddle [in, out]
+        x = rng.randn(5, 4).astype(np.float32)
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import spectral_norm
+        lin = nn.Linear(4, 3)
+        lin.weight.set_value(W)
+        lin.bias.set_value(np.zeros(3, np.float32))
+        spectral_norm(lin, n_power_iterations=50, dim=1)
+        loss = paddle.sum(lin(_t(x)))
+        loss.backward()
+        got = lin.weight_orig.grad.numpy()
+
+        tl = torch.nn.Linear(4, 3, bias=False)
+        with torch.no_grad():
+            tl.weight.copy_(torch.tensor(W.T))
+        tl = torch.nn.utils.spectral_norm(tl, n_power_iterations=50)
+        tloss = tl(torch.tensor(x)).sum()
+        tloss.backward()
+        want = tl.weight_orig.grad.numpy().T
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+    def test_spectral_norm_works_under_trainstep(self):
+        """r5 review: the power iteration must be trace-safe (numpy on a
+        tracer would crash TrainStep)."""
+        from paddle_tpu import nn
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.nn.utils import spectral_norm
+        from paddle_tpu.optimizer import SGD
+        rng = np.random.RandomState(3)
+        lin = nn.Linear(4, 3)
+        spectral_norm(lin)
+        opt = SGD(learning_rate=0.1, parameters=list(lin.parameters()))
+        step = TrainStep(lin, lambda out, _l: paddle.sum(out * out), opt)
+        x = _t(rng.randn(2, 4).astype(np.float32))
+        l0 = float(step.step((x,), (x,)).value)
+        l1 = float(step.step((x,), (x,)).value)
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+    def test_clip_grad_value(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import clip_grad_value_
+        rng = np.random.RandomState(2)
+        lin = nn.Linear(4, 3)
+        loss = paddle.sum(lin(_t(rng.randn(8, 4).astype(np.float32) * 50)))
+        loss.backward()
+        clip_grad_value_(list(lin.parameters()), 0.05)
+        for p in lin.parameters():
+            assert np.abs(p.grad.numpy()).max() <= 0.05 + 1e-8
+
+
 class TestRegistryHonesty:
     def test_invented_names_gone(self):
         for bad in ("sinc_pi", "cosine_similarity_flat", "moveaxis_single",
