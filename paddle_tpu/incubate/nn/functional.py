@@ -162,3 +162,186 @@ def swiglu(x, y=None):
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
     return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference fused_matmul_bias (cublasLt epilogue fusion) — on TPU one
+    jnp matmul + add that XLA fuses into the same kernel."""
+    from ...ops import matmul
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Packed-QKV flash attention (reference flash_attn_qkvpacked):
+    qkv [batch, seq, 3, heads, head_dim]."""
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Functional form of the fused attention block (reference
+    fused_attention_op †): (pre-LN ->) qkv -> attention -> out proj ->
+    bias+dropout+residual(+post-LN). qkv_weight layout [3, H, D, hidden]
+    (the reference's fused layout)."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention(cache_kv=...) decode path: use "
+            "masked_multihead_attention (single-token) or the "
+            "FusedMultiTransformer layer's cache plumbing")
+    from ...ops import einsum, reshape
+    residual = x
+    hidden = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, [hidden], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    qkv = einsum("bsh,tndh->bstnd", x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    b, s = out.shape[0], out.shape[1]
+    out = F.linear(reshape(out, [b, s, hidden]), linear_weight, None)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [hidden], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Functional form of the fused FFN block (reference
+    fused_feedforward_op †): (pre-LN ->) linear1 -> act -> dropout ->
+    linear2 -> dropout (+residual, +post-LN)."""
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, [d], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = F.dropout(getattr(F, activation)(h), dropout1_rate,
+                  training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = residual + h
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [d], ln2_scale, ln2_bias, ln2_epsilon)
+    return h
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """Reference variable_length_memory_efficient_attention ([B, H, S, D]
+    layout, per-batch valid lengths). TPU path: dense attention with the
+    length masks folded into the softmax logits — static shapes, and XLA
+    fuses the masking into the attention matmuls."""
+    import math as _math
+
+    from ...ops._op import tensor_op as _top
+
+    @_top(name="incubate.varlen_mem_efficient_attention")
+    def _impl(q, k, v, qlen, klen, mask):
+        B, H, Sq, D = q.shape
+        Sk = k.shape[2]
+        qlen = qlen.reshape(B)   # reference documents [batch, 1] shape
+        klen = klen.reshape(B)
+        sc = scale if scale is not None else 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * sc
+        kv_valid = jnp.arange(Sk)[None, None, None, :] \
+            < klen[:, None, None, None]
+        logits = jnp.where(kv_valid, logits, -1e30)
+        if causal:
+            cm = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+            logits = jnp.where(cm[None, None], logits, -1e30)
+        if mask is not None:
+            logits = logits + mask
+        p = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        q_valid = jnp.arange(Sq)[None, None, :, None] \
+            < qlen[:, None, None, None]
+        return jnp.where(q_valid, out, 0.0)
+
+    return _impl(query, key, value, seq_lens, kv_seq_lens, mask)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention against a growing KV cache
+    (reference masked_multihead_attention_op †, the generation hot op).
+
+    x [B, 3*H*D] (this step's fused qkv projection), cache_kv
+    [2, B, H, S_max, D]. Appends this step's k/v at ``sequence_lengths``
+    (default: first unused slot = current step for all rows), attends q
+    against the valid prefix, returns (out [B, H*D], cache_kv).
+    Quantization args are accepted for signature parity but only the
+    unquantized path is implemented (out_scale must stay -1)."""
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: quantized output path not "
+            "implemented (out_scale must be -1)")
+    from ...ops._op import tensor_op as _top
+
+    @_top(name="incubate.masked_multihead_attention")
+    def _impl(x, cache, mask, seq_lens):
+        import math as _math
+        two, B, H, S_max, D = cache.shape
+        qkv = x.reshape(B, 3, H, D)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        step = seq_lens.reshape(B).astype(jnp.int32)
+        bidx = jnp.arange(B)
+        kc = cache[0].at[bidx, :, step].set(k_new)
+        vc = cache[1].at[bidx, :, step].set(v_new)
+        valid = jnp.arange(S_max)[None, None, :] <= step[:, None, None]
+        logits = jnp.einsum("bhd,bhsd->bhs", q, kc,
+                            preferred_element_type=jnp.float32) \
+            / _math.sqrt(D)
+        logits = jnp.where(valid, logits, -1e30)
+        if mask is not None:
+            logits = logits + mask.reshape(B, 1, -1)[:, :, :S_max]
+        p = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", p, vc)
+        return out.reshape(B, H * D), jnp.stack([kc, vc])
+
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention needs sequence_lengths ([B] or "
+            "[B, 1] current cache fill per row) — without it every step "
+            "would overwrite cache slot 0")
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: fused rotary path not wired; "
+            "apply fused_rotary_position_embedding before the qkv pack")
+    return _impl(x, cache_kv, src_mask, sequence_lengths)

@@ -1236,7 +1236,7 @@ def _fractional_pool_impl_mask(x, bounds, in_sizes):
         idxs.append(flat)
     out = jnp.stack(vals, axis=-1).reshape(lead + out_shape)
     mask = jnp.stack(idxs, axis=-1).reshape(lead + out_shape)
-    return out, mask.astype(jnp.int32)
+    return out, mask.astype(dtype_mod.long_dtype())
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,

@@ -35,6 +35,7 @@ def _norm_except(v, dim):
     from ... import ops
     if dim is None:
         return ops.sqrt(ops.sum(v * v))
+    dim = dim % len(v.shape)  # negative dims must exclude the right axis
     axes = [i for i in range(len(v.shape)) if i != dim]
     return ops.sqrt(ops.sum(v * v, axis=axes, keepdim=True))
 

@@ -1,0 +1,136 @@
+"""Round-5 incubate.nn.functional completions (reference:
+``python/paddle/incubate/nn/functional/`` †): functional forms of the
+fused attention/FFN blocks, packed-qkv flash, fused_matmul_bias, varlen
+memory-efficient attention, and the masked_multihead_attention decode
+op — each pinned against the corresponding layer or a manual oracle."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestFusedFunctionals:
+    def test_fused_matmul_bias(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        np.testing.assert_allclose(
+            IF.fused_matmul_bias(_t(x), _t(y), _t(b)).numpy(),
+            x @ y + b, rtol=1e-5)
+        np.testing.assert_allclose(
+            IF.fused_matmul_bias(_t(x.T), _t(y), transpose_x=True).numpy(),
+            x @ y, rtol=1e-5)
+
+    def test_flash_attn_qkvpacked_matches_unpacked(self):
+        rng = np.random.RandomState(1)
+        qkv = rng.randn(2, 8, 3, 2, 4).astype(np.float32)
+        o1, _ = IF.flash_attn_qkvpacked(_t(qkv), causal=True)
+        o2, _ = IF.flash_attention(_t(qkv[:, :, 0]), _t(qkv[:, :, 1]),
+                                   _t(qkv[:, :, 2]), causal=True)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy())
+
+    def test_fused_multi_head_attention_matches_layer(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        rng = np.random.RandomState(2)
+        m = FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0,
+                                    normalize_before=False)
+        m.eval()
+        x = rng.randn(2, 6, 8).astype(np.float32)
+        want = m(_t(x)).numpy()
+        got = IF.fused_multi_head_attention(
+            _t(x), m.qkv_weight, m.linear_weight, pre_layer_norm=False,
+            ln_scale=m.ln_scale, ln_bias=m.ln_bias, qkv_bias=m.qkv_bias,
+            linear_bias=m.linear_bias, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_fused_feedforward_matches_layer(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        rng = np.random.RandomState(3)
+        ff = FusedFeedForward(8, 16, dropout_rate=0.0, normalize_before=True)
+        ff.eval()
+        x = rng.randn(2, 6, 8).astype(np.float32)
+        want = ff(_t(x)).numpy()
+        got = IF.fused_feedforward(
+            _t(x), ff.linear1.weight, ff.linear2.weight, ff.linear1.bias,
+            ff.linear2.bias, ln1_scale=ff.norm.weight,
+            ln1_bias=ff.norm.bias, dropout1_rate=0.0, dropout2_rate=0.0,
+            pre_layer_norm=True, training=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestVarlenAndDecodeAttention:
+    def test_variable_length_attention_masks_both_sides(self):
+        rng = np.random.RandomState(4)
+        q = rng.randn(2, 2, 4, 4).astype(np.float32)
+        k = rng.randn(2, 2, 6, 4).astype(np.float32)
+        v = rng.randn(2, 2, 6, 4).astype(np.float32)
+        ql = np.asarray([3, 4], np.int32)
+        kl = np.asarray([5, 2], np.int32)
+        got = IF.variable_length_memory_efficient_attention(
+            _t(q), _t(k), _t(v), _t(ql), _t(kl)).numpy()
+        # reference documents [batch, 1] length shapes — same result
+        got2 = IF.variable_length_memory_efficient_attention(
+            _t(q), _t(k), _t(v), _t(ql[:, None]), _t(kl[:, None])).numpy()
+        np.testing.assert_allclose(got2, got)
+        for bi in range(2):
+            lg = (q[bi] @ k[bi].transpose(0, 2, 1)) / 2.0
+            lg[:, :, kl[bi]:] = -1e30
+            p = np.exp(lg - lg.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            o = p @ v[bi]
+            o[:, ql[bi]:] = 0
+            np.testing.assert_allclose(got[bi], o, rtol=1e-4, atol=1e-5)
+
+    def test_masked_multihead_attention_decode_step(self):
+        rng = np.random.RandomState(5)
+        B, H, S, D = 2, 2, 5, 4
+        cache = np.zeros((2, B, H, S, D), np.float32)
+        cache[0, :, :, :2] = rng.randn(B, H, 2, D)
+        cache[1, :, :, :2] = rng.randn(B, H, 2, D)
+        x = rng.randn(B, 3 * H * D).astype(np.float32)
+        lens = np.asarray([2, 2], np.int32)
+        out, newcache = IF.masked_multihead_attention(
+            _t(x), _t(cache), sequence_lengths=_t(lens))
+        qkv = x.reshape(B, 3, H, D)
+        for bi in range(B):
+            kc = cache[0, bi].copy()
+            vc = cache[1, bi].copy()
+            kc[:, 2] = qkv[bi, 1]
+            vc[:, 2] = qkv[bi, 2]
+            lg = np.einsum("hd,hsd->hs", qkv[bi, 0], kc[:, :3]) / 2.0
+            p = np.exp(lg - lg.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            o = np.einsum("hs,hsd->hd", p, vc[:, :3]).reshape(-1)
+            np.testing.assert_allclose(out.numpy()[bi], o, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(newcache.numpy()[0, bi, :, 2],
+                                       qkv[bi, 1], rtol=1e-6)
+
+    def test_masked_multihead_attention_rejects_quant(self):
+        import pytest
+        with pytest.raises(NotImplementedError):
+            IF.masked_multihead_attention(
+                _t(np.zeros((1, 12), np.float32)),
+                _t(np.zeros((2, 1, 1, 4, 3), np.float32)), out_scale=0.5)
+        # missing sequence_lengths would silently clobber cache slot 0
+        # on every step — must refuse (r5 review)
+        with pytest.raises(ValueError):
+            IF.masked_multihead_attention(
+                _t(np.zeros((1, 12), np.float32)),
+                _t(np.zeros((2, 1, 1, 4, 3), np.float32)))
+
+    def test_fused_mha_rejects_cache(self):
+        import pytest
+        with pytest.raises(NotImplementedError):
+            IF.fused_multi_head_attention(
+                _t(np.zeros((1, 2, 8), np.float32)),
+                _t(np.zeros((3, 2, 4, 8), np.float32)),
+                _t(np.zeros((8, 8), np.float32)),
+                cache_kv=_t(np.zeros((2, 1, 2, 4, 4), np.float32)))

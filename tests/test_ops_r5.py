@@ -497,6 +497,19 @@ class TestNNUtilsReparam:
         np.testing.assert_allclose(lin(_t(x)).numpy(), got, rtol=1e-5,
                                    atol=1e-6)
 
+    def test_weight_norm_negative_dim(self):
+        """r5 review: dim=-1 must exclude the LAST axis from the norm,
+        not silently compute a global norm."""
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import weight_norm
+        lin = nn.Linear(4, 3)
+        W = np.random.RandomState(5).randn(4, 3).astype(np.float32)
+        lin.weight.set_value(W)
+        weight_norm(lin, dim=-1)
+        assert lin.weight_g.shape == [3]
+        np.testing.assert_allclose(lin.weight_g.numpy(),
+                                   np.linalg.norm(W, axis=0), rtol=1e-5)
+
     def test_spectral_norm_unit_sigma(self):
         from paddle_tpu import nn
         from paddle_tpu.nn.utils import spectral_norm
