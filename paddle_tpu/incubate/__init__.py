@@ -4,3 +4,7 @@ from . import nn
 from . import distributed
 
 from .. import autograd as autograd  # incubate.autograd alias
+# the pre-paddle.geometric segment API lived here (reference
+# python/paddle/incubate/tensor/math.py †); same ops, older namespace
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: F401
+                         segment_sum)

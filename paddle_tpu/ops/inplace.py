@@ -106,6 +106,14 @@ def _install():
     sources["triu_"] = creation.triu
     sources["cumsum_"] = OP_REGISTRY["cumsum"]
     sources["cumprod_"] = OP_REGISTRY["cumprod"]
+    # 2.6 comparison / logical / bitwise inplace batch (the result dtype
+    # matches the receiver's for bitwise; comparisons rebind to bool —
+    # same observable contract as the reference's inplace kernels)
+    for base in ("logical_and", "logical_or", "logical_xor", "logical_not",
+                 "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+                 "less_than", "less_equal", "greater_than", "greater_equal",
+                 "not_equal", "equal"):
+        sources[base + "_"] = OP_REGISTRY[base]
     import sys
     mod = sys.modules[__name__]
     for name, fn in sources.items():

@@ -8,6 +8,8 @@ is a bilinear gather; everything compiles under jit.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -15,7 +17,7 @@ from ..ops._op import tensor_op
 
 __all__ = ["nms", "box_iou", "box_area", "roi_align", "roi_pool",
            "box_coder", "distribute_fpn_proposals", "prior_box",
-           "yolo_box", "deform_conv2d", "psroi_pool", "matrix_nms"]
+           "yolo_box", "deform_conv2d", "psroi_pool", "matrix_nms", "generate_proposals"]
 
 
 def _iou_matrix(boxes_a, boxes_b, norm=0.0):
@@ -164,12 +166,22 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
                          jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
         return out / var
-    # decode
-    t = target_box * var
+    return _decode_center_size(target_box, var, pw, ph, pcx, pcy, norm)
+
+
+def _decode_center_size(deltas, var, pw, ph, pcx, pcy, norm, clip=None):
+    """Inverse of encode_center_size (shared by box_coder's decode branch
+    and generate_proposals); ``clip`` caps the w/h log-deltas (the RPN
+    kernel's kBBoxClipDefault)."""
+    t = deltas * var
+    tw, th = t[..., 2], t[..., 3]
+    if clip is not None:
+        tw = jnp.minimum(tw, clip)
+        th = jnp.minimum(th, clip)
     cx = t[..., 0] * pw + pcx
     cy = t[..., 1] * ph + pcy
-    w = jnp.exp(t[..., 2]) * pw
-    h = jnp.exp(t[..., 3]) * ph
+    w = jnp.exp(tw) * pw
+    h = jnp.exp(th) * ph
     return jnp.stack([cx - w * 0.5, cy - h * 0.5,
                       cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
 
@@ -544,3 +556,79 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     if return_rois_num:
         res.append(num)
     return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference generate_proposals_v2 †):
+    per image, decode anchor deltas -> clip to the image -> drop
+    sub-min_size boxes -> top pre_nms_top_n by score -> hard NMS ->
+    top post_nms_top_n.
+
+    Static-shape contract: rois/roi_probs come back [N, post_nms_top_n,
+    4]/[N, post_nms_top_n] zero-padded, rois_num [N] giving the valid
+    count per image (the reference's LoD boundary)."""
+    if eta < 1.0:
+        raise NotImplementedError(
+            "generate_proposals: adaptive-NMS (eta < 1) is not "
+            "implemented — the static-shape schedule runs hard NMS; "
+            "pass eta=1.0")
+    return _generate_proposals_impl(
+        scores, bbox_deltas, img_size, anchors, variances,
+        int(pre_nms_top_n), int(post_nms_top_n), float(nms_thresh),
+        # reference FilterBoxes floors min_size at 1 pixel
+        max(float(min_size), 1.0), 1.0 if pixel_offset else 0.0,
+        return_rois_num)
+
+
+@tensor_op(differentiable=False)
+def _generate_proposals_impl(scores, bbox_deltas, img_size, anchors,
+                             variances, pre_n, post_n, nms_thresh, min_size,
+                             offset, return_rois_num):
+    N, A, H, W = scores.shape
+    M = A * H * W
+    anc = anchors.reshape(M, 4)
+    var = variances.reshape(M, 4)
+    pre_n = min(pre_n, M)
+
+    def one_image(args):
+        sc, deltas, imsz = args
+        s = sc.reshape(A, H, W).transpose(1, 2, 0).reshape(M)
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(M, 4)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        a = anc[top_i]
+        v = var[top_i]
+        dd = d[top_i]
+        aw = a[:, 2] - a[:, 0] + offset
+        ah = a[:, 3] - a[:, 1] + offset
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        # shared center-size decode; w/h log-deltas capped at the RPN
+        # kernel's kBBoxClipDefault = log(1000/16)
+        dec = _decode_center_size(dd, v, aw, ah, acx, acy, offset,
+                                  clip=math.log(1000.0 / 16.0))
+        ih, iw = imsz[0], imsz[1]
+        x1 = jnp.clip(dec[:, 0], 0, iw - offset)
+        y1 = jnp.clip(dec[:, 1], 0, ih - offset)
+        x2 = jnp.clip(dec[:, 2], 0, iw - offset)
+        y2 = jnp.clip(dec[:, 3], 0, ih - offset)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        ok = ((x2 - x1 + offset) >= min_size) & \
+             ((y2 - y1 + offset) >= min_size)
+        top_s = jnp.where(ok, top_s, -jnp.inf)
+        keep = nms.raw_fn(boxes, nms_thresh, scores=top_s, top_k=post_n)
+        good = (keep >= 0) & (jnp.take(top_s, jnp.clip(keep, 0, pre_n - 1))
+                              > -jnp.inf)
+        ki = jnp.clip(keep, 0, pre_n - 1)
+        out_b = jnp.where(good[:, None], boxes[ki], 0.0)
+        out_s = jnp.where(good, top_s[ki], 0.0)
+        return out_b, out_s, jnp.sum(good.astype(jnp.int32))
+
+    rois, probs, num = jax.lax.map(one_image, (scores, bbox_deltas,
+                                               img_size))
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs

@@ -165,6 +165,27 @@ class TestInplaceBatch:
         assert out is a
         np.testing.assert_allclose(a.numpy(), [1.0, 9.0, 3.0])
 
+    def test_comparison_logical_bitwise_inplace(self):
+        # the 2.6 inplace batch: receiver rebinds to the op result
+        a = _t(np.asarray([1, 2, 3], np.int32))
+        a.bitwise_and_(_t(np.asarray([3, 3, 3], np.int32)))
+        np.testing.assert_array_equal(a.numpy(), [1, 2, 3])
+        b = _t(np.asarray([1.0, 5.0], np.float32))
+        b.greater_than_(_t(np.asarray([2.0, 2.0], np.float32)))
+        np.testing.assert_array_equal(b.numpy(), [False, True])
+        c = _t(np.asarray([True, False]))
+        c.logical_not_()
+        np.testing.assert_array_equal(c.numpy(), [False, True])
+        d = _t(np.asarray([1.0, 2.0], np.float32))
+        d.equal_(_t(np.asarray([1.0, 3.0], np.float32)))
+        np.testing.assert_array_equal(d.numpy(), [True, False])
+
+    def test_incubate_segment_alias(self):
+        import paddle_tpu.incubate as inc
+        out = inc.segment_sum(_t(np.ones((3, 2), np.float32)),
+                              _t(np.asarray([0, 0, 1], np.int32)))
+        np.testing.assert_allclose(out.numpy(), [[2, 2], [1, 1]])
+
     def test_fill_zero_refills(self):
         k = _t(np.ones(5, np.float32))
         k.zero_()

@@ -245,3 +245,44 @@ class TestDetectionOpsR4:
                               anchors=[10, 13, 16, 30, 33, 23], class_num=C,
                               conf_thresh=1.1)
         assert float(np.abs(b0.numpy()).max()) == 0.0
+
+
+class TestGenerateProposals:
+    def test_invariants_and_static_shapes(self):
+        """RPN decode->clip->min-size->NMS->top-k (reference
+        generate_proposals_v2 †): static [N, post_n] padding with
+        rois_num giving the valid counts; kept boxes are inside the
+        image, score-sorted, and pairwise under the NMS threshold."""
+        rng = np.random.RandomState(0)
+        N, A, H, W = 2, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        img = np.asarray([[64, 64], [64, 64]], np.float32)
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for i in range(H):
+            for j in range(W):
+                for a in range(A):
+                    cx, cy = j * 16 + 8, i * 16 + 8
+                    sz = 8 * (a + 1)
+                    anchors[i, j, a] = [cx - sz, cy - sz, cx + sz, cy + sz]
+        var = np.full((H, W, A, 4), 1.0, np.float32)
+        rois, probs, num = vops.generate_proposals(
+            _t(scores), _t(deltas), _t(img), _t(anchors), _t(var),
+            pre_nms_top_n=20, post_nms_top_n=8, nms_thresh=0.7,
+            return_rois_num=True)
+        rois, probs, num = rois.numpy(), probs.numpy(), num.numpy()
+        assert rois.shape == (2, 8, 4) and probs.shape == (2, 8)
+        for b in range(N):
+            nb = int(num[b])
+            assert 1 <= nb <= 8
+            v = rois[b, :nb]
+            assert (v[:, 0] <= v[:, 2] + 1e-5).all()
+            assert v.min() >= -1e-5 and v.max() <= 64 + 1e-4
+            assert (np.diff(probs[b, :nb]) <= 1e-6).all()
+            iou = vops.box_iou(_t(v), _t(v)).numpy() - np.eye(nb)
+            assert iou.max() <= 0.7 + 1e-5
+        # adaptive-NMS eta is honestly rejected, not silently ignored
+        with pytest.raises(NotImplementedError):
+            vops.generate_proposals(
+                _t(scores), _t(deltas), _t(img), _t(anchors), _t(var),
+                eta=0.9)
