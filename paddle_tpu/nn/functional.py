@@ -1444,6 +1444,57 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
 
 
+@tensor_op
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block/CSR-pattern attention (reference
+    ``python/paddle/nn/functional/sparse_attention.py`` † over the CUDA
+    sparse-attention kernel): each query row attends only to the key
+    columns its CSR row lists.
+
+    TPU formulation: the CSR pattern (offset [B, H, S+1], columns
+    [B, H, nnz]) expands to a dense boolean mask — row ids recovered
+    with a static-shape searchsorted over the offsets, so the whole op
+    jits — and the masked softmax+PV runs as two MXU matmuls. The CUDA
+    kernel's gather/scatter saves bandwidth on sparse patterns; on TPU
+    the dense masked form keeps the MXU busy and lets XLA fuse the mask.
+    """
+    B, H, S, D = query.shape
+    nnz = sparse_csr_columns.shape[-1]
+    off = sparse_csr_offset.reshape(B, H, S + 1).astype(jnp.int32)
+    cols = sparse_csr_columns.reshape(B, H, nnz).astype(jnp.int32)
+    # row of each nnz slot t: the number of row ENDS <= t (off[1:] is
+    # the end-offset array); slots past off[-1] are padding and must not
+    # scatter, so they carry False through an at[].max write
+    row_of = jax.vmap(jax.vmap(
+        lambda o: jnp.searchsorted(o, jnp.arange(nnz), side="right")
+    ))(off[..., 1:])
+    valid_slot = jnp.arange(nnz)[None, None, :] < off[..., -1:]
+    row_of = jnp.clip(row_of, 0, S - 1)
+    mask = jnp.zeros((B, H, S, S), bool)
+    bidx = jnp.arange(B)[:, None, None]
+    hidx = jnp.arange(H)[None, :, None]
+    mask = mask.at[bidx, hidx, row_of, cols].max(valid_slot)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", query, key,
+                        preferred_element_type=jnp.float32) \
+        / math.sqrt(D)
+    logits = jnp.where(mask, logits, -1e30)
+    # reference mask contract: a value of 0 means MASKED (the CUDA kernel
+    # writes -inf there), not an additive bias
+    if key_padding_mask is not None:
+        keep = key_padding_mask.reshape(B, 1, 1, S) != 0
+        logits = jnp.where(keep, logits, -1e30)
+    if attn_mask is not None:
+        logits = jnp.where(attn_mask.reshape(1, 1, S, S) != 0, logits,
+                           -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    # rows with an empty CSR range: no valid key -> zero output
+    any_valid = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(any_valid, p, 0.0).astype(value.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, value)
+
+
 def class_center_sample(label, num_classes, num_samples, group=None,
                         name=None):
     """Sample ``num_samples`` class centers containing every positive

@@ -47,18 +47,19 @@ def box_area(boxes, name=None):
 
 @tensor_op(differentiable=False)
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
-        categories=None, top_k=None, name=None):
+        categories=None, top_k=None, name=None, _norm=0.0):
     """Hard NMS (reference paddle.vision.ops.nms): returns kept box indices
     sorted by descending score. Static-shape: the suppression runs as a
     fixed-length scan over all N candidates; with ``top_k`` the result is
-    exactly top_k indices padded with -1."""
+    exactly top_k indices padded with -1. ``_norm=1.0`` switches the IoU
+    to the +1-pixel span convention (generate_proposals' pixel_offset)."""
     n = boxes.shape[0]
     if scores is None:
         order = jnp.arange(n)
     else:
         order = jnp.argsort(-scores)
     sorted_boxes = boxes[order]
-    iou = _iou_matrix(sorted_boxes, sorted_boxes)
+    iou = _iou_matrix(sorted_boxes, sorted_boxes, norm=_norm)
     if category_idxs is not None:
         # multiclass: suppress only within the same category
         cats = category_idxs[order]
@@ -619,7 +620,8 @@ def _generate_proposals_impl(scores, bbox_deltas, img_size, anchors,
         ok = ((x2 - x1 + offset) >= min_size) & \
              ((y2 - y1 + offset) >= min_size)
         top_s = jnp.where(ok, top_s, -jnp.inf)
-        keep = nms.raw_fn(boxes, nms_thresh, scores=top_s, top_k=post_n)
+        keep = nms.raw_fn(boxes, nms_thresh, scores=top_s, top_k=post_n,
+                          _norm=offset)
         good = (keep >= 0) & (jnp.take(top_s, jnp.clip(keep, 0, pre_n - 1))
                               > -jnp.inf)
         ki = jnp.clip(keep, 0, pre_n - 1)

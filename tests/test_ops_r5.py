@@ -383,6 +383,59 @@ class TestPSRoIPoolAndMatrixNMS:
             sorted(out5.numpy()[0][:, 1])[0], 0.8 * (1 - iou_px), rtol=1e-5)
 
 
+class TestSparseAttention:
+    def test_csr_band_matches_dense_oracle(self):
+        """CSR-pattern attention == dense attention under the equivalent
+        additive mask (band pattern, ragged per-row counts)."""
+        rng = np.random.RandomState(0)
+        B, H, S, D = 2, 2, 6, 4
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        offs = np.zeros((B, H, S + 1), np.int32)
+        cols_l = []
+        for i in range(S):
+            cols_l.extend(range(max(0, i - 2), i + 1))
+            offs[:, :, i + 1] = len(cols_l)
+        cols = np.tile(np.asarray(cols_l, np.int32), (B, H, 1))
+        got = F.sparse_attention(_t(q), _t(k), _t(v), _t(offs),
+                                 _t(cols)).numpy()
+        for b in range(B):
+            for h in range(H):
+                m = np.full((S, S), -1e30)
+                for i in range(S):
+                    m[i, max(0, i - 2):i + 1] = 0.0
+                lg = q[b, h] @ k[b, h].T / 2.0 + m
+                p = np.exp(lg - lg.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                np.testing.assert_allclose(got[b, h], p @ v[b, h],
+                                           rtol=1e-4, atol=1e-5)
+        # padded nnz slots (beyond off[-1]) must not leak attention
+        cols_pad = np.concatenate(
+            [cols, np.zeros((B, H, 3), np.int32)], axis=-1)
+        got_pad = F.sparse_attention(_t(q), _t(k), _t(v), _t(offs),
+                                     _t(cols_pad)).numpy()
+        np.testing.assert_allclose(got_pad, got, rtol=1e-6)
+        # reference mask contract: 0 == masked (not an additive bias) —
+        # padding out the last 2 keys must equal truncating the pattern
+        kpm = np.ones((B, S), np.float32)
+        kpm[:, S - 2:] = 0.0
+        got_kpm = F.sparse_attention(_t(q), _t(k), _t(v), _t(offs),
+                                     _t(cols),
+                                     key_padding_mask=_t(kpm)).numpy()
+        for b in range(B):
+            for h in range(H):
+                m = np.full((S, S), -1e30)
+                for i in range(S):
+                    m[i, max(0, i - 2):i + 1] = 0.0
+                m[:, S - 2:] = -1e30
+                lg = q[b, h] @ k[b, h].T / 2.0 + m
+                p = np.exp(lg - lg.max(-1, keepdims=True))
+                p /= np.maximum(p.sum(-1, keepdims=True), 1e-30)
+                np.testing.assert_allclose(got_kpm[b, h], p @ v[b, h],
+                                           rtol=1e-4, atol=1e-5)
+
+
 class TestClassCenterSample:
     def test_contains_positives_and_remaps(self):
         paddle.seed(3)
