@@ -9,15 +9,30 @@ counter-based, reproducible under jit, vmap-safe — instead of the
 reference's stateful cuRAND ops. log_prob/entropy are pure jnp and fuse
 into surrounding programs.
 """
-from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
-                            Distribution, Exponential, Gamma, Geometric,
-                            Gumbel, Laplace, LogNormal, Multinomial, Normal,
-                            Poisson, StudentT, Uniform)
+from .distributions import (Bernoulli, Beta, Binomial, Categorical, Cauchy,
+                            Chi2, ContinuousBernoulli, Dirichlet,
+                            Distribution, Exponential, ExponentialFamily,
+                            Gamma, Geometric, Gumbel, Independent,
+                            LKJCholesky, Laplace, LogNormal, Multinomial,
+                            MultivariateNormal, Normal, Poisson, StudentT,
+                            TransformedDistribution, Uniform)
 from .kl import kl_divergence, register_kl
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
 
 __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
     "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
     "Multinomial", "Gumbel", "Geometric", "Poisson", "StudentT",
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+    "ExponentialFamily", "Independent", "LKJCholesky",
+    "MultivariateNormal", "TransformedDistribution",
     "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
 ]

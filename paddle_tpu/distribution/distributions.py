@@ -554,3 +554,335 @@ class StudentT(Distribution):
         return _wrap(gl((df + 1) / 2) - gl(df / 2)
                      - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
                      - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(self._key(), shp, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(z ** 2))
+
+    def entropy(self):
+        out = jnp.log(4 * math.pi * self.scale)
+        return _wrap(jnp.broadcast_to(out, self._batch_shape))
+
+    def cdf(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return _wrap(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Chi2(Gamma):
+    """Chi-squared with ``df`` degrees of freedom = Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _val(df)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df / 2.0, 0.5))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _val(total_count)
+        # same degenerate-parameter clip convention as Bernoulli above:
+        # probs 0/1 are valid parameterizations and must not NaN log_prob
+        self.probs = jnp.clip(_val(probs), 1e-7, 1 - 1e-7)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        out = jax.random.binomial(self._key(),
+                                  self.total_count.astype(jnp.float32),
+                                  self.probs.astype(jnp.float32), shape=shp)
+        return _wrap(out)
+
+    def log_prob(self, value):
+        v = _val(value)
+        n, p = self.total_count, self.probs
+        gl = jax.scipy.special.gammaln
+        return _wrap(gl(n + 1) - gl(v + 1) - gl(n - v + 1)
+                     + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0, 1] (reference ContinuousBernoulli;
+    Loaiza-Ganem & Cunningham 2019). ``lims`` brackets the unstable
+    region around probs=0.5 where the normalizer's Taylor limit is
+    used."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _val(probs)
+        self.lims = lims
+        super().__init__(self.probs.shape)
+
+    def _safe_p(self):
+        lo, hi = self.lims
+        mid = (self.probs < lo) | (self.probs > hi)
+        return jnp.where(mid, self.probs, lo)
+
+    def _log_norm(self):
+        # C(p) = 2 atanh(1-2p) / (1-2p), -> 2 as p -> 0.5
+        lo, hi = self.lims
+        outside = (self.probs < lo) | (self.probs > hi)
+        p = self._safe_p()
+        c = 2.0 * jnp.arctanh(1 - 2 * p) / (1 - 2 * p)
+        x = self.probs - 0.5
+        taylor = 2.0 + (8.0 / 3.0) * x ** 2  # series about p = 0.5
+        return jnp.log(jnp.where(outside, c, taylor))
+
+    @property
+    def mean(self):
+        lo, hi = self.lims
+        outside = (self.probs < lo) | (self.probs > hi)
+        p = self._safe_p()
+        m = p / (2 * p - 1) + 1.0 / (2 * jnp.arctanh(1 - 2 * p))
+        return _wrap(jnp.where(outside, m, 0.5))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(self._key(), shp, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        lo, hi = self.lims
+        outside = (self.probs < lo) | (self.probs > hi)
+        p = self._safe_p()
+        # inverse CDF: x = [log(u(2p-1)/(1-p) + 1)] / log(p/(1-p))
+        num = jnp.log1p(u * (2 * p - 1) / (1 - p))
+        den = jnp.log(p) - jnp.log1p(-p)
+        return _wrap(jnp.where(outside, num / den, u))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = self.probs
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                     + self._log_norm())
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _val(loc)
+        if scale_tril is not None:
+            self._L = _val(scale_tril)
+        elif covariance_matrix is not None:
+            self._L = jnp.linalg.cholesky(_val(covariance_matrix))
+        elif precision_matrix is not None:
+            self._L = jnp.linalg.cholesky(
+                jnp.linalg.inv(_val(precision_matrix)))
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix "
+                             "/ scale_tril is required")
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._L.shape[:-2])
+        super().__init__(batch, self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc, self._batch_shape + self._event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return _wrap(self._L @ jnp.swapaxes(self._L, -1, -2))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.sum(self._L ** 2, axis=-1),
+            self._batch_shape + self._event_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape) + tuple(self._event_shape)
+        eps = jax.random.normal(self._key(), shp)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._L, eps))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        d = int(self._event_shape[0])
+        diff = _val(value) - self.loc
+        # solve L z = diff; quad form = ||z||^2 (L broadcast over any
+        # leading sample dims of `value`)
+        z = jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(self._L, diff.shape[:-1] + (d, d)),
+            diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._L, axis1=-2, axis2=-1)), axis=-1)
+        return _wrap(-0.5 * jnp.sum(z ** 2, -1) - half_logdet
+                     - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = int(self._event_shape[0])
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._L, axis1=-2, axis2=-1)), axis=-1)
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return _wrap(jnp.broadcast_to(out, self._batch_shape))
+
+
+class ExponentialFamily(Distribution):
+    """Abstract exponential-family base (reference ExponentialFamily †):
+    subclasses expose natural parameters + log-normalizer and inherit
+    the Bregman-divergence entropy. The concrete family classes here
+    implement entropy directly, so this base exists for API parity and
+    user subclasses."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (reference
+    Independent): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = tuple(base._batch_shape)
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + tuple(base._event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _val(self.base.log_prob(value))
+        return _wrap(jnp.sum(
+            lp, axis=tuple(range(lp.ndim - self.rank, lp.ndim))))
+
+    def entropy(self):
+        e = _val(self.base.entropy())
+        return _wrap(jnp.sum(
+            e, axis=tuple(range(e.ndim - self.rank, e.ndim))))
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms (reference
+    TransformedDistribution): sample = T(base.sample()), log_prob via the
+    inverse log-det."""
+
+    def __init__(self, base, transforms, name=None):
+        from .transform import ChainTransform
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        shape = tuple(base._batch_shape) + tuple(base._event_shape)
+        out = self._chain.forward_shape(shape)
+        # torch convention: the result's event rank is the max of the
+        # base's and the chain's (an elementwise transform over a
+        # vector-event base keeps the vector event)
+        er = max(self._chain._event_rank, len(base._event_shape))
+        super().__init__(out[:len(out) - er] if er else out,
+                         out[len(out) - er:] if er else ())
+
+    def sample(self, shape=()):
+        x = _val(self.base.sample(shape))
+        return _wrap(self._chain._forward(x))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _val(value)
+        x = self._chain._inverse(y)
+        base_lp = _val(self.base.log_prob(_wrap(x)))
+        ld = self._chain._forward_log_det_jacobian(x)
+        er = max(self._chain._event_rank, len(self.base._event_shape))
+        # an elementwise chain over a vector-event base: its per-element
+        # log-dets sum over the event dims
+        extra_ld = er - self._chain._event_rank
+        if extra_ld > 0:
+            ld = jnp.sum(ld, axis=tuple(range(ld.ndim - extra_ld, ld.ndim)))
+        # a higher-event-rank chain over a scalar base: the base's
+        # per-element log-probs sum over the dims the chain made event
+        extra_lp = er - len(self.base._event_shape)
+        if extra_lp > 0:
+            base_lp = jnp.sum(base_lp, axis=tuple(
+                range(base_lp.ndim - extra_lp, base_lp.ndim)))
+        return _wrap(base_lp - ld)
+
+
+class LKJCholesky(Distribution):
+    """Cholesky factor of an LKJ-distributed correlation matrix
+    (reference LKJCholesky; onion-method sampler)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        self.dim = int(dim)
+        self.concentration = _val(concentration)
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method!r}")
+        super().__init__(self.concentration.shape,
+                         (self.dim, self.dim))
+        marginal = self.concentration + 0.5 * (self.dim - 2)
+        offset = jnp.concatenate([jnp.zeros(1),
+                                  jnp.arange(self.dim - 1, dtype=jnp.float32)])
+        self._beta_a = offset + 0.5
+        self._beta_b = marginal[..., None] - 0.5 * offset
+
+    def sample(self, shape=()):
+        d = self.dim
+        shp = _shape(shape, self._batch_shape)
+        y = jax.random.beta(self._key(), self._beta_a, self._beta_b,
+                            shp + (d,))[..., None]
+        u = jax.random.normal(self._key(), shp + (d, d))
+        u = jnp.tril(u, -1)
+        norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+        u_sphere = jnp.where(norm > 0, u / jnp.maximum(norm, 1e-12), 0.0)
+        w = jnp.sqrt(y) * u_sphere
+        diag = jnp.sqrt(jnp.clip(1.0 - jnp.sum(w ** 2, axis=-1), 1e-12))
+        L = w + jnp.zeros_like(w).at[..., jnp.arange(d), jnp.arange(d)].set(
+            diag)
+        return _wrap(L)
+
+    def log_prob(self, value):
+        L = _val(value)
+        d = self.dim
+        conc = self.concentration
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        exponents = 2.0 * (conc[..., None] - 1.0) + d - order
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(exponents * jnp.log(diag), axis=-1)
+        dm1 = d - 1
+        alpha = conc + 0.5 * dm1
+        gl = jax.scipy.special.gammaln
+        numer = jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+        denom = gl(alpha) * dm1
+        norm = 0.5 * dm1 * math.log(math.pi) + numer - denom
+        return _wrap(unnorm - norm)

@@ -1,0 +1,321 @@
+"""paddle.distribution transforms (reference:
+``python/paddle/distribution/transform.py`` — the bijector family
+backing TransformedDistribution).
+
+Each transform is pure jnp on Tensor values: forward / inverse /
+forward_log_det_jacobian / inverse_log_det_jacobian plus the
+shape-mapping helpers; everything fuses under jit like any other op.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distributions import _val, _wrap
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class Transform:
+    """Base bijector (reference Transform †). Subclasses implement
+    ``_forward``/``_inverse``/``_forward_log_det_jacobian`` on raw jnp
+    arrays; the public surface wraps Tensors."""
+
+    _event_rank = 0  # event dims consumed by one application
+
+    def forward(self, x):
+        return _wrap(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _val(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    """y = |x| — not injective; the inverse picks the positive branch and
+    log-det is undefined (reference raises too)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "AbsTransform is not injective: log_det_jacobian is undefined")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _val(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) computed stably: 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """exp-then-normalize over the last axis (reference SoftmaxTransform;
+    not bijective — log_det raises, inverse maps to log space)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not injective: log_det_jacobian is "
+            "undefined")
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _batch(self, x, event):
+        n = len(event)
+        return x.shape[:len(x.shape) - n] if n else x.shape
+
+    def _forward(self, x):
+        return x.reshape(self._batch(x, self.in_event_shape)
+                         + self.out_event_shape)
+
+    def _inverse(self, y):
+        return y.reshape(self._batch(y, self.out_event_shape)
+                         + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros(self._batch(x, self.in_event_shape))
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Reinterprets trailing batch dims of ``base`` as event dims: the
+    log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - self.rank, ld.ndim)))
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max([t._event_rank for t in self.transforms]
+                               or [0])
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            # reduce per-element log-dets of lower-rank transforms onto
+            # this chain's event rank so the terms add consistently
+            extra = self._event_rank - t._event_rank
+            if extra and ld.ndim >= extra:
+                ld = jnp.sum(ld, axis=tuple(range(ld.ndim - extra, ld.ndim)))
+            total = total + ld
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slice i along ``axis`` (reference
+    StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _apply(self, x, method):
+        parts = [getattr(t, method)(xi) for t, xi in
+                 zip(self.transforms,
+                     [jnp.squeeze(s, self.axis) for s in
+                      jnp.split(x, len(self.transforms), axis=self.axis)])]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._apply(x, "_forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> interior of the (K+1)-simplex via stick breaking (reference
+    StickBreakingTransform)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        K = x.shape[-1]
+        offset = K - jnp.arange(K, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        K = y.shape[-1] - 1
+        cum = jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype),
+             jnp.cumsum(y[..., :-1], axis=-1)], axis=-1)[..., :-1]
+        rest = 1.0 - cum
+        z = y[..., :-1] / rest
+        offset = K - jnp.arange(K, dtype=y.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        K = x.shape[-1]
+        offset = K - jnp.arange(K, dtype=x.dtype)
+        t = x - jnp.log(offset)
+        z = jax.nn.sigmoid(t)
+        rest = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, axis=-1)[..., :-1]], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
