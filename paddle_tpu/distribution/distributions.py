@@ -681,6 +681,20 @@ class ContinuousBernoulli(Distribution):
                      + self._log_norm())
 
 
+def _half_logdet(L):
+    """sum(log diag(L)) — half the log-determinant of L L^T."""
+    return jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+
+
+def _tri_solve_vec(L, diff):
+    """Solve L z = diff for a batch of vectors, broadcasting L over any
+    leading sample/batch dims of ``diff``."""
+    d = diff.shape[-1]
+    return jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(L, diff.shape[:-1] + (d, d)),
+        diff[..., None], lower=True)[..., 0]
+
+
 class MultivariateNormal(Distribution):
     def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
                  scale_tril=None, name=None):
@@ -724,21 +738,13 @@ class MultivariateNormal(Distribution):
     def log_prob(self, value):
         d = int(self._event_shape[0])
         diff = _val(value) - self.loc
-        # solve L z = diff; quad form = ||z||^2 (L broadcast over any
-        # leading sample dims of `value`)
-        z = jax.scipy.linalg.solve_triangular(
-            jnp.broadcast_to(self._L, diff.shape[:-1] + (d, d)),
-            diff[..., None], lower=True)[..., 0]
-        half_logdet = jnp.sum(jnp.log(
-            jnp.diagonal(self._L, axis1=-2, axis2=-1)), axis=-1)
-        return _wrap(-0.5 * jnp.sum(z ** 2, -1) - half_logdet
+        z = _tri_solve_vec(self._L, diff)  # quad form = ||z||^2
+        return _wrap(-0.5 * jnp.sum(z ** 2, -1) - _half_logdet(self._L)
                      - 0.5 * d * math.log(2 * math.pi))
 
     def entropy(self):
         d = int(self._event_shape[0])
-        half_logdet = jnp.sum(jnp.log(
-            jnp.diagonal(self._L, axis1=-2, axis2=-1)), axis=-1)
-        out = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + _half_logdet(self._L)
         return _wrap(jnp.broadcast_to(out, self._batch_shape))
 
 

@@ -9,9 +9,10 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
-                            Distribution, Exponential, Gamma, Laplace,
-                            Normal, Uniform)
+from .distributions import (Bernoulli, Beta, Categorical, Cauchy,
+                            Dirichlet, Distribution, Exponential, Gamma,
+                            Laplace, MultivariateNormal, Normal, Uniform,
+                            _half_logdet, _tri_solve_vec)
 
 _KL_REGISTRY = {}
 
@@ -105,3 +106,25 @@ def _kl_dirichlet(p, q):
     return (gl(s1) - jnp.sum(gl(c1), -1) - gl(jnp.sum(c2, -1))
             + jnp.sum(gl(c2), -1)
             + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # Chen et al. 2019: closed-form KL between Cauchy distributions
+    return jnp.log(((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+                   / (4.0 * p.scale * q.scale))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = int(p._event_shape[0])
+    Lp, Lq = p._L, q._L
+    # tr(Sq^-1 Sp) = ||Lq^-1 Lp||_F^2; batch dims broadcast both ways
+    # (batched posterior vs unbatched prior is the standard VI shape)
+    bshape = jnp.broadcast_shapes(Lp.shape[:-2], Lq.shape[:-2])
+    M = jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(Lq, bshape + (d, d)),
+        jnp.broadcast_to(Lp, bshape + (d, d)), lower=True)
+    tr = jnp.sum(M ** 2, axis=(-2, -1))
+    quad = jnp.sum(_tri_solve_vec(Lq, q.loc - p.loc) ** 2, axis=-1)
+    return (_half_logdet(Lq) - _half_logdet(Lp) + 0.5 * (tr + quad - d))

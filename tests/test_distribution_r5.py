@@ -95,6 +95,55 @@ class TestNewDistributions:
                                    rtol=1e-4)
 
 
+class TestNewKLs:
+    def test_cauchy_and_mvn_kl_match_torch(self):
+        rng = np.random.RandomState(0)
+        p = D.Cauchy(_t(np.float32(0.0)), _t(np.float32(1.0)))
+        q = D.Cauchy(_t(np.float32(2.0)), _t(np.float32(3.0)))
+        np.testing.assert_allclose(
+            float(D.kl_divergence(p, q)),
+            float(torch.distributions.kl_divergence(
+                torch.distributions.Cauchy(0.0, 1.0),
+                torch.distributions.Cauchy(2.0, 3.0))), rtol=1e-5)
+        A = rng.randn(3, 3).astype(np.float32)
+        c1 = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+        B = rng.randn(3, 3).astype(np.float32)
+        c2 = (B @ B.T + 3 * np.eye(3)).astype(np.float32)
+        l1 = rng.randn(3).astype(np.float32)
+        l2 = rng.randn(3).astype(np.float32)
+        got = float(D.kl_divergence(
+            D.MultivariateNormal(_t(l1), covariance_matrix=_t(c1)),
+            D.MultivariateNormal(_t(l2), covariance_matrix=_t(c2))))
+        want = float(torch.distributions.kl_divergence(
+            torch.distributions.MultivariateNormal(torch.tensor(l1),
+                                                   torch.tensor(c1)),
+            torch.distributions.MultivariateNormal(torch.tensor(l2),
+                                                   torch.tensor(c2))))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_mvn_kl_batched_posterior_vs_unbatched_prior(self):
+        """r5 review: the standard VI shape — batched posterior against
+        an unbatched prior — must broadcast, returning a [B] KL."""
+        rng = np.random.RandomState(0)
+        locs = rng.randn(4, 3).astype(np.float32)
+        A = rng.randn(3, 3).astype(np.float32)
+        cov = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+        kl = D.kl_divergence(
+            D.MultivariateNormal(_t(locs),
+                                 covariance_matrix=_t(np.tile(cov,
+                                                              (4, 1, 1)))),
+            D.MultivariateNormal(_t(np.zeros(3, np.float32)),
+                                 covariance_matrix=_t(
+                                     np.eye(3, dtype=np.float32)))).numpy()
+        assert kl.shape == (4,)
+        want = torch.distributions.kl_divergence(
+            torch.distributions.MultivariateNormal(
+                torch.tensor(locs), torch.tensor(np.tile(cov, (4, 1, 1)))),
+            torch.distributions.MultivariateNormal(
+                torch.zeros(3), torch.eye(3))).numpy()
+        np.testing.assert_allclose(kl, want, rtol=1e-4)
+
+
 class TestWrappers:
     def test_independent_sums_event_dims(self):
         rng = np.random.RandomState(1)
