@@ -7,16 +7,29 @@ kernel inside ``paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu``
 cache of which only ``lengths[b]`` entries are valid. Design points:
 
 - **Ragged lengths** ([B] int32, scalar-prefetched to SMEM): KV blocks
-  entirely past a row's length are skipped — compute cost scales with the
+  entirely past a row's length are skipped — HBM cost scales with the
   *valid* cache, not S_max, like the ragged/paged-attention kernels this
   slot is named for in SURVEY §3.5 / PAPERS.md.
-- **GQA inside the kernel**: the grid iterates kv heads and each step
-  attends the G = H/Hkv query heads of that group against ONE copy of the
-  kv block. The jnp path materializes ``jnp.repeat(cache, G)`` — G× the
-  HBM traffic of the cache read, and decode is bandwidth-bound.
 - **No transpose of the cache**: the kernel reads the paddle cache layout
-  [B, S_max, Hkv, D] directly via the BlockSpec index map, so no
+  [B, S_max, Hkv, D] directly (viewed as [B, S_max, Hkv*D] — a free
+  reshape, identical memory layout) via the BlockSpec index map, so no
   [B,S,H,D] -> [B,H,S,D] HBM pass precedes it.
+- **Mosaic-conservative lowering** (the r4 kernel was rejected by the
+  real TPU compiler: a (1, block_k, 1, D) KV block has last-two dims
+  (1, D) that neither divide (8, 128) nor equal the full (Hkv, D)).
+  This version uses ONLY 2D tiles whose last-two block dims equal the
+  full array dims, and only plain 2D ``dot_general`` — no sublane
+  slicing, no batch dims, no cross-tile reshapes. GQA head matching is
+  done with a **block-diagonal wide query**: q is expanded outside the
+  kernel to [H, Hkv*D] with head h's D values placed at its kv-group's
+  lane offset and zeros elsewhere, so one [H,KD]x[KD,bk] matmul yields
+  exactly the per-head logits (cross-head terms multiply zeros). The
+  PV matmul symmetrically produces a wide [H, Hkv*D] accumulator whose
+  per-head diagonal block is extracted outside the kernel. This costs
+  ~Hkv x more MXU FLOPs than a sliced kernel, but decode is HBM-bound
+  (cache+weight streaming) and the MXU is ~100x idle at bench shapes;
+  HBM traffic — the real bottleneck — is unchanged (cache read once,
+  no G x GQA repeat).
 
 Inference-only (no VJP): decode never backpropagates.
 """
@@ -36,10 +49,10 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, scale, block_k, s_max):
+                   acc_scr, *, scale, block_k):
     b = pl.program_id(0)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
     length = len_ref[b]
 
     @pl.when(ki == 0)
@@ -50,9 +63,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
     @pl.when(ki * block_k < length)  # ragged skip: block fully past length
     def _compute():
-        q = q_ref[0, 0]                     # [G, D]
-        k = k_ref[0, :, 0]                  # [block_k, D]
-        v = v_ref[0, :, 0]                  # [block_k, D]
+        q = q_ref[0]                        # [H, Hkv*D] block-diagonal
+        k = k_ref[0]                        # [block_k, Hkv*D]
+        v = v_ref[0]                        # [block_k, Hkv*D]
+        # one 2D matmul = all heads' logits (zeros kill cross-head terms)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -79,24 +93,25 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     @pl.when(ki == nk - 1)
     def _finish():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def _decode_call(q, k_cache, v_cache, lengths, scale, block_k, interpret):
-    B, Hkv, G, D = q.shape
-    s_max = k_cache.shape[1]
+def _decode_call(q_wide, kv_k, kv_v, lengths, scale, block_k, interpret):
+    """q_wide: [B, H, KD] block-diagonal; kv_*: [B, S_max, KD]."""
+    B, H, KD = q_wide.shape
+    s_max = kv_k.shape[1]
     nk = pl.cdiv(s_max, block_k)
-    grid = (B, Hkv, nk)
-    kernel = functools.partial(_decode_kernel, scale=scale, block_k=block_k,
-                               s_max=s_max)
-    def _kv_index(b, h, ki, lens):
+    grid = (B, nk)
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=block_k)
+
+    def _kv_index(b, ki, lens):
         # ragged DMA skip: blocks fully past lens[b] re-reference the last
         # valid block instead of fetching — Pallas elides the copy when the
         # block index repeats, so HBM traffic scales with the VALID cache
         # length, not S_max (the compute for those steps is pl.when-gated
         # off anyway). This is the paged-attention fetch pattern.
         last = (jnp.maximum(lens[b], 1) - 1) // block_k
-        return (b, jnp.minimum(ki, last), h, 0)
+        return (b, jnp.minimum(ki, last), 0)
 
     out = pl.pallas_call(
         kernel,
@@ -104,22 +119,21 @@ def _decode_call(q, k_cache, v_cache, lengths, scale, block_k, interpret):
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, G, D), lambda b, h, ki, lens: (b, h, 0, 0)),
-                pl.BlockSpec((1, block_k, 1, D), _kv_index),
-                pl.BlockSpec((1, block_k, 1, D), _kv_index),
+                pl.BlockSpec((1, H, KD), lambda b, ki, lens: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, KD), _kv_index),
+                pl.BlockSpec((1, block_k, KD), _kv_index),
             ],
-            out_specs=pl.BlockSpec((1, 1, G, D),
-                                   lambda b, h, ki, lens: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, H, KD), lambda b, ki, lens: (b, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((G, 128), jnp.float32),
-                pltpu.VMEM((G, 128), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
+                pltpu.VMEM((H, 128), jnp.float32),
+                pltpu.VMEM((H, 128), jnp.float32),
+                pltpu.VMEM((H, KD), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
-        compiler_params=_cparams(("parallel", "parallel", "arbitrary")),
+        out_shape=jax.ShapeDtypeStruct((B, H, KD), q_wide.dtype),
+        compiler_params=_cparams(("parallel", "arbitrary")),
         interpret=interpret,
-    )(lengths, q, k_cache, v_cache)
+    )(lengths, q_wide, kv_k, kv_v)
     return out
 
 
@@ -128,13 +142,13 @@ def _decode_call(q, k_cache, v_cache, lengths, scale, block_k, interpret):
 # pallas_call is unsupported in interpret mode. The custom rule keeps the
 # linearizer out of the kernel; actually differentiating decode raises.
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _decode(q, k_cache, v_cache, lengths, scale, block_k):
-    return _decode_call(q, k_cache, v_cache, lengths, scale, block_k,
+def _decode(q_wide, kv_k, kv_v, lengths, scale, block_k):
+    return _decode_call(q_wide, kv_k, kv_v, lengths, scale, block_k,
                         _interpret_mode())
 
 
-def _decode_fwd_rule(q, k_cache, v_cache, lengths, scale, block_k):
-    return _decode(q, k_cache, v_cache, lengths, scale, block_k), None
+def _decode_fwd_rule(q_wide, kv_k, kv_v, lengths, scale, block_k):
+    return _decode(q_wide, kv_k, kv_v, lengths, scale, block_k), None
 
 
 def _decode_bwd_rule(scale, block_k, res, g):
@@ -156,7 +170,8 @@ def decode_attention_pallas(q, k_cache, v_cache, lengths, block_k=256):
     lengths:  [B] int32       — valid cache entries per row (ragged)
     returns:  [B, H, D]
 
-    GQA (Hkv < H) is resolved inside the kernel; kv blocks past
+    GQA (Hkv < H) is resolved inside the kernel via the block-diagonal
+    wide-query trick (see module docstring); kv blocks past
     ``lengths[b]`` are skipped per row.
     """
     B, H, D = q.shape
@@ -164,11 +179,26 @@ def decode_attention_pallas(q, k_cache, v_cache, lengths, block_k=256):
     assert H % Hkv == 0, (H, Hkv)
     G = H // Hkv
     s_max = k_cache.shape[1]
+    KD = Hkv * D
     scale = 1.0 / math.sqrt(D)
     bk = min(block_k, s_max)
+    if s_max % bk or (bk % 8 and bk != s_max):
+        # Mosaic: the KV block's second-to-last dim must be a multiple of
+        # 8 or equal s_max. Largest multiple-of-8 divisor of s_max wins;
+        # if s_max has none (not divisible by 8), a single full-length
+        # block is the only legal tiling.
+        cands = [d for d in range(8, bk + 1, 8) if s_max % d == 0]
+        bk = max(cands) if cands else s_max
     lengths = jnp.asarray(lengths, jnp.int32).reshape(B)
-    qg = q.reshape(B, Hkv, G, D)
-    out = _decode(qg, k_cache, v_cache, lengths, scale, bk)
+    # block-diagonal wide query: head h's D values at its kv group's lanes
+    eye = jnp.eye(Hkv, dtype=q.dtype)
+    q_wide = jnp.einsum("bkgd,kj->bkgjd", q.reshape(B, Hkv, G, D), eye)
+    q_wide = q_wide.reshape(B, H, KD)
+    out_wide = _decode(q_wide, k_cache.reshape(B, s_max, KD),
+                       v_cache.reshape(B, s_max, KD), lengths, scale, bk)
+    # extract each head's own kv-group block from the wide accumulator
+    out = jnp.einsum("bkgjd,kj->bkgd",
+                     out_wide.reshape(B, Hkv, G, Hkv, D), eye)
     return out.reshape(B, H, D)
 
 
