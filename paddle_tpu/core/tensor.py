@@ -19,6 +19,30 @@ import numpy as np
 from . import dtype as dtype_mod
 from ..autograd import engine
 
+# Print options consumed by Tensor.__repr__ only (set via
+# paddle.set_printoptions). Scoped to Tensor rendering — the reference's
+# printer options don't leak into how user numpy arrays print, so these are
+# applied in a np.printoptions context at repr time rather than mutating
+# numpy's process-global state. None = numpy's own default.
+_print_options = {"precision": None, "threshold": None, "edgeitems": None,
+                  "linewidth": None, "sci_mode": None}
+
+
+def _format_value(v):
+    opts = {k: _print_options[k]
+            for k in ("precision", "threshold", "edgeitems", "linewidth")
+            if _print_options[k] is not None}
+    sci = _print_options["sci_mode"]
+    if sci is True:
+        prec = _print_options["precision"] or 8
+        opts["formatter"] = {"float_kind": lambda x:
+                             np.format_float_scientific(x, precision=prec,
+                                                        unique=False)}
+    elif sci is False:
+        opts["suppress"] = True
+    with np.printoptions(**opts):
+        return str(v)
+
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
@@ -149,7 +173,7 @@ class Tensor:
     def __repr__(self):
         sg = self.stop_gradient
         return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
-                f"stop_gradient={sg},\n       {self._value})")
+                f"stop_gradient={sg},\n       {_format_value(self._value)})")
 
     # ------------------------------------------------------------------ grad
     @property

@@ -170,3 +170,29 @@ _install_tensor_methods()
 # table so their Tensor bindings see the functional ops in place
 from .inplace import *  # noqa: E402,F401,F403
 from .tail import *  # noqa: E402,F401,F403
+
+# ---------------------------------------------------------------- registry
+# Public ops that are thin normalization wrappers over privately-registered
+# @tensor_op kernels, or composites of registered ops. The reference's
+# OpInfoMap enumerates these under their public names (python/paddle/
+# tensor/manipulation.py †); register the same public surface here so the
+# registry reflects what users actually call.
+from ._op import register_op as _reg  # noqa: E402
+from . import extra as _extra_mod  # noqa: E402
+from .tail import view as _view_op  # noqa: E402
+
+for _f in (reshape, split, chunk, unstack, unbind, tile, broadcast_to,
+           expand, expand_as, broadcast_tensors, scatter_nd, pad, cast,
+           numel, shape, floor_mod, _view_op,
+           _extra_mod.bucketize, _extra_mod.lu_unpack,
+           _extra_mod.broadcast_shape, _extra_mod.tensor_split,
+           _extra_mod.hsplit, _extra_mod.vsplit, _extra_mod.dsplit,
+           _extra_mod.tolist, _extra_mod.rank, _extra_mod.is_tensor,
+           _extra_mod.is_complex, _extra_mod.is_floating_point,
+           _extra_mod.is_integer, _extra_mod.is_empty,
+           _extra_mod.tril_indices, _extra_mod.triu_indices,
+           _extra_mod.poisson, _extra_mod.randint_like,
+           _extra_mod.set_printoptions):
+    _reg(_f)
+# astype is the Tensor-method spelling of cast (distinct public surface)
+_reg(cast, name="astype")

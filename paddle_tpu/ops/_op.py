@@ -128,6 +128,19 @@ def tensor_op(fn=None, *, differentiable=True, name=None):
     return deco
 
 
+def register_op(fn, name=None):
+    """Record an already-built public op callable in OP_REGISTRY.
+
+    Some public ops are thin argument-normalization wrappers over a
+    ``@tensor_op`` kernel that registered under a private name (``tile``
+    normalizes ``repeat_times`` then calls the registered ``_tile``) or are
+    composites of registered ops (``chunk`` → ``split``). The reference
+    enumerates these by their *public* name in OpInfoMap; this records the
+    same public surface so registry enumeration matches what users call."""
+    OP_REGISTRY[name or fn.__name__] = fn
+    return fn
+
+
 def unwrap(x):
     """Tensor → jax value (identity for non-Tensors)."""
     if isinstance(x, Tensor):

@@ -354,7 +354,7 @@ __all__ += [
     "masked_scatter", "multiplex", "mv", "nanmedian", "poisson", "polygamma",
     "randint_like", "rank", "select_scatter", "sgn", "shard_index",
     "strided_slice", "take", "tolist", "tril_indices", "triu_indices",
-    "unflatten", "unique_consecutive", "view_as",
+    "unflatten", "unique_consecutive", "view_as", "set_printoptions",
 ]
 
 
@@ -646,3 +646,33 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
     out = jax.random.randint(random_mod.next_key(), v.shape, int(low),
                              int(high))
     return _T(out.astype(dt))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure how Tensor values render in ``repr``/``print``.
+
+    The reference implements this as a module-level options object consumed
+    by the tensor printer (python/paddle/tensor/to_string.py †) — scoped to
+    tensor printing, NOT to how user numpy arrays print. Mirrored here: the
+    options live in ``core.tensor._print_options`` and Tensor.__repr__
+    applies them inside a ``np.printoptions`` context, so numpy's
+    process-global print state is never touched.
+
+    Args mirror the reference: ``precision`` (significant digits, default
+    8), ``threshold`` (total elements before summarization, default 1000),
+    ``edgeitems`` (items shown per dim edge when summarizing, default 3),
+    ``sci_mode`` (True forces scientific notation, False forbids it,
+    None/unset = auto), ``linewidth`` (chars per line, default 80).
+    """
+    from ..core.tensor import _print_options
+    if precision is not None:
+        _print_options["precision"] = int(precision)
+    if threshold is not None:
+        _print_options["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _print_options["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _print_options["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        _print_options["sci_mode"] = bool(sci_mode)

@@ -369,11 +369,6 @@ def shape(x):
 
 
 @tensor_op
-def as_strided_like_view(x):  # placeholder parity stub
-    return x
-
-
-@tensor_op
 def tensordot(x, y, axes=2):
     return jnp.tensordot(x, y, axes=axes)
 
