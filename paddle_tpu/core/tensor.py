@@ -34,7 +34,8 @@ def _format_value(v):
             if _print_options[k] is not None}
     sci = _print_options["sci_mode"]
     if sci is True:
-        prec = _print_options["precision"] or 8
+        prec = _print_options["precision"]
+        prec = 8 if prec is None else prec
         opts["formatter"] = {"float_kind": lambda x:
                              np.format_float_scientific(x, precision=prec,
                                                         unique=False)}

@@ -91,17 +91,28 @@ class TestBenchDriverFlow:
                                       "top_ops": []}), ""
             if leg == "--decode":
                 assert timeout == bench.DECODE_TIMEOUT_S
-                return 124, "", "# decode: model built, compiling generate()"
+                attn = args[args.index("--decode") + 1]
+                if attn == "pallas":  # pallas child dies -> jnp fallback
+                    return 124, "", \
+                        "# decode: model built, compiling generate()"
+                return 0, json.dumps({"name": "decode[jnp]", "ok": True,
+                                      "attn": "jnp", "decode_tok_s": 321.0,
+                                      "decode_mbu": 0.4, "B": 8,
+                                      "prompt": 128, "max_new": 256}), ""
             raise AssertionError(args)
 
         bench._run = fake_run
         doc = _headline(bench)
         assert doc["value"] > 0
+        assert "decode[jnp] 321" in doc["unit"]
         # decode is the final leg: a wedge there cannot cost the trace
         assert order[-1] == "--decode" and "--trace" in order
         art = json.load(open(bench.SELF_BENCH_PATH))
-        assert art["decode"]["ok"] is False and art["decode"]["rc"] == 124
-        assert "compiling generate" in art["decode"]["stderr_tail"]
+        assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
+        # the pallas attempt's forensic trail rides along with the success
+        (fa,) = art["decode"]["failed_attempts"]
+        assert fa["attn"] == "pallas" and fa["rc"] == 124
+        assert "compiling generate" in fa["stderr_tail"]
         assert art["record"]["provenance_note"] == "session-2 sweep"
         assert art["layer7b"]["layer7b_mfu"] == 0.5
         # prior best rides along so a later fallback can still cite it
