@@ -35,6 +35,7 @@ bool = bool_  # paddle.bool
 # subpackages (imported lazily below to keep import time sane)
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
+from . import regularizer  # noqa: E402
 from . import amp  # noqa: E402
 from . import io  # noqa: E402
 from . import vision  # noqa: E402

@@ -108,6 +108,10 @@ class Layer:
             p.optimize_attr = {"learning_rate": lr}
         if attr is not None and getattr(attr, "trainable", True) is False:
             p.trainable = False
+        if attr is not None and getattr(attr, "regularizer", None) is not None:
+            # consumed by Optimizer.step(): per-param regularizer overrides
+            # the optimizer-level weight_decay (reference precedence)
+            p.regularizer = attr.regularizer
         return p
 
     def add_parameter(self, name, parameter):

@@ -34,13 +34,20 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        # Normalize weight_decay to ONE representation: a callable
+        # penalty-gradient `_wd_fn` (bare float == L2Decay(float), the
+        # reference convention). `_coeff` is kept only for AdamW's
+        # decoupled-decay path.
         if weight_decay is None:
-            self._coeff = 0.0
-        elif isinstance(weight_decay, (int, float)):
-            self._coeff = float(weight_decay)
-        else:  # L2Decay object
+            self._wd_fn, self._coeff = None, 0.0
+        elif callable(weight_decay):
+            self._wd_fn = weight_decay
             self._coeff = float(getattr(weight_decay, "_coeff",
                                         getattr(weight_decay, "coeff", 0.0)))
+        else:
+            from ..regularizer import L2Decay
+            self._coeff = float(weight_decay)
+            self._wd_fn = L2Decay(self._coeff) if self._coeff else None
         self._slots: Dict[int, dict] = {}
         self._step_count = 0
         # decoupled weight decay (AdamW) vs L2-regularization-into-grad
@@ -93,8 +100,13 @@ class Optimizer:
             if sid not in self._slots:
                 self._slots[sid] = self._init_slots(p.value)
             gv = g.value if isinstance(g, Tensor) else g
-            if self._coeff and not self._decoupled_wd:
-                gv = gv + self._coeff * p.value
+            # per-param ParamAttr(regularizer=...) overrides the
+            # optimizer-level one (reference append_regularization_ops
+            # precedence); eager path only — the pure apply_gradients path
+            # sees raw arrays, not Parameters
+            reg = getattr(p, "regularizer", None) or self._wd_fn
+            if reg is not None and not self._decoupled_wd:
+                gv = gv + reg(p.value)
             new_p, new_slots = self._update(
                 p.value, gv, self._slots[sid], lr * self._param_lr(p),
                 self._step_count)
@@ -130,8 +142,8 @@ class Optimizer:
 
         def upd(p, g, s):
             gv = g
-            if self._coeff and not self._decoupled_wd:
-                gv = gv + self._coeff * p
+            if self._wd_fn is not None and not self._decoupled_wd:
+                gv = gv + self._wd_fn(p)
             new_p, new_s = self._update(p, gv, s, lr, step)
             return new_p.astype(p.dtype), new_s
 
@@ -254,8 +266,18 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name)
-        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
-            else float(getattr(weight_decay, "_coeff", 0.01))
+        if isinstance(weight_decay, (int, float)):
+            self._coeff = float(weight_decay)
+        else:
+            from ..regularizer import L2Decay
+            if not isinstance(weight_decay, L2Decay):
+                # decoupled decay IS L2 by definition; silently extracting
+                # a coeff from L1Decay would apply the wrong semantics
+                raise TypeError(
+                    f"AdamW's decoupled weight decay only supports a float "
+                    f"or L2Decay, got {type(weight_decay).__name__}; use "
+                    f"Adam(weight_decay=L1Decay(...)) for an L1 penalty")
+            self._coeff = float(weight_decay._coeff)
         self._decoupled_wd = True
         self._apply_decay_param_fun = apply_decay_param_fun
         self._decay_mask = None  # optional pytree mask for the pure path

@@ -1,0 +1,46 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py †,
+applied by the optimizer into the gradient before the update — the
+"L2-regularization-into-grad" style, as opposed to AdamW's decoupled decay).
+
+A regularizer is a callable ``penalty_grad = reg(param)`` plus a ``_coeff``
+attribute; optimizers accept one as ``weight_decay=`` and add the penalty
+term to the gradient inside both the eager ``step()`` and the pure
+``apply_gradients`` (jit/TrainStep) paths.
+"""
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class; subclasses define the per-parameter gradient penalty."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """d/dp of coeff * |p| = coeff * sign(p) added to the gradient."""
+
+    def __call__(self, param):
+        return self._coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """d/dp of (coeff/2) * ||p||^2 = coeff * p added to the gradient.
+
+    Matches the numeric ``weight_decay=float`` spelling exactly (the
+    reference treats a bare float as L2Decay(float))."""
+
+    def __call__(self, param):
+        return self._coeff * param

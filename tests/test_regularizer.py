@@ -1,0 +1,85 @@
+"""paddle.regularizer L1Decay/L2Decay applied through weight_decay=
+(reference: python/paddle/regularizer.py †, optimizer folds the penalty
+into the gradient; AdamW's decoupled decay is unaffected)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import SGD
+
+
+def _one_step(weight_decay, w0=0.5, g=0.1, lr=0.1):
+    p = paddle.to_tensor(np.full((3,), w0, np.float32))
+    p.stop_gradient = False
+    opt = SGD(learning_rate=lr, parameters=[p], weight_decay=weight_decay)
+    p.grad = paddle.to_tensor(np.full((3,), g, np.float32))
+    opt.step()
+    return p.numpy()
+
+
+class TestRegularizer:
+    def test_l2_matches_bare_float(self):
+        np.testing.assert_allclose(
+            _one_step(paddle.regularizer.L2Decay(0.01)), _one_step(0.01),
+            rtol=1e-6)
+
+    def test_l2_value(self):
+        # p - lr*(g + c*p) = 0.5 - 0.1*(0.1 + 0.01*0.5)
+        np.testing.assert_allclose(
+            _one_step(paddle.regularizer.L2Decay(0.01)),
+            np.full((3,), 0.5 - 0.1 * (0.1 + 0.005)), rtol=1e-6)
+
+    def test_l1_sign_penalty(self):
+        # p - lr*(g + c*sign(p)) with p>0 -> 0.5 - 0.1*(0.1 + 0.01)
+        np.testing.assert_allclose(
+            _one_step(paddle.regularizer.L1Decay(0.01)),
+            np.full((3,), 0.5 - 0.1 * 0.11), rtol=1e-6)
+        # negative weights decay UP (sign = -1)
+        out = _one_step(paddle.regularizer.L1Decay(0.01), w0=-0.5)
+        np.testing.assert_allclose(
+            out, np.full((3,), -0.5 - 0.1 * (0.1 - 0.01)), rtol=1e-6)
+
+    def test_jit_apply_gradients_path(self):
+        import jax.numpy as jnp
+        p = paddle.to_tensor(np.full((2,), 0.5, np.float32))
+        p.stop_gradient = False
+        opt = SGD(learning_rate=0.1, parameters=[p],
+                  weight_decay=paddle.regularizer.L1Decay(0.01))
+        state = opt.init_state({"w": p.value})
+        new_p, _ = opt.apply_gradients(
+            {"w": p.value}, {"w": jnp.full((2,), 0.1, jnp.float32)}, state)
+        np.testing.assert_allclose(np.asarray(new_p["w"]),
+                                   np.full((2,), 0.5 - 0.1 * 0.11), rtol=1e-6)
+
+    def test_repr_and_coeff(self):
+        r = paddle.regularizer.L1Decay(0.25)
+        assert r.coeff == 0.25 and "L1Decay" in repr(r)
+
+    def test_adamw_rejects_l1(self):
+        import pytest
+        p = paddle.to_tensor(np.ones((2,), np.float32))
+        p.stop_gradient = False
+        with pytest.raises(TypeError, match="L2Decay"):
+            paddle.optimizer.AdamW(parameters=[p],
+                                   weight_decay=paddle.regularizer.L1Decay(0.01))
+        # L2Decay object maps onto the decoupled coeff
+        opt = paddle.optimizer.AdamW(
+            parameters=[p], weight_decay=paddle.regularizer.L2Decay(0.02))
+        assert opt._coeff == 0.02
+
+    def test_param_attr_regularizer_overrides(self):
+        # per-param ParamAttr(regularizer=...) wins over the optimizer-level
+        # weight_decay (reference append_regularization_ops precedence)
+        from paddle_tpu.framework import ParamAttr
+        lin = paddle.nn.Linear(
+            2, 1,
+            weight_attr=ParamAttr(regularizer=paddle.regularizer.L1Decay(0.5)),
+            bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        opt = SGD(learning_rate=0.1, parameters=lin.parameters(),
+                  weight_decay=paddle.regularizer.L2Decay(0.9))
+        lin.weight.grad = paddle.to_tensor(np.zeros_like(w0))
+        opt.step()
+        # zero grad -> update comes from the penalty alone: L1 (0.5*sign),
+        # NOT L2 (0.9*w)
+        np.testing.assert_allclose(
+            lin.weight.numpy(), w0 - 0.1 * 0.5 * np.sign(w0), rtol=1e-6)
