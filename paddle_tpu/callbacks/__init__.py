@@ -5,6 +5,29 @@ import os
 import time
 
 
+def _resolve_mode(mode, monitor):
+    """'auto' picks 'max' only for accuracy-like monitors (reference/keras
+    convention: min unless 'acc' is in the name), so error/mae/bleu-style
+    monitors default to 'min'."""
+    if mode in ("min", "max"):
+        return mode
+    return "max" if "acc" in monitor else "min"
+
+
+def _metric_value(logs, monitor):
+    cur = (logs or {}).get(monitor)
+    if cur is None:
+        return None
+    return float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+
+
+def _is_better(cur, best, mode, min_delta):
+    if best is None:
+        return True
+    return (cur < best - min_delta) if mode == "min" \
+        else (cur > best + min_delta)
+
+
 class Callback:
     def __init__(self):
         self.model = None
@@ -104,18 +127,13 @@ class EarlyStopping(Callback):
         self.best = None
         self.wait = 0
         self.stopped_epoch = 0
-        self.mode = "min" if (mode == "auto" and "loss" in monitor) or \
-            mode == "min" else "max"
+        self.mode = _resolve_mode(mode, monitor)
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        cur = logs.get(self.monitor)
+        cur = _metric_value(logs, self.monitor)
         if cur is None:
             return
-        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
-        better = (self.best is None or
-                  (cur < self.best - self.min_delta if self.mode == "min"
-                   else cur > self.best + self.min_delta))
+        better = _is_better(cur, self.best, self.mode, self.min_delta)
         if better:
             self.best = cur
             self.wait = 0
@@ -173,3 +191,61 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR by ``factor`` when the monitored metric
+    plateaus for ``patience`` evals (reference: paddle.callbacks.
+    ReduceLROnPlateau †; plateau logic matches optimizer.lr.ReduceOnPlateau
+    with threshold_mode='abs'). Skips with a warning when the optimizer is
+    driven by an LRScheduler — the scheduler owns the LR then."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = float(min_lr)
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.mode = _resolve_mode(mode, monitor)
+
+    def on_eval_end(self, logs=None):
+        cur = _metric_value(logs, self.monitor)
+        if cur is None:
+            return
+        if _is_better(cur, self.best, self.mode, self.min_delta):
+            self.best = cur
+            self.wait = 0
+            return
+        # bad evals during cooldown don't count toward patience (matches
+        # optimizer.lr.ReduceOnPlateau's cooldown handling)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait < self.patience:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler as Sched
+        if isinstance(getattr(opt, "_learning_rate", None), Sched):
+            import warnings
+            warnings.warn(
+                "ReduceLROnPlateau skipped: the optimizer is driven by an "
+                "LRScheduler which owns the learning rate")
+            return
+        new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+        if new_lr < opt.get_lr():
+            opt.set_lr(new_lr)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+        self.wait = 0
+        self.cooldown_counter = self.cooldown

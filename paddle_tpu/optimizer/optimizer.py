@@ -133,9 +133,22 @@ class Optimizer:
         return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
 
     def apply_gradients(self, params_tree, grads_tree, state, lr=None):
-        """Pure: returns (new_params_tree, new_state). Used inside jit."""
+        """Pure: returns (new_params_tree, new_state). Used inside jit.
+
+        Per-param ParamAttr(regularizer=...) overrides apply in the EAGER
+        step() only — this path sees raw arrays, so the optimizer-level
+        weight_decay is used for every leaf (warned once below)."""
         if lr is None:
             lr = self.get_lr()
+        if not getattr(self, "_warned_param_reg", False) and any(
+                getattr(p, "regularizer", None) is not None
+                for p in self._parameter_list):
+            self._warned_param_reg = True
+            import warnings
+            warnings.warn(
+                "per-parameter ParamAttr regularizers are honored in the "
+                "eager optimizer.step() path only; this jit path applies "
+                "the optimizer-level weight_decay to all parameters")
         if self._grad_clip is not None:
             grads_tree = self._grad_clip.apply_pure(grads_tree)
         step = state["step"] + 1
@@ -266,7 +279,9 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name)
-        if isinstance(weight_decay, (int, float)):
+        if weight_decay is None:
+            self._coeff = 0.0
+        elif isinstance(weight_decay, (int, float)):
             self._coeff = float(weight_decay)
         else:
             from ..regularizer import L2Decay

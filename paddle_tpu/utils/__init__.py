@@ -1,6 +1,8 @@
 from . import flags
 from .flags import get_flags, set_flags
 from . import log as logger  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
 
 
 def try_import(name):

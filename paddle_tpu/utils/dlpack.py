@@ -1,0 +1,58 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py †).
+
+Zero-copy tensor exchange with any DLPack-speaking framework (torch, numpy,
+cupy, ...). jax arrays implement ``__dlpack__``/``__dlpack_device__``, so
+``to_dlpack`` returns the standard capsule and ``from_dlpack`` accepts
+either a capsule or an object implementing the protocol (the modern
+``__dlpack__`` form torch/numpy produce).
+"""
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (consumable exactly once by a peer
+    framework's ``from_dlpack``)."""
+    from ..core.tensor import Tensor
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return v.__dlpack__()
+
+
+class _CapsuleShim:
+    """Adapter for legacy PyCapsule input: modern jax only consumes objects
+    implementing ``__dlpack__``/``__dlpack_device__``, while the reference's
+    ``to_dlpack`` (and torch's) hand out bare capsules. The device tuple is
+    read from the DLManagedTensor struct the capsule carries."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+        self._device = _capsule_device(capsule)
+
+    def __dlpack__(self, **_kw):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return self._device
+
+
+def _capsule_device(capsule):
+    """(device_type, device_id) from a 'dltensor' capsule via the stable
+    DLPack ABI: DLTensor starts with {void* data; int32 device_type;
+    int32 device_id; ...}."""
+    import ctypes
+    get = ctypes.pythonapi.PyCapsule_GetPointer
+    get.restype = ctypes.c_void_p
+    get.argtypes = [ctypes.py_object, ctypes.c_char_p]
+    ptr = get(capsule, b"dltensor")
+    base = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_int32))
+    ptr_words = ctypes.sizeof(ctypes.c_void_p) // 4
+    return int(base[ptr_words]), int(base[ptr_words + 1])
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule or ``__dlpack__``-implementing object -> Tensor."""
+    from ..core.tensor import Tensor
+    if not hasattr(dlpack, "__dlpack__"):  # legacy capsule
+        dlpack = _CapsuleShim(dlpack)
+    return Tensor(jnp.from_dlpack(dlpack))

@@ -1,0 +1,47 @@
+"""Unique name generation (reference: python/paddle/utils/unique_name.py †
+— the name mint behind auto-assigned parameter/op names).
+
+``generate("fc")`` -> "fc_0", "fc_1", ...; ``guard()`` scopes a fresh
+generator (optionally prefixed) so names inside the with-block restart
+from zero; ``switch`` swaps the active generator and returns the old one.
+"""
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _NameGenerator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self._counters = {}
+
+    def generate(self, key):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{self.prefix}{key}_{n}"
+
+
+_generator = _NameGenerator()
+
+
+def generate(key):
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None \
+        else _NameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = _NameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -61,10 +61,26 @@ class TestRegularizer:
         with pytest.raises(TypeError, match="L2Decay"):
             paddle.optimizer.AdamW(parameters=[p],
                                    weight_decay=paddle.regularizer.L1Decay(0.01))
-        # L2Decay object maps onto the decoupled coeff
+        # L2Decay object maps onto the decoupled coeff; None means no decay
         opt = paddle.optimizer.AdamW(
             parameters=[p], weight_decay=paddle.regularizer.L2Decay(0.02))
         assert opt._coeff == 0.02
+        assert paddle.optimizer.AdamW(parameters=[p],
+                                      weight_decay=None)._coeff == 0.0
+
+    def test_pure_path_warns_on_param_regularizer(self):
+        import jax.numpy as jnp
+        import pytest
+        from paddle_tpu.framework import ParamAttr
+        lin = paddle.nn.Linear(
+            2, 1,
+            weight_attr=ParamAttr(regularizer=paddle.regularizer.L1Decay(0.5)),
+            bias_attr=False)
+        opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+        state = opt.init_state({"w": lin.weight.value})
+        with pytest.warns(UserWarning, match="eager"):
+            opt.apply_gradients({"w": lin.weight.value},
+                                {"w": jnp.zeros((2, 1))}, state)
 
     def test_param_attr_regularizer_overrides(self):
         # per-param ParamAttr(regularizer=...) wins over the optimizer-level

@@ -1,0 +1,135 @@
+"""utils.dlpack / utils.unique_name / callbacks.ReduceLROnPlateau
+(reference: python/paddle/utils/dlpack.py †, utils/unique_name.py †,
+hapi/callbacks.py † ReduceLROnPlateau)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDlpack:
+    def test_torch_roundtrip(self):
+        torch = pytest.importorskip("torch")
+        src = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        t = paddle.utils.dlpack.from_dlpack(src)  # torch -> paddle
+        assert isinstance(t, paddle.Tensor)
+        np.testing.assert_array_equal(t.numpy(), src.numpy())
+        back = torch.utils.dlpack.from_dlpack(   # paddle -> torch
+            paddle.utils.dlpack.to_dlpack(t * 2))
+        np.testing.assert_array_equal(back.numpy(), src.numpy() * 2)
+
+    def test_numpy_from_dlpack(self):
+        t = paddle.to_tensor(np.float32([1.0, 2.0]))
+        out = np.from_dlpack(t.value)
+        np.testing.assert_array_equal(out, [1.0, 2.0])
+
+    def test_capsule_self_roundtrip(self):
+        # the canonical reference usage: to_dlpack hands out a bare capsule
+        # and from_dlpack consumes it (modern jax needs the shim for this)
+        t = paddle.to_tensor(np.float32([[1, 2], [3, 4]]))
+        out = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(out.numpy(), [[1, 2], [3, 4]])
+
+    def test_torch_capsule_to_paddle(self):
+        torch = pytest.importorskip("torch")
+        cap = torch.utils.dlpack.to_dlpack(torch.arange(4, dtype=torch.int32))
+        out = paddle.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(out.numpy(), [0, 1, 2, 3])
+
+
+class TestUniqueName:
+    def test_generate_increments(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            assert unique_name.generate("fc") == "fc_0"
+            assert unique_name.generate("fc") == "fc_1"
+            assert unique_name.generate("conv") == "conv_0"
+
+    def test_guard_scopes_and_prefix(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            unique_name.generate("fc")
+            with unique_name.guard("block_"):
+                assert unique_name.generate("fc") == "block_fc_0"
+            # outer generator resumes where it left off
+            assert unique_name.generate("fc") == "fc_1"
+
+
+class TestReduceLROnPlateau:
+    def _model_with_opt(self, lr=0.1):
+        class M:  # minimal hapi-model stand-in: callback reads ._optimizer
+            pass
+        m = M()
+        p = paddle.to_tensor(np.ones((2,), np.float32))
+        p.stop_gradient = False
+        m._optimizer = paddle.optimizer.SGD(learning_rate=lr, parameters=[p])
+        return m
+
+    def test_reduces_after_patience(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=2, verbose=0)
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"loss": 1.0})        # best
+        for _ in range(2):                   # two bad evals = patience
+            cb.on_eval_end({"loss": 1.0})
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+
+    def test_improvement_resets_wait(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=2, verbose=0)
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})        # bad (wait=1)
+        cb.on_eval_end({"loss": 0.5})        # improvement resets
+        cb.on_eval_end({"loss": 0.5})        # bad (wait=1)
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.1)
+
+    def test_min_lr_floor(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.1, patience=1, min_lr=0.05, verbose=0)
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+
+    def test_auto_mode_is_min_for_error_monitors(self):
+        # 'val_error' must resolve to min-mode: a plateauing error reduces
+        # the LR (max-mode would treat every eval as an improvement)
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="val_error", factor=0.5, patience=1, verbose=0)
+        assert cb.mode == "min"
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"val_error": 1.0})
+        cb.on_eval_end({"val_error": 1.0})
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+        # accuracy-like monitors resolve to max
+        assert paddle.callbacks.ReduceLROnPlateau(monitor="acc").mode == "max"
+        assert paddle.callbacks.EarlyStopping(monitor="val_acc").mode == "max"
+        assert paddle.callbacks.EarlyStopping(monitor="val_error").mode == "min"
+
+    def test_cooldown_bad_evals_dont_count(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=2, cooldown=1, verbose=0)
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"loss": 1.0})            # best
+        cb.on_eval_end({"loss": 1.0})            # bad 1
+        cb.on_eval_end({"loss": 1.0})            # bad 2 -> reduce, cooldown
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+        cb.on_eval_end({"loss": 1.0})            # cooldown eval: not counted
+        cb.on_eval_end({"loss": 1.0})            # bad 1 after cooldown
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+        cb.on_eval_end({"loss": 1.0})            # bad 2 -> second reduction
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.025)
+
+    def test_scheduler_driven_optimizer_skipped(self):
+        from paddle_tpu.optimizer.lr import StepDecay
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", patience=0,
+                                                verbose=0)
+        cb.model = self._model_with_opt()
+        p = paddle.to_tensor(np.ones((2,), np.float32))
+        p.stop_gradient = False
+        cb.model._optimizer = paddle.optimizer.SGD(
+            learning_rate=StepDecay(0.1, step_size=5), parameters=[p])
+        cb.on_eval_end({"loss": 1.0})
+        with pytest.warns(UserWarning, match="LRScheduler"):
+            cb.on_eval_end({"loss": 1.0})
