@@ -219,15 +219,18 @@ class ReduceLROnPlateau(Callback):
         cur = _metric_value(logs, self.monitor)
         if cur is None:
             return
+        # cooldown elapses on EVERY eval (improving ones included) and
+        # swallows bad evals while active — matches
+        # optimizer.lr.ReduceOnPlateau / keras semantics
+        in_cooldown = self.cooldown_counter > 0
+        if in_cooldown:
+            self.cooldown_counter -= 1
+            self.wait = 0
         if _is_better(cur, self.best, self.mode, self.min_delta):
             self.best = cur
             self.wait = 0
             return
-        # bad evals during cooldown don't count toward patience (matches
-        # optimizer.lr.ReduceOnPlateau's cooldown handling)
-        if self.cooldown_counter > 0:
-            self.cooldown_counter -= 1
-            self.wait = 0
+        if in_cooldown:
             return
         self.wait += 1
         if self.wait < self.patience:

@@ -140,15 +140,16 @@ class Optimizer:
         weight_decay is used for every leaf (warned once below)."""
         if lr is None:
             lr = self.get_lr()
-        if not getattr(self, "_warned_param_reg", False) and any(
-                getattr(p, "regularizer", None) is not None
-                for p in self._parameter_list):
-            self._warned_param_reg = True
-            import warnings
-            warnings.warn(
-                "per-parameter ParamAttr regularizers are honored in the "
-                "eager optimizer.step() path only; this jit path applies "
-                "the optimizer-level weight_decay to all parameters")
+        if not getattr(self, "_warned_param_reg", False):
+            self._warned_param_reg = True  # scan once per instance
+            if any(getattr(p, "regularizer", None) is not None
+                   for p in self._parameter_list):
+                import warnings
+                warnings.warn(
+                    "per-parameter ParamAttr regularizers are honored in "
+                    "the eager optimizer.step() path only; this jit path "
+                    "applies the optimizer-level weight_decay to all "
+                    "parameters")
         if self._grad_clip is not None:
             grads_tree = self._grad_clip.apply_pure(grads_tree)
         step = state["step"] + 1

@@ -13,10 +13,20 @@ __all__ = ["to_dlpack", "from_dlpack"]
 
 def to_dlpack(x):
     """Tensor -> DLPack capsule (consumable exactly once by a peer
-    framework's ``from_dlpack``)."""
+    framework's ``from_dlpack``).
+
+    DLPack has no TPU device type, so a TPU-resident array is copied to
+    host first and the capsule describes the host buffer (no longer
+    zero-copy — the interop contract survives, the aliasing does not)."""
+    import jax
+
     from ..core.tensor import Tensor
     v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
-    return v.__dlpack__()
+    try:
+        return v.__dlpack__()
+    except (TypeError, ValueError, RuntimeError):
+        import numpy as np
+        return np.asarray(jax.device_get(v)).__dlpack__()
 
 
 class _CapsuleShim:

@@ -121,6 +121,23 @@ class TestReduceLROnPlateau:
         cb.on_eval_end({"loss": 1.0})            # bad 2 -> second reduction
         assert cb.model._optimizer.get_lr() == pytest.approx(0.025)
 
+    def test_cooldown_elapses_during_improvement(self):
+        # cooldown burns down on improving evals too (keras semantics): a
+        # plateau that starts after the cooldown window has passed needs
+        # only `patience` bad evals, not cooldown+patience
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=2, cooldown=3, verbose=0)
+        cb.model = self._model_with_opt(0.1)
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})        # reduce #1, cooldown=3
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
+        for v in (0.9, 0.8, 0.7, 0.6):       # improving: cooldown expires
+            cb.on_eval_end({"loss": v})
+        cb.on_eval_end({"loss": 0.6})        # bad 1
+        cb.on_eval_end({"loss": 0.6})        # bad 2 -> reduce #2
+        assert cb.model._optimizer.get_lr() == pytest.approx(0.025)
+
     def test_scheduler_driven_optimizer_skipped(self):
         from paddle_tpu.optimizer.lr import StepDecay
         cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", patience=0,

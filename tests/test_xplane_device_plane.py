@@ -1,0 +1,113 @@
+"""Device-plane XPlane parsing pinned against a SYNTHETIC TPU trace.
+
+The CI xplane test parses a real CPU-backend trace, but the device plane
+(`device_only=True`, the branch `bench.py --trace` tries first on the real
+chip) had only ever been exercised against host planes. This encodes an
+XSpace in raw protobuf wire format with TPU-style device planes — same
+field numbers the parser documents — so the device-only filter, the
+metadata display_name precedence, and multi-plane aggregation are all
+proven without a chip.
+"""
+import os
+
+from paddle_tpu.profiler.xplane import op_statistics, parse_xplane, summarize
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _field(fno, payload):
+    if isinstance(payload, int):
+        return _varint((fno << 3) | 0) + _varint(payload)
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _event(meta_id, dur_ps, offset_ps=0):
+    return _field(1, meta_id) + _field(2, offset_ps) + _field(3, dur_ps)
+
+
+def _event_metadata(mid, name, display_name=None):
+    m = _field(1, mid) + _field(2, name.encode())
+    if display_name is not None:
+        m += _field(3, display_name.encode())
+    return m
+
+
+def _meta_entry(mid, name, display_name=None):
+    return _field(1, mid) + _field(2, _event_metadata(mid, name,
+                                                      display_name))
+
+
+def _plane(name, meta_entries, lines):
+    buf = _field(2, name.encode())
+    for lb in lines:
+        buf += _field(3, lb)
+    for me in meta_entries:
+        buf += _field(4, me)
+    return buf
+
+
+def _line(events, line_id=1):
+    buf = _field(1, line_id)
+    for e in events:
+        buf += _field(4, e)
+    return buf
+
+
+def _write_space(tmp_path, planes):
+    space = b"".join(_field(1, p) for p in planes)
+    d = tmp_path / "plugins" / "profile" / "run"
+    os.makedirs(d)
+    (d / "host.xplane.pb").write_bytes(space)
+    return str(tmp_path)
+
+
+class TestDevicePlaneParsing:
+    def _make_trace(self, tmp_path):
+        device = _plane(
+            "/device:TPU:0 (chip 0 core 0)",
+            [_meta_entry(7, "fusion.42", "fused_matmul_add"),
+             _meta_entry(9, "copy.3")],
+            # two lines (XLA Modules / XLA Ops style): fusion appears twice
+            [_line([_event(7, 5_000_000_000), _event(9, 1_000_000_000)]),
+             _line([_event(7, 2_000_000_000)], line_id=2)])
+        host = _plane(
+            "/host:CPU",
+            [_meta_entry(1, "python_thread")],
+            [_line([_event(1, 9_000_000_000)])])
+        return _write_space(tmp_path, [device, host])
+
+    def test_device_only_filters_host(self, tmp_path):
+        rows = op_statistics(self._make_trace(tmp_path), device_only=True)
+        assert {r["name"] for r in rows} == {"fused_matmul_add", "copy.3"}
+        assert all("TPU" in r["plane"] for r in rows)
+
+    def test_aggregation_and_display_name(self, tmp_path):
+        rows = op_statistics(self._make_trace(tmp_path), device_only=True)
+        fused = next(r for r in rows if r["name"] == "fused_matmul_add")
+        # 5ms + 2ms across two lines, display_name wins over name
+        assert fused["count"] == 2
+        assert abs(fused["total_ms"] - 7.0) < 1e-9
+        assert rows[0]["name"] == "fused_matmul_add"  # sorted by total
+
+    def test_host_plane_included_when_not_device_only(self, tmp_path):
+        rows = op_statistics(self._make_trace(tmp_path), device_only=False)
+        assert any(r["name"] == "python_thread" for r in rows)
+
+    def test_parse_xplane_shape(self, tmp_path):
+        d = self._make_trace(tmp_path)
+        path = os.path.join(d, "plugins", "profile", "run", "host.xplane.pb")
+        planes = parse_xplane(path)
+        assert [p["name"] for p in planes] == [
+            "/device:TPU:0 (chip 0 core 0)", "/host:CPU"]
+
+    def test_summarize_renders(self, tmp_path):
+        out = summarize(self._make_trace(tmp_path))
+        assert "fused_matmul_add" in out and "total_ms" in out
