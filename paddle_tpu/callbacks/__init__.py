@@ -222,15 +222,17 @@ class ReduceLROnPlateau(Callback):
         # cooldown elapses on EVERY eval (improving ones included) and
         # swallows bad evals while active — matches
         # optimizer.lr.ReduceOnPlateau / keras semantics
-        in_cooldown = self.cooldown_counter > 0
-        if in_cooldown:
+        if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.wait = 0
         if _is_better(cur, self.best, self.mode, self.min_delta):
             self.best = cur
             self.wait = 0
             return
-        if in_cooldown:
+        # swallow the bad eval only while cooldown is STILL active after
+        # the decrement (keras re-checks post-decrement: with counter==1
+        # this same eval already counts toward patience)
+        if self.cooldown_counter > 0:
             return
         self.wait += 1
         if self.wait < self.patience:

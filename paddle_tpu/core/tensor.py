@@ -171,6 +171,23 @@ class Tensor:
     def __hash__(self):
         return id(self)
 
+    # DLPack protocol: lets np.from_dlpack / torch.from_dlpack consume a
+    # Tensor directly (reference exposes the same via utils.dlpack; the
+    # protocol methods make the Tensor itself a valid exchange object).
+    # DLPack has no TPU device type, so a TPU-resident value falls back to
+    # a host copy — same contract as utils.dlpack.to_dlpack.
+    def __dlpack__(self, **kwargs):
+        try:
+            return self._value.__dlpack__(**kwargs)
+        except (TypeError, ValueError, RuntimeError):
+            return np.asarray(jax.device_get(self._value)).__dlpack__()
+
+    def __dlpack_device__(self):
+        try:
+            return self._value.__dlpack_device__()
+        except (TypeError, ValueError, RuntimeError):
+            return np.asarray(jax.device_get(self._value)).__dlpack_device__()
+
     def __repr__(self):
         sg = self.stop_gradient
         return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "

@@ -3,7 +3,8 @@
 lives in ``paddle_tpu/parallel`` per this repo's layout)."""
 from ..parallel import *  # noqa: F401,F403
 from ..parallel import (DataParallel, Group, ParallelEnv, ReduceOp, all_gather,
-                        all_gather_object, all_reduce, alltoall, barrier,
+                        all_gather_object, all_reduce, alltoall,
+                        alltoall_single, barrier,
                         broadcast, broadcast_object_list,
                         destroy_process_group, gather,
                         get_rank, get_world_size, init_parallel_env,

@@ -16,6 +16,7 @@ from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
                   is_initialized)
 from .mesh import Group, build_mesh, ensure_mesh, get_mesh, new_group, set_mesh
 from .communication import (ReduceOp, all_gather, all_reduce, alltoall,
+                            alltoall_single,
                             barrier, batch_isend_irecv, broadcast,
                             destroy_process_group, gather, irecv,
                             isend, P2POp, recv, reduce, reduce_scatter,

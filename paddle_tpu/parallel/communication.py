@@ -213,6 +213,25 @@ def alltoall(in_tensor_list, out_tensor_list=None,
     return _Task(chunks)
 
 
+def alltoall_single(in_tensor, out_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group: Optional[Group] = None, sync_op=True):
+    """Single-tensor all-to-all (reference: python/paddle/distributed/
+    communication/all_to_all.py † ``alltoall_single``). The leading dim is
+    split into nranks chunks (equal split; ragged ``*_split_sizes`` are
+    rejected explicitly — XLA's all_to_all is tiled/uniform) and chunk j
+    goes to rank j, concatenated by source rank."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with ragged in/out_split_sizes is not "
+            "supported on the XLA collective path (all_to_all is uniform); "
+            "pad to equal chunks or use alltoall on a tensor list")
+    if not isinstance(in_tensor, Tensor):
+        in_tensor = Tensor(jnp.asarray(in_tensor))
+    # the tensor form of alltoall implements exactly these semantics
+    return alltoall(in_tensor, out_tensor, group=group, sync_op=sync_op)
+
+
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op=True):
     return all_reduce(tensor, op=op, group=group, sync_op=sync_op)

@@ -158,3 +158,33 @@ class TestEagerCollectiveSemantics:
         x = paddle.to_tensor(np.ones((4,), np.float32))
         all_reduce(x)
         np.testing.assert_allclose(x.numpy(), np.full((4,), float(n)))
+
+
+class TestAllToAllSingle:
+    """paddle.distributed.alltoall_single (reference: communication/
+    all_to_all.py †): leading dim split into nranks chunks, chunk j to
+    rank j, concatenated by source."""
+
+    def test_transposes_chunk_matrix(self):
+        from paddle_tpu.distributed import alltoall_single
+        mesh_mod._STATE["mesh"] = None
+        n = len(jax.devices())
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = mesh_mod.ensure_mesh()
+        axes = tuple(mesh.axis_names)
+        # global [n*n] layout: rank r holds rows r*n..r*n+n, row r*n+j is
+        # the chunk r sends to j; after a2a rank r holds column r
+        v = np.arange(n * n, dtype=np.float32).reshape(n * n, 1)
+        x = paddle.to_tensor(
+            jax.device_put(jnp.asarray(v), NamedSharding(mesh, P(axes))))
+        out = paddle.to_tensor(np.zeros_like(v))
+        alltoall_single(x, out)
+        expect = v.reshape(n, n, 1).transpose(1, 0, 2).reshape(n * n, 1)
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_ragged_split_sizes_rejected(self):
+        import pytest
+        from paddle_tpu.distributed import alltoall_single
+        x = paddle.to_tensor(np.ones((8, 2), np.float32))
+        with pytest.raises(NotImplementedError, match="split_sizes"):
+            alltoall_single(x, in_split_sizes=[3, 5])

@@ -23,6 +23,14 @@ class TestDlpack:
         out = np.from_dlpack(t.value)
         np.testing.assert_array_equal(out, [1.0, 2.0])
 
+    def test_tensor_is_dlpack_object(self):
+        # the Tensor itself speaks the protocol: consumers need no unwrap
+        t = paddle.to_tensor(np.float32([3.0, 4.0]))
+        np.testing.assert_array_equal(np.from_dlpack(t), [3.0, 4.0])
+        torch = pytest.importorskip("torch")
+        np.testing.assert_array_equal(
+            torch.utils.dlpack.from_dlpack(t).numpy(), [3.0, 4.0])
+
     def test_capsule_self_roundtrip(self):
         # the canonical reference usage: to_dlpack hands out a bare capsule
         # and from_dlpack consumes it (modern jax needs the shim for this)
@@ -107,18 +115,22 @@ class TestReduceLROnPlateau:
         assert paddle.callbacks.EarlyStopping(monitor="val_acc").mode == "max"
         assert paddle.callbacks.EarlyStopping(monitor="val_error").mode == "min"
 
-    def test_cooldown_bad_evals_dont_count(self):
+    def test_cooldown_resets_patience_counting(self):
+        # keras-exact: the cooldown branch zeroes wait, decrements, and a
+        # bad eval counts once the counter has reached zero — so with
+        # cooldown=2, the first post-reduction bad eval is swallowed
+        # (counter 2->1) and the second starts patience counting fresh
         cb = paddle.callbacks.ReduceLROnPlateau(
-            monitor="loss", factor=0.5, patience=2, cooldown=1, verbose=0)
+            monitor="loss", factor=0.5, patience=2, cooldown=2, verbose=0)
         cb.model = self._model_with_opt(0.1)
         cb.on_eval_end({"loss": 1.0})            # best
         cb.on_eval_end({"loss": 1.0})            # bad 1
-        cb.on_eval_end({"loss": 1.0})            # bad 2 -> reduce, cooldown
+        cb.on_eval_end({"loss": 1.0})            # bad 2 -> reduce, cooldown=2
         assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
-        cb.on_eval_end({"loss": 1.0})            # cooldown eval: not counted
-        cb.on_eval_end({"loss": 1.0})            # bad 1 after cooldown
+        cb.on_eval_end({"loss": 1.0})            # cooldown 2->1: swallowed
+        cb.on_eval_end({"loss": 1.0})            # cooldown 1->0: wait=1
         assert cb.model._optimizer.get_lr() == pytest.approx(0.05)
-        cb.on_eval_end({"loss": 1.0})            # bad 2 -> second reduction
+        cb.on_eval_end({"loss": 1.0})            # wait=2 -> second reduction
         assert cb.model._optimizer.get_lr() == pytest.approx(0.025)
 
     def test_cooldown_elapses_during_improvement(self):
