@@ -186,7 +186,9 @@ class Tensor:
         try:
             return self._value.__dlpack_device__()
         except (TypeError, ValueError, RuntimeError):
-            return np.asarray(jax.device_get(self._value)).__dlpack_device__()
+            # the fallback exports a host copy, so the device IS the CPU;
+            # answering from metadata avoids materializing the array twice
+            return (1, 0)  # (kDLCPU, 0)
 
     def __repr__(self):
         sg = self.stop_gradient

@@ -2066,3 +2066,24 @@ def _rnnt_impl(logits, label, in_len, lab_len, blank, fastemit_lambda,
     nll = _rnnt_nll(lp_blank, lp_emit, in_len.astype(jnp.int32),
                     lab_len.astype(jnp.int32), float(fastemit_lambda))
     return _reduce(nll, reduction)
+
+
+# ------------------------------------------------------------------ flash
+# The reference exposes flash attention under BOTH paddle.nn.functional
+# (python/paddle/nn/functional/flash_attention.py †) and
+# paddle.incubate.nn.functional; the implementation lives with the other
+# fused wrappers in incubate (which routes [b,s,h,d] inputs to the Pallas
+# flash kernel) and is re-exported here under the canonical path.
+def flash_attention(*args, **kwargs):
+    from ..incubate.nn.functional import flash_attention as _fa
+    return _fa(*args, **kwargs)
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    from ..incubate.nn.functional import flash_attn_unpadded as _fav
+    return _fav(*args, **kwargs)
+
+
+def flash_attn_qkvpacked(*args, **kwargs):
+    from ..incubate.nn.functional import flash_attn_qkvpacked as _faq
+    return _faq(*args, **kwargs)

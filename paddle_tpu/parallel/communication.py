@@ -195,6 +195,10 @@ def alltoall(in_tensor_list, out_tensor_list=None,
         x = in_tensor_list.value
         n = g.nranks
         if g.nranks == 1:
+            # one rank: out == in, but the out-tensor contract still holds
+            if out_tensor_list is not None and isinstance(out_tensor_list,
+                                                          Tensor):
+                out_tensor_list._rebind(x)
             return _Task(x)
         axes = _axes(g)
         prog = jax.jit(jax.shard_map(

@@ -188,3 +188,21 @@ class TestAllToAllSingle:
         x = paddle.to_tensor(np.ones((8, 2), np.float32))
         with pytest.raises(NotImplementedError, match="split_sizes"):
             alltoall_single(x, in_split_sizes=[3, 5])
+
+    def test_single_rank_group_writes_out_tensor(self):
+        # nranks==1: out == in, and the out-tensor contract still holds
+        # (the early-return path must rebind, not skip)
+        from paddle_tpu.parallel.communication import alltoall_single
+        from paddle_tpu.parallel import mesh as _m
+        import jax as _jax
+        saved = _m._STATE["mesh"]
+        try:
+            _m._STATE["mesh"] = None
+            _m.set_mesh(_m.build_mesh({"dp": 1},
+                                      devices=_jax.devices()[:1]))
+            x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+            out = paddle.to_tensor(np.zeros(4, np.float32))
+            alltoall_single(x, out)
+            np.testing.assert_array_equal(out.numpy(), [0, 1, 2, 3])
+        finally:
+            _m._STATE["mesh"] = saved

@@ -162,3 +162,20 @@ class TestReduceLROnPlateau:
         cb.on_eval_end({"loss": 1.0})
         with pytest.warns(UserWarning, match="LRScheduler"):
             cb.on_eval_end({"loss": 1.0})
+
+
+class TestFlashAttentionCanonicalPath:
+    """F.flash_attention re-exported under nn.functional (reference path:
+    python/paddle/nn/functional/flash_attention.py †) matches the incubate
+    implementation exactly."""
+
+    def test_alias_matches_incubate(self):
+        import paddle_tpu.incubate.nn.functional as iF
+        F = paddle.nn.functional
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(2, 8, 4, 16).astype(np.float32))
+        a, _ = F.flash_attention(q, q, q, causal=True)
+        b, _ = iF.flash_attention(q, q, q, causal=True)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        for n in ("flash_attn_unpadded", "flash_attn_qkvpacked"):
+            assert callable(getattr(F, n))
