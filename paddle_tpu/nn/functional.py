@@ -864,18 +864,41 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             scale_factor = [scale_factor] * len(spatial)
         size = [int(s * f) for s, f in zip(spatial, scale_factor)]
     size = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
-    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
-              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
-    if align_corners and method == "linear":
+    if mode == "area":
+        # reference semantics: area = adaptive average pooling over the
+        # target grid (NOT a linear resize)
+        from ..ops import transpose as _tr
+        nd = len(size)
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[nd]
+        size = size[0] if nd == 1 else size  # pool1d takes a scalar
+        if data_format.startswith("NC"):
+            return pool(x, size)
+        # channels-last: pools are channels-first — sandwich in transposes
+        to_cf = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        to_cl = (0,) + tuple(range(2, x.ndim)) + (1,)
+        return _tr(pool(_tr(x, to_cf), size), to_cl)
+    if mode == "nearest":
+        # reference kernel: src = trunc(dst * in/out) (align_corners=False)
+        # or round(dst * (in-1)/(out-1)) (True) — jax.image.resize rounds
+        # half-pixel centers instead, which shifts every sample
+        return _interp_nearest(x, tuple(size), data_format,
+                               bool(align_corners))
+    if mode == "bicubic":
+        if align_corners:
+            raise NotImplementedError(
+                "interpolate(mode='bicubic', align_corners=True) is not "
+                "implemented; use align_corners=False or a linear mode")
+        # reference cubic convolution uses a=-0.75 (torch/OpenCV); jax's
+        # resize uses the Keys a=-0.5 kernel, so sample explicitly
+        return _interp_cubic(x, tuple(size), data_format)
+    method = {"bilinear": "linear", "trilinear": "linear",
+              "linear": "linear"}[mode]
+    if align_corners:
         # corner-anchored sampling (out_i -> in coord i*(n-1)/(out-1));
         # jax.image.resize is half-pixel only, so this path interpolates
         # explicitly — separable per-dim lerp, exact
         return _interp_align_corners(x, tuple(size), data_format)
-    if align_corners and method == "cubic":
-        raise NotImplementedError(
-            "interpolate(mode='bicubic', align_corners=True) is not "
-            "implemented (jax.image.resize is half-pixel only); use "
-            "align_corners=False or a linear mode")
     return _interp(x, tuple(size), method, data_format)
 
 
@@ -888,12 +911,64 @@ def _interp(x, size, method, data_format):
     return jax.image.resize(x, out_shape, method=method)
 
 
+def _spatial_axes(x, data_format):
+    return (range(2, x.ndim) if data_format.startswith("NC")
+            else range(1, x.ndim - 1))
+
+
+@tensor_op
+def _interp_nearest(x, size, data_format, align_corners):
+    out = x
+    for ax, osz in zip(_spatial_axes(x, data_format), size):
+        n = out.shape[ax]
+        if align_corners:
+            # C round() semantics (half away from zero) — jnp.round is
+            # banker's rounding and would send 0.5 -> 0, 2.5 -> 2
+            c = jnp.floor(jnp.arange(osz) * ((n - 1) / max(osz - 1, 1))
+                          + 0.5)
+        else:
+            c = jnp.floor(jnp.arange(osz) * (n / osz))
+        idx = jnp.clip(c.astype(jnp.int32), 0, n - 1)
+        out = jnp.take(out, idx, axis=ax)
+    return out
+
+
+def _cubic_weights(t, a=-0.75):
+    """Cubic-convolution weights for the 4 taps around fractional offset t
+    (kernel parameter a=-0.75 — the torch/OpenCV/reference constant)."""
+    def near(d):   # |d| <= 1
+        return ((a + 2.0) * d - (a + 3.0)) * d * d + 1.0
+
+    def far(d):    # 1 < |d| < 2
+        return ((a * d - 5.0 * a) * d + 8.0 * a) * d - 4.0 * a
+
+    return far(t + 1.0), near(t), near(1.0 - t), far(2.0 - t)
+
+
+@tensor_op
+def _interp_cubic(x, size, data_format):
+    out = x
+    for ax, osz in zip(_spatial_axes(x, data_format), size):
+        n = out.shape[ax]
+        if osz == n:
+            continue
+        c = (jnp.arange(osz) + 0.5) * (n / osz) - 0.5
+        i0 = jnp.floor(c)
+        t = (c - i0).astype(out.dtype)
+        taps = [jnp.clip(i0.astype(jnp.int32) + k, 0, n - 1)
+                for k in (-1, 0, 1, 2)]
+        ws = _cubic_weights(t)
+        wshape = [1] * out.ndim
+        wshape[ax] = osz
+        out = sum(jnp.take(out, idx, axis=ax) * w.reshape(wshape)
+                  for idx, w in zip(taps, ws))
+    return out
+
+
 @tensor_op
 def _interp_align_corners(x, size, data_format):
-    axes = (range(2, x.ndim) if data_format.startswith("NC")
-            else range(1, x.ndim - 1))
     out = x
-    for ax, osz in zip(axes, size):
+    for ax, osz in zip(_spatial_axes(x, data_format), size):
         n = out.shape[ax]
         if osz == n:
             continue

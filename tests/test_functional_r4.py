@@ -418,3 +418,59 @@ class TestRNNTLoss:
             int(lab_len[b]), lam=lam) for b in range(B)])
         np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4,
                                    atol=1e-6)
+
+
+class TestInterpolateModeParityR5:
+    """nearest/bicubic/area vs the torch oracle (caught in r5: the
+    jax.image.resize defaults diverge from the reference kernels —
+    half-pixel-rounded nearest, Keys a=-0.5 cubic, and 'area' mapped to a
+    linear resize)."""
+
+    def _pair(self):
+        rng = np.random.RandomState(7)
+        img = rng.randn(1, 2, 6, 6).astype(np.float32)
+        return img, torch.tensor(img)
+
+    def test_nearest_trunc_indexing(self):
+        img, ti = self._pair()
+        for size in ([9, 11], [4, 3]):
+            got = F.interpolate(_t(img), size=size, mode="nearest").numpy()
+            exp = TF.interpolate(ti, size=size, mode="nearest").numpy()
+            np.testing.assert_array_equal(got, exp)
+
+    def test_bicubic_a075_kernel(self):
+        img, ti = self._pair()
+        for size in ([9, 11], [4, 3]):
+            got = F.interpolate(_t(img), size=size, mode="bicubic",
+                                align_corners=False).numpy()
+            exp = TF.interpolate(ti, size=size, mode="bicubic",
+                                 align_corners=False).numpy()
+            np.testing.assert_allclose(got, exp, atol=1e-5, rtol=1e-5)
+
+    def test_area_is_adaptive_avg(self):
+        img, ti = self._pair()
+        for size in ([9, 11], [3, 2]):
+            got = F.interpolate(_t(img), size=size, mode="area").numpy()
+            exp = TF.interpolate(ti, size=size, mode="area").numpy()
+            np.testing.assert_allclose(got, exp, atol=1e-6)
+
+    def test_area_channels_last(self):
+        img, ti = self._pair()
+        got = F.interpolate(_t(img.transpose(0, 2, 3, 1)), size=[3, 2],
+                            mode="area", data_format="NHWC").numpy()
+        exp = TF.interpolate(ti, size=[3, 2], mode="area").numpy()
+        np.testing.assert_allclose(got.transpose(0, 3, 1, 2), exp, atol=1e-6)
+
+    def test_nearest_align_corners_rounds(self):
+        # align_corners=True nearest: round(i * (n-1)/(out-1))
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 4)
+        got = F.interpolate(_t(x), size=[7], mode="nearest",
+                            align_corners=True, data_format="NCW").numpy()
+        np.testing.assert_array_equal(got[0, 0], [0, 1, 1, 2, 2, 3, 3])
+
+    def test_area_1d(self):
+        x = np.random.RandomState(3).randn(2, 3, 10).astype(np.float32)
+        got = F.interpolate(_t(x), size=[4], mode="area",
+                            data_format="NCW").numpy()
+        exp = TF.interpolate(torch.tensor(x), size=4, mode="area").numpy()
+        np.testing.assert_allclose(got, exp, atol=1e-6)
