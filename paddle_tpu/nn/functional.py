@@ -911,15 +911,23 @@ def _interp(x, size, method, data_format):
     return jax.image.resize(x, out_shape, method=method)
 
 
-def _spatial_axes(x, data_format):
-    return (range(2, x.ndim) if data_format.startswith("NC")
+def _spatial_axes(x, data_format, size=None):
+    axes = (range(2, x.ndim) if data_format.startswith("NC")
             else range(1, x.ndim - 1))
+    if size is not None and len(size) != len(axes):
+        # zip would silently truncate; the resize paths must reject a
+        # size whose length doesn't match the spatial rank (the old
+        # jax.image.resize path raised here too)
+        raise ValueError(
+            f"interpolate: size has {len(size)} element(s) but the input "
+            f"has {len(axes)} spatial dim(s) for data_format above")
+    return axes
 
 
 @tensor_op
 def _interp_nearest(x, size, data_format, align_corners):
     out = x
-    for ax, osz in zip(_spatial_axes(x, data_format), size):
+    for ax, osz in zip(_spatial_axes(x, data_format, size), size):
         n = out.shape[ax]
         if align_corners:
             # C round() semantics (half away from zero) — jnp.round is
@@ -948,7 +956,7 @@ def _cubic_weights(t, a=-0.75):
 @tensor_op
 def _interp_cubic(x, size, data_format):
     out = x
-    for ax, osz in zip(_spatial_axes(x, data_format), size):
+    for ax, osz in zip(_spatial_axes(x, data_format, size), size):
         n = out.shape[ax]
         if osz == n:
             continue
@@ -968,7 +976,7 @@ def _interp_cubic(x, size, data_format):
 @tensor_op
 def _interp_align_corners(x, size, data_format):
     out = x
-    for ax, osz in zip(_spatial_axes(x, data_format), size):
+    for ax, osz in zip(_spatial_axes(x, data_format, size), size):
         n = out.shape[ax]
         if osz == n:
             continue

@@ -11,6 +11,16 @@ from ._op import tensor_op
 def norm(x, p="fro", axis=None, keepdim=False):
     if axis is None and p == "fro":
         return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if p == "nuc":  # nuclear norm: sum of singular values (matrix-only)
+        if axis is None:
+            ax = (-2, -1)
+        elif isinstance(axis, (list, tuple)) and len(axis) == 2:
+            ax = tuple(axis)
+        else:
+            raise ValueError(
+                f"norm(p='nuc') is a matrix norm: axis must be None or a "
+                f"2-element list/tuple, got {axis!r}")
+        return jnp.linalg.norm(x, ord="nuc", axis=ax, keepdims=keepdim)
     if p == "fro":
         p = 2
     if isinstance(axis, (list, tuple)) and len(axis) == 2:

@@ -474,3 +474,8 @@ class TestInterpolateModeParityR5:
                             data_format="NCW").numpy()
         exp = TF.interpolate(torch.tensor(x), size=4, mode="area").numpy()
         np.testing.assert_allclose(got, exp, atol=1e-6)
+
+    def test_size_rank_mismatch_raises(self):
+        x = np.zeros((1, 2, 6, 6), np.float32)
+        with pytest.raises(ValueError, match="spatial dim"):
+            F.interpolate(_t(x), size=[9], mode="nearest")

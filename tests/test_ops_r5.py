@@ -660,3 +660,23 @@ class TestRegistryHonesty:
     def test_registry_crosses_500(self):
         from paddle_tpu.ops._op import OP_REGISTRY
         assert len(OP_REGISTRY) >= 500, len(OP_REGISTRY)
+
+
+class TestNormNuclear:
+    """p='nuc' (sum of singular values) — crashed pre-r5-session-3 (the
+    numeric-p power path received the string)."""
+
+    def test_nuc_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(11).randn(4, 6).astype(np.float32)
+        got = float(paddle.linalg.norm(paddle.to_tensor(x), "nuc").numpy())
+        exp = float(torch.linalg.norm(torch.tensor(x), "nuc"))
+        assert abs(got - exp) < 1e-3
+        got2 = float(paddle.linalg.norm(paddle.to_tensor(x), "nuc",
+                                        axis=[0, 1]).numpy())
+        assert abs(got2 - exp) < 1e-3
+
+    def test_nuc_rejects_vector_axis(self):
+        x = np.zeros((3, 4), np.float32)
+        with pytest.raises(ValueError, match="matrix norm"):
+            paddle.linalg.norm(paddle.to_tensor(x), "nuc", axis=0)
