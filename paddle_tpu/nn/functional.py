@@ -281,19 +281,37 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
-def _conv_padding(padding, spatial, strides=None):
+def _conv_padding(padding, spatial, channel_last=False):
     if isinstance(padding, str):
         return padding.upper()
     if isinstance(padding, int):
         return [(padding, padding)] * spatial
     padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # pair-per-dim forms: either one pair per SPATIAL dim, or the
+        # full per-tensor-dim form incl. batch/channel pairs, whose
+        # spatial positions depend on the layout (reference conv padding
+        # contract: [[0,0],[0,0],[h0,h1],[w0,w1]] for NCHW vs
+        # [[0,0],[h0,h1],[w0,w1],[0,0]] for NHWC)
+        if len(padding) == spatial:
+            sp = padding
+        elif len(padding) == spatial + 2:
+            sp = padding[1:-1] if channel_last else padding[2:]
+            nc = padding[:1] + padding[-1:] if channel_last else padding[:2]
+            if any(int(v) != 0 for p in nc for v in p):
+                # reference rejects nonzero batch/channel padding rather
+                # than silently dropping it (a mis-ordered list otherwise
+                # diverges without signal)
+                raise ValueError(
+                    f"padding on batch/channel dims must be zero, got "
+                    f"{padding}")
+        else:
+            raise ValueError(f"bad padding {padding}")
+        return [tuple(int(v) for v in p) for p in sp]
     if len(padding) == spatial and all(isinstance(p, int) for p in padding):
         return [(p, p) for p in padding]
     if len(padding) == 2 * spatial:
         return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
-    if all(isinstance(p, (list, tuple)) for p in padding):
-        # NCHW-style full-form padding: take spatial entries
-        return [tuple(p) for p in padding[-spatial:]]
     raise ValueError(f"bad padding {padding}")
 
 
@@ -302,7 +320,7 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     stride = _pair(stride)
     dilation = _pair(dilation)
-    pad = _conv_padding(padding, 2)
+    pad = _conv_padding(padding, 2, not data_format.startswith("NC"))
     dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
     w = weight
     if data_format != "NCHW":
@@ -322,13 +340,16 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL"):
     stride = _pair(stride, 1)
     dilation = _pair(dilation, 1)
-    pad = _conv_padding(padding, 1)
+    cl = not data_format.startswith("NC")
+    pad = _conv_padding(padding, 1, cl)
+    if cl:
+        x = _nc_first(x)
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
         dimension_numbers=("NCH", "OIH", "NCH"), feature_group_count=groups)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1))
-    return out
+    return _nc_last(out) if cl else out
 
 
 @tensor_op
@@ -336,13 +357,16 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
     stride = _pair(stride, 3)
     dilation = _pair(dilation, 3)
-    pad = _conv_padding(padding, 3)
+    cl = not data_format.startswith("NC")
+    pad = _conv_padding(padding, 3, cl)
+    if cl:
+        x = _nc_first(x)
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), feature_group_count=groups)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
-    return out
+    return _nc_last(out) if cl else out
 
 
 @tensor_op
@@ -354,7 +378,10 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     opad = _pair(output_padding)
     if isinstance(padding, str):
         raise NotImplementedError("string padding for conv_transpose")
-    pads = _conv_padding(padding, 2)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 2, cl)
+    if cl:
+        x = _nc_first(x)
     # paddle weight layout for transpose conv: [in, out/groups, kh, kw]
     kh, kw = weight.shape[2], weight.shape[3]
     # lax transposed conv = conv with lhs_dilation
@@ -378,16 +405,28 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         feature_group_count=groups)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1, 1))
-    return out
+    return _nc_last(out) if cl else out
 
 
-def _ceil_extra(size, k, s, pad):
-    """Extra right/bottom padding so ceil-mode partial windows are included."""
-    span = size + 2 * pad - k
+def _nc_first(x):
+    """channels-last -> channels-first (the sandwich that lets every conv/
+    pool body stay NC*; XLA folds the transposes into the op's layout)."""
+    return jnp.transpose(x, (0, x.ndim - 1) + tuple(range(1, x.ndim - 1)))
+
+
+def _nc_last(x):
+    return jnp.transpose(x, (0,) + tuple(range(2, x.ndim)) + (1,))
+
+
+def _ceil_extra(size, k, s, pad, pad_hi=None):
+    """Extra right/bottom padding so ceil-mode partial windows are included.
+    Takes both pad sides — asymmetric per-side padding spans differ."""
+    pad_hi = pad if pad_hi is None else pad_hi
+    span = size + pad + pad_hi - k
     out_floor = span // s + 1
     out_ceil = -(-span // s) + 1
     if out_ceil > out_floor:
-        return (out_ceil - 1) * s + k - size - 2 * pad
+        return (out_ceil - 1) * s + k - size - pad - pad_hi
     return 0
 
 
@@ -396,21 +435,25 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW"):
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
-    pads = _conv_padding(padding, 2)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 2, cl)
+    if cl:
+        x = _nc_first(x)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     if isinstance(pads, str):
         if return_mask:
             raise NotImplementedError("return_mask with string padding")
-        return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
-                                     (1, 1) + s, padding=pads)
-    eh = _ceil_extra(x.shape[2], k[0], s[0], pads[0][0]) if ceil_mode else 0
-    ew = _ceil_extra(x.shape[3], k[1], s[1], pads[1][0]) if ceil_mode else 0
+        out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
+                                    (1, 1) + s, padding=pads)
+        return _nc_last(out) if cl else out
+    eh = _ceil_extra(x.shape[2], k[0], s[0], *pads[0]) if ceil_mode else 0
+    ew = _ceil_extra(x.shape[3], k[1], s[1], *pads[1]) if ceil_mode else 0
     pad_cfg = [(0, 0), (0, 0), (pads[0][0], pads[0][1] + eh),
                (pads[1][0], pads[1][1] + ew)]
     out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
                                 padding=pad_cfg)
     if not return_mask:
-        return out
+        return _nc_last(out) if cl else out
     # mask = flattened H*W input index of each window max (paddle semantics);
     # computed from explicit -inf-padded patches
     N, C, H, W = x.shape
@@ -427,6 +470,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     in_i = oh * s[0] - pads[0][0] + wi
     in_j = ow * s[1] - pads[1][0] + wj
     mask = (in_i * W + in_j).astype(dtype_mod.long_dtype())
+    if cl:
+        return _nc_last(out), _nc_last(mask)
     return out, mask
 
 
@@ -435,24 +480,29 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
-    pads = _conv_padding(padding, 2)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 2, cl)
+    if cl:
+        x = _nc_first(x)
     if isinstance(pads, str):
         pad_cfg = pads
     else:
-        eh = _ceil_extra(x.shape[2], k[0], s[0], pads[0][0]) if ceil_mode else 0
-        ew = _ceil_extra(x.shape[3], k[1], s[1], pads[1][0]) if ceil_mode else 0
+        eh = _ceil_extra(x.shape[2], k[0], s[0], *pads[0]) if ceil_mode else 0
+        ew = _ceil_extra(x.shape[3], k[1], s[1], *pads[1]) if ceil_mode else 0
         pad_cfg = [(0, 0), (0, 0), (pads[0][0], pads[0][1] + eh),
                    (pads[1][0], pads[1][1] + ew)]
     summed = jax.lax.reduce_window(
         x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, padding=pad_cfg)
     if divisor_override:
-        return summed / divisor_override
-    if exclusive and not isinstance(pad_cfg, str):
+        out = summed / divisor_override
+    elif exclusive and not isinstance(pad_cfg, str):
         ones = jnp.ones((1, 1) + x.shape[-2:], x.dtype)
         count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1) + k,
                                       (1, 1) + s, padding=pad_cfg)
-        return summed / count
-    return summed / (k[0] * k[1])
+        out = summed / count
+    else:
+        out = summed / (k[0] * k[1])
+    return _nc_last(out) if cl else out
 
 
 @tensor_op
@@ -1066,21 +1116,25 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
     k = _pair(kernel_size, 3)
     s = _pair(stride, 3) if stride is not None else k
-    pads = _conv_padding(padding, 3)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 3, cl)
+    if cl:
+        x = _nc_first(x)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     if isinstance(pads, str):
         if return_mask:
             raise NotImplementedError("return_mask with string padding")
-        return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
-                                     (1, 1) + s, padding=pads)
-    extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], pads[i][0])
+        out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
+                                    (1, 1) + s, padding=pads)
+        return _nc_last(out) if cl else out
+    extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], *pads[i])
               if ceil_mode else 0) for i in range(3)]
     pad_cfg = [(0, 0), (0, 0)] + [(pads[i][0], pads[i][1] + extra[i])
                                   for i in range(3)]
     out = jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
                                 padding=pad_cfg)
     if not return_mask:
-        return out
+        return _nc_last(out) if cl else out
     # mask = flattened D*H*W input index of each window max (paddle
     # semantics) — same explicit-patch scheme as max_pool2d above
     N, C, D, H, W = x.shape
@@ -1101,6 +1155,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     in_i = oh * s[1] - pads[1][0] + wi
     in_j = ow * s[2] - pads[2][0] + wj
     mask = ((in_d * H + in_i) * W + in_j).astype(dtype_mod.long_dtype())
+    if cl:
+        return _nc_last(out), _nc_last(mask)
     return out, mask
 
 
@@ -1109,24 +1165,29 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW"):
     k = _pair(kernel_size, 3)
     s = _pair(stride, 3) if stride is not None else k
-    pads = _conv_padding(padding, 3)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 3, cl)
+    if cl:
+        x = _nc_first(x)
     if isinstance(pads, str):
         pad_cfg = pads
     else:
-        extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], pads[i][0])
+        extra = [(_ceil_extra(x.shape[2 + i], k[i], s[i], *pads[i])
                   if ceil_mode else 0) for i in range(3)]
         pad_cfg = [(0, 0), (0, 0)] + [(pads[i][0], pads[i][1] + extra[i])
                                       for i in range(3)]
     summed = jax.lax.reduce_window(
         x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, padding=pad_cfg)
     if divisor_override:
-        return summed / divisor_override
-    if exclusive and not isinstance(pad_cfg, str):
+        out = summed / divisor_override
+    elif exclusive and not isinstance(pad_cfg, str):
         ones = jnp.ones((1, 1) + x.shape[-3:], x.dtype)
         count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1) + k,
                                       (1, 1) + s, padding=pad_cfg)
-        return summed / count
-    return summed / (k[0] * k[1] * k[2])
+        out = summed / count
+    else:
+        out = summed / (k[0] * k[1] * k[2])
+    return _nc_last(out) if cl else out
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False):
@@ -1325,12 +1386,16 @@ def _fractional_pool_impl_mask(x, bounds, in_sizes):
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCL"):
-    from ..ops import squeeze, unsqueeze
+    from ..ops import squeeze, unsqueeze, transpose as _tr
+    cl = not data_format.startswith("NC")
+    if cl:
+        x = _tr(x, (0, 2, 1))
     out = conv2d_transpose(
         unsqueeze(x, -1), unsqueeze(weight, -1), bias,
         (_pair(stride, 1)[0], 1), (_pair(padding, 1)[0], 0),
         (_pair(output_padding, 1)[0], 0), (_pair(dilation, 1)[0], 1), groups)
-    return squeeze(out, -1)
+    out = squeeze(out, -1)
+    return _tr(out, (0, 2, 1)) if cl else out
 
 
 @tensor_op
@@ -1342,7 +1407,10 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     opad = _pair(output_padding, 3)
     if isinstance(padding, str):
         raise NotImplementedError("string padding for conv_transpose")
-    pads = _conv_padding(padding, 3)
+    cl = not data_format.startswith("NC")
+    pads = _conv_padding(padding, 3, cl)
+    if cl:
+        x = _nc_first(x)
     ks = weight.shape[2:]
     pad_t = [(dilation[i] * (ks[i] - 1) - pads[i][0],
               dilation[i] * (ks[i] - 1) - pads[i][1] + opad[i])
@@ -1361,7 +1429,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
         feature_group_count=groups)
     if bias is not None:
         out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
-    return out
+    return _nc_last(out) if cl else out
 
 
 @tensor_op
@@ -1795,14 +1863,14 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     p = float(norm_type)
     if p == float("inf"):
         return max_pool2d(x, kernel_size, stride, padding,
-                          ceil_mode=ceil_mode)
+                          ceil_mode=ceil_mode, data_format=data_format)
     kh, kw = _pair(kernel_size)
     powed = x.abs().pow(p) if hasattr(x, "abs") else abs(x) ** p
     # divisor_override pins the divisor to the FULL kernel area, so
     # s * kh*kw is the true window sum even for padding/ceil overhang
     # windows (exclusive averaging there would overscale the sum)
     s = avg_pool2d(powed, kernel_size, stride, padding, ceil_mode=ceil_mode,
-                   divisor_override=kh * kw)
+                   divisor_override=kh * kw, data_format=data_format)
     return (s * float(kh * kw)).pow(1.0 / p)
 
 

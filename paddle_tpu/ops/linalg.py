@@ -21,10 +21,12 @@ def norm(x, p="fro", axis=None, keepdim=False):
                 f"norm(p='nuc') is a matrix norm: axis must be None or a "
                 f"2-element list/tuple, got {axis!r}")
         return jnp.linalg.norm(x, ord="nuc", axis=ax, keepdims=keepdim)
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        # matrix norm: 'fro' must stay Frobenius here — mapping it to p=2
+        # first would compute the spectral norm (largest singular value)
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
     if p == "fro":
         p = 2
-    if isinstance(axis, (list, tuple)) and len(axis) == 2:
-        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
     if axis is None:
         x = jnp.reshape(x, (-1,))
         axis = 0

@@ -479,3 +479,119 @@ class TestInterpolateModeParityR5:
         x = np.zeros((1, 2, 6, 6), np.float32)
         with pytest.raises(ValueError, match="spatial dim"):
             F.interpolate(_t(x), size=[9], mode="nearest")
+
+
+class TestConvPaddingFormsR5:
+    """Reference conv padding forms (caught in r5: the flat-2*spatial
+    branch intercepted pair-of-pairs input and crashed, and the full
+    per-tensor-dim form ignored channel-last layouts)."""
+
+    def _xw(self):
+        rng = np.random.RandomState(17)
+        return (rng.randn(2, 4, 9, 9).astype(np.float32),
+                rng.randn(6, 2, 3, 3).astype(np.float32))
+
+    def test_pair_of_pairs_nchw(self):
+        x, w = self._xw()
+        got = F.conv2d(_t(x), _t(w), None,
+                       padding=[[0, 0], [0, 0], [1, 2], [2, 1]],
+                       groups=2).numpy()
+        exp = TF.conv2d(TF.pad(torch.tensor(x), (2, 1, 1, 2)),
+                        torch.tensor(w), None, groups=2).numpy()
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_pair_of_pairs_nhwc_uses_spatial_positions(self):
+        x, w = self._xw()
+        got = F.conv2d(_t(x.transpose(0, 2, 3, 1)), _t(w), None,
+                       padding=[[0, 0], [1, 2], [2, 1], [0, 0]],
+                       groups=2, data_format="NHWC").numpy()
+        exp = TF.conv2d(TF.pad(torch.tensor(x), (2, 1, 1, 2)),
+                        torch.tensor(w), None,
+                        groups=2).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_flat_asymmetric(self):
+        x, w = self._xw()
+        got = F.conv2d(_t(x), _t(w), None, padding=[1, 2, 2, 1],
+                       groups=2).numpy()
+        exp = TF.conv2d(TF.pad(torch.tensor(x), (2, 1, 1, 2)),
+                        torch.tensor(w), None, groups=2).numpy()
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+
+class TestChannelsLastConvPoolR5:
+    """Channels-last data_format across conv/pool families vs torch
+    (caught in r5: conv1d/conv3d/transposes/pools parsed channel-last
+    padding but computed channels-first on the raw layout — NLC/NDHWC/NHWC
+    inputs produced garbage). Plus the fro-axis spectral-norm fix and the
+    asymmetric ceil_mode span."""
+
+    def setup_method(self):
+        self.rng = np.random.RandomState(19)
+
+    def test_conv1d_nlc(self):
+        x = self.rng.randn(2, 3, 11).astype(np.float32)
+        w = self.rng.randn(5, 3, 4).astype(np.float32)
+        got = F.conv1d(_t(x.transpose(0, 2, 1)), _t(w), None, stride=2,
+                       padding=1, data_format="NLC").numpy()
+        exp = TF.conv1d(torch.tensor(x), torch.tensor(w), None, 2,
+                        1).numpy().transpose(0, 2, 1)
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_conv3d_ndhwc(self):
+        x = self.rng.randn(1, 2, 5, 6, 7).astype(np.float32)
+        w = self.rng.randn(4, 2, 2, 3, 2).astype(np.float32)
+        got = F.conv3d(_t(x.transpose(0, 2, 3, 4, 1)), _t(w), None,
+                       padding=1, data_format="NDHWC").numpy()
+        exp = TF.conv3d(torch.tensor(x), torch.tensor(w), None,
+                        padding=1).numpy().transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_conv2d_transpose_nhwc(self):
+        x = self.rng.randn(2, 6, 5, 5).astype(np.float32)
+        w = self.rng.randn(6, 2, 3, 3).astype(np.float32)
+        got = F.conv2d_transpose(
+            _t(x.transpose(0, 2, 3, 1)), _t(w), None, stride=2, padding=1,
+            output_padding=1, groups=2, data_format="NHWC").numpy()
+        exp = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), None, 2,
+                                  1, 1, 2).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_max_pool2d_nhwc_with_mask(self):
+        x = self.rng.randn(2, 4, 8, 8).astype(np.float32)
+        o, m = F.max_pool2d(_t(x.transpose(0, 2, 3, 1)), 2, stride=2,
+                            return_mask=True, data_format="NHWC")
+        to, tm = TF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+        np.testing.assert_allclose(o.numpy(),
+                                   to.numpy().transpose(0, 2, 3, 1))
+        np.testing.assert_array_equal(m.numpy(),
+                                      tm.numpy().transpose(0, 2, 3, 1))
+
+    def test_avg_pool3d_ndhwc(self):
+        x = self.rng.randn(1, 3, 6, 7, 8).astype(np.float32)
+        got = F.avg_pool3d(_t(x.transpose(0, 2, 3, 4, 1)), 2, stride=2,
+                           data_format="NDHWC").numpy()
+        exp = TF.avg_pool3d(torch.tensor(x), 2,
+                            2).numpy().transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(got, exp, atol=2e-4, rtol=1e-3)
+
+    def test_ceil_mode_asymmetric_pad(self):
+        # span 6+1+0-2=5 -> ceil gives 4 windows; the symmetric-pad formula
+        # (span 6) would produce 3
+        x = self.rng.randn(1, 1, 6, 6).astype(np.float32)
+        out = F.max_pool2d(_t(x), 2, stride=2,
+                           padding=[[0, 0], [0, 0], [1, 0], [1, 0]],
+                           ceil_mode=True)
+        assert tuple(out.shape) == (1, 1, 4, 4)
+
+    def test_fro_with_axis_is_frobenius(self):
+        m = np.float32([[3, 0], [0, 4]])
+        got = float(paddle.linalg.norm(_t(m), "fro", axis=[0, 1]).numpy())
+        assert abs(got - 5.0) < 1e-5  # spectral would give 4.0
+
+    def test_nonzero_channel_pad_rejected(self):
+        x = np.zeros((1, 2, 4, 4), np.float32)
+        w = np.zeros((2, 2, 3, 3), np.float32)
+        with pytest.raises(ValueError, match="batch/channel"):
+            F.conv2d(_t(x), _t(w), None,
+                     padding=[[0, 0], [3, 3], [1, 1], [1, 1]])
