@@ -132,6 +132,17 @@ def _install_tensor_methods():
     Tensor.__and__ = lambda s, o: (logical_and if s.dtype == jnp.bool_ else bitwise_and)(s, _coerce(o))
     Tensor.__or__ = lambda s, o: (logical_or if s.dtype == jnp.bool_ else bitwise_or)(s, _coerce(o))
     Tensor.__xor__ = lambda s, o: (logical_xor if s.dtype == jnp.bool_ else bitwise_xor)(s, _coerce(o))
+
+    def _lshift(s, o):
+        from .tail import bitwise_left_shift
+        return bitwise_left_shift(s, _coerce(o))
+
+    def _rshift(s, o):
+        from .tail import bitwise_right_shift
+        return bitwise_right_shift(s, _coerce(o))
+
+    Tensor.__lshift__ = _lshift
+    Tensor.__rshift__ = _rshift
     Tensor.__getitem__ = lambda s, idx: getitem(s, idx)
 
     def _setitem_inplace(s, idx, value):

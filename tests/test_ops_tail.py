@@ -317,3 +317,14 @@ class TestTailOps:
         """VERDICT r3 item 8: registry >= 450 ops."""
         from paddle_tpu.ops._op import OP_REGISTRY
         assert len(OP_REGISTRY) >= 450, len(OP_REGISTRY)
+
+
+class TestShiftOperators:
+    """`<<`/`>>` operator overloads on Tensor (reference installs
+    __lshift__/__rshift__ over the bitwise shift kernels)."""
+
+    def test_shift_dunders(self):
+        x = paddle.to_tensor(np.int32([1, 2, 3]))
+        np.testing.assert_array_equal((x << 2).numpy(), [4, 8, 12])
+        y = paddle.to_tensor(np.int32([8, 16, 32]))
+        np.testing.assert_array_equal((y >> 2).numpy(), [2, 4, 8])
