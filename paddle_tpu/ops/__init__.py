@@ -143,6 +143,8 @@ def _install_tensor_methods():
 
     Tensor.__lshift__ = _lshift
     Tensor.__rshift__ = _rshift
+    Tensor.__rlshift__ = lambda s, o: _lshift(_coerce(o), s)
+    Tensor.__rrshift__ = lambda s, o: _rshift(_coerce(o), s)
     Tensor.__getitem__ = lambda s, idx: getitem(s, idx)
 
     def _setitem_inplace(s, idx, value):
@@ -207,3 +209,14 @@ for _f in (reshape, split, chunk, unstack, unbind, tile, broadcast_to,
     _reg(_f)
 # astype is the Tensor-method spelling of cast (distinct public surface)
 _reg(cast, name="astype")
+
+# Method spellings of registry ops (the reference patches these onto Tensor
+# in python/paddle/tensor/__init__.py's tensor_method_func list †). Bound
+# after every module has registered so the registry lookup sees them all.
+for _n in ("unfold", "bucketize", "frac", "renorm", "logcumsumexp",
+           "cummax", "cummin", "copysign", "hypot", "ldexp", "frexp",
+           "nextafter", "heaviside", "nanmean", "nansum", "quantile",
+           "nanquantile", "cross", "histogram", "bincount", "vander",
+           "corrcoef", "cov", "trapezoid"):
+    if _n in OP_REGISTRY and not hasattr(Tensor, _n):
+        setattr(Tensor, _n, OP_REGISTRY[_n])

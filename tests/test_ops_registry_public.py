@@ -83,3 +83,25 @@ class TestSetPrintoptions:
         assert np.get_printoptions() == before
         arr = np.array([0.123456789])
         assert "0.12345679" in repr(arr)
+
+
+class TestMethodSpellings:
+    """Registry ops bound as Tensor methods (reference tensor_method_func
+    patch list †) — r5 session-3 batch."""
+
+    def test_bound_and_working(self):
+        t = paddle.to_tensor(np.float32([3.7, -1.2, 0.5]))
+        np.testing.assert_allclose(t.frac().numpy(), [0.7, -0.2, 0.5],
+                                   atol=1e-6)
+        v, i = paddle.to_tensor(np.float32([[1, 5, 2]])).cummax(axis=1)
+        np.testing.assert_array_equal(v.numpy(), [[1, 5, 5]])
+        u = paddle.to_tensor(np.arange(10, dtype=np.float32)).unfold(0, 4, 2)
+        assert tuple(u.shape) == (4, 4)
+        q = paddle.to_tensor(np.float32([1, 2, 3, 4])).quantile(0.5)
+        assert float(q.numpy()) == 2.5
+        for name in ("bucketize", "renorm", "logcumsumexp", "cummin",
+                     "copysign", "hypot", "ldexp", "frexp", "nextafter",
+                     "heaviside", "nanmean", "nansum", "nanquantile",
+                     "cross", "histogram", "bincount", "vander",
+                     "corrcoef", "cov", "trapezoid"):
+            assert callable(getattr(paddle.Tensor, name)), name

@@ -328,3 +328,8 @@ class TestShiftOperators:
         np.testing.assert_array_equal((x << 2).numpy(), [4, 8, 12])
         y = paddle.to_tensor(np.int32([8, 16, 32]))
         np.testing.assert_array_equal((y >> 2).numpy(), [2, 4, 8])
+
+    def test_reflected_shift_dunders(self):
+        t = paddle.to_tensor(np.int32([1, 2, 3]))
+        np.testing.assert_array_equal((2 << t).numpy(), [4, 8, 16])
+        np.testing.assert_array_equal((256 >> t).numpy(), [128, 64, 32])
