@@ -387,17 +387,109 @@ class TrainStep:
         return self._opt_state
 
 
+def _spec_struct(s, pos):
+    """InputSpec / Tensor / array-like -> jax.ShapeDtypeStruct. Dynamic
+    dims (InputSpec None/-1, e.g. the batch axis) become jax.export
+    symbolic dimensions, so the exported program accepts any size there."""
+    from ..core import dtype as dtype_mod
+    if isinstance(s, Tensor):
+        return jax.ShapeDtypeStruct(tuple(s.shape), s.value.dtype)
+    dims = [int(d) if d is not None else -1 for d in s.shape]
+    dt = dtype_mod.to_jax_dtype(getattr(s, "dtype", "float32"))
+    if any(d == -1 for d in dims):
+        from jax import export as jexport
+        sym = ",".join(f"_dyn{pos}_{i}" if d == -1 else str(d)
+                       for i, d in enumerate(dims))
+        return jax.ShapeDtypeStruct(jexport.symbolic_shape(sym), dt)
+    return jax.ShapeDtypeStruct(tuple(dims), dt)
+
+
 def save(layer, path, input_spec=None, **config):
-    """paddle.jit.save — persists params + buffers (portable state, not HLO)."""
+    """paddle.jit.save (reference: python/paddle/jit/api.py † — persists a
+    translated static program + params). TPU-native artifact split:
+
+    - ``<path>.pdparams`` — the state dict (train/finetune state).
+    - ``<path>.pdmodel`` — when ``input_spec`` is given, the layer's
+      forward traced once and serialized as StableHLO via ``jax.export``
+      (the XLA analog of the reference's translated program; weights are
+      baked in as constants, so the .pdmodel alone is a complete
+      inference artifact loadable by :func:`load`).
+    """
     from ..framework import io as fio
+    base = path[:-len(".pdparams")] if path.endswith(".pdparams") else path
     state = layer.state_dict() if hasattr(layer, "state_dict") else layer
-    fio.save(state, path + ".pdparams" if not path.endswith(".pdparams") else path)
+    fio.save(state, base + ".pdparams")
+    if input_spec is None:
+        return
+    if not callable(layer):
+        raise TypeError(
+            f"jit.save: input_spec was given but the object to save is not "
+            f"callable ({type(layer).__name__}); pass the Layer itself, not "
+            f"its state_dict, to export a traced program")
+    from jax import export as jexport
+
+    def _pure(*arrs):
+        with no_grad():
+            out = layer(*[Tensor(a) for a in arrs])
+        return jax.tree.map(lambda t: t.value if isinstance(t, Tensor) else t,
+                            out, is_leaf=lambda t: isinstance(t, Tensor))
+
+    exp = jexport.export(jax.jit(_pure))(*[_spec_struct(s, i)
+                                           for i, s in enumerate(input_spec)])
+    with open(base + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+
+
+class TranslatedLayer:
+    """Callable inference artifact returned by :func:`load` (reference
+    ``paddle.jit.TranslatedLayer`` †): wraps a deserialized StableHLO
+    program. Weights are constants inside the program; ``state_dict()``
+    exposes the sidecar .pdparams for inspection/finetune hand-off."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+        self.training = False
+
+    def __call__(self, *args):
+        arrs = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(*arrs)
+        return jax.tree.map(lambda v: Tensor(v), out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return self._state
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference artifact (weights are baked "
+            "into the exported program); rebuild the python Layer and "
+            "set_state_dict the .pdparams to train")
 
 
 def load(path, **config):
+    """Returns a callable :class:`TranslatedLayer` when a traced program
+    was saved (input_spec passed to save); otherwise the bare state dict
+    (params-only save)."""
+    import os as _os
+
     from ..framework import io as fio
     p = path if path.endswith(".pdparams") else path + ".pdparams"
-    return fio.load(p)
+    state = fio.load(p)
+    model_p = (path[:-len(".pdparams")] if path.endswith(".pdparams")
+               else path) + ".pdmodel"
+    if _os.path.exists(model_p):
+        from jax import export as jexport
+        with open(model_p, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        return TranslatedLayer(exported, state)
+    return state
 
 
 def not_to_static(fn):

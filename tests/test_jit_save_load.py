@@ -1,0 +1,97 @@
+"""paddle.jit.save/load deployment artifact (reference: python/paddle/jit/
+api.py † save → translated program + params; load → TranslatedLayer).
+
+TPU-native artifact: the forward traced once and serialized as StableHLO
+via jax.export (.pdmodel, weights baked as constants) beside the state
+dict (.pdparams)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+from paddle_tpu.static import InputSpec
+
+
+def _net():
+    paddle.seed(7)
+    return paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                paddle.nn.Linear(8, 2))
+
+
+class TestJitSaveLoad:
+    def test_translated_layer_roundtrip(self, tmp_path):
+        net = _net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        ref = net(x).numpy()
+        path = str(tmp_path / "model")
+        jit.save(net, path, input_spec=[x])
+        assert sorted(os.listdir(tmp_path)) == ["model.pdmodel",
+                                                "model.pdparams"]
+        loaded = jit.load(path)
+        assert type(loaded).__name__ == "TranslatedLayer"
+        np.testing.assert_allclose(loaded(x).numpy(), ref, atol=1e-6)
+        # the artifact is self-contained: params live in the program
+        assert loaded.state_dict()  # sidecar exposed for inspection
+
+    def test_input_spec_form(self, tmp_path):
+        net = _net()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(5, 4).astype(np.float32))
+        path = str(tmp_path / "m")
+        jit.save(net, path,
+                 input_spec=[InputSpec(shape=[5, 4], dtype="float32")])
+        np.testing.assert_allclose(jit.load(path)(x).numpy(), net(x).numpy(),
+                                   atol=1e-6)
+
+    def test_params_only_save_returns_state(self, tmp_path):
+        net = _net()
+        path = str(tmp_path / "p")
+        jit.save(net, path)
+        state = jit.load(path)
+        assert isinstance(state, dict)
+        fresh = _net()
+        fresh.set_state_dict(state)
+
+    def test_dynamic_batch_dim(self, tmp_path):
+        # InputSpec None batch dim -> symbolic shape: one export serves
+        # every batch size (paddle's canonical dynamic-batch deployment)
+        net = _net()
+        path = str(tmp_path / "dyn")
+        jit.save(net, path,
+                 input_spec=[InputSpec(shape=[None, 4], dtype="float32")])
+        loaded = jit.load(path)
+        for b in (2, 7):
+            x = paddle.to_tensor(
+                np.random.RandomState(b).randn(b, 4).astype(np.float32))
+            np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                       atol=1e-6)
+
+    def test_pdparams_suffix_path_consistent(self, tmp_path):
+        # save('m.pdparams') and load('m.pdparams') must agree on where
+        # the traced program lives
+        net = _net()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        path = str(tmp_path / "m.pdparams")
+        jit.save(net, path, input_spec=[x])
+        loaded = jit.load(path)
+        assert type(loaded).__name__ == "TranslatedLayer"
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   atol=1e-6)
+
+    def test_state_dict_with_spec_raises(self, tmp_path):
+        import pytest
+        net = _net()
+        with pytest.raises(TypeError, match="not.*callable|state_dict"):
+            jit.save(net.state_dict(), str(tmp_path / "x"),
+                     input_spec=[InputSpec(shape=[2, 4], dtype="float32")])
+
+    def test_translated_layer_refuses_train(self, tmp_path):
+        import pytest
+        net = _net()
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        path = str(tmp_path / "t")
+        jit.save(net, path, input_spec=[x])
+        with pytest.raises(RuntimeError, match="inference artifact"):
+            jit.load(path).train()
