@@ -173,9 +173,25 @@ class Model:
         return self.network.state_dict()
 
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: inference export via jit.save — the network's
+        forward traced over the Model's input spec into a StableHLO
+        .pdmodel loadable as a callable TranslatedLayer (reference
+        hapi.Model.save → jit.save contract †)."""
         from ..framework import io as fio
+        if not training:
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference "
+                    "program and needs the input spec: construct the "
+                    "Model with inputs=[InputSpec(...)]")
+            from .. import jit as jit_mod
+            spec = (self._inputs if isinstance(self._inputs, (list, tuple))
+                    else [self._inputs])
+            jit_mod.save(self.network, path, input_spec=spec)
+            return
         fio.save(self.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             fio.save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

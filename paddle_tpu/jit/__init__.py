@@ -387,10 +387,12 @@ class TrainStep:
         return self._opt_state
 
 
-def _spec_struct(s, pos):
+def _spec_struct(s, pos, scope):
     """InputSpec / Tensor / array-like -> jax.ShapeDtypeStruct. Dynamic
     dims (InputSpec None/-1, e.g. the batch axis) become jax.export
-    symbolic dimensions, so the exported program accepts any size there."""
+    symbolic dimensions in the SHARED ``scope`` (mixing scopes across
+    inputs is rejected by jax.export), so the exported program accepts
+    any size there."""
     from ..core import dtype as dtype_mod
     if isinstance(s, Tensor):
         return jax.ShapeDtypeStruct(tuple(s.shape), s.value.dtype)
@@ -400,7 +402,8 @@ def _spec_struct(s, pos):
         from jax import export as jexport
         sym = ",".join(f"_dyn{pos}_{i}" if d == -1 else str(d)
                        for i, d in enumerate(dims))
-        return jax.ShapeDtypeStruct(jexport.symbolic_shape(sym), dt)
+        return jax.ShapeDtypeStruct(
+            jexport.symbolic_shape(sym, scope=scope), dt)
     return jax.ShapeDtypeStruct(tuple(dims), dt)
 
 
@@ -415,11 +418,17 @@ def save(layer, path, input_spec=None, **config):
       baked in as constants, so the .pdmodel alone is a complete
       inference artifact loadable by :func:`load`).
     """
+    import os as _os
+
     from ..framework import io as fio
     base = path[:-len(".pdparams")] if path.endswith(".pdparams") else path
     state = layer.state_dict() if hasattr(layer, "state_dict") else layer
     fio.save(state, base + ".pdparams")
     if input_spec is None:
+        # params-only save must not leave a stale traced program behind —
+        # a later load would silently run the OLD baked weights
+        if _os.path.exists(base + ".pdmodel"):
+            _os.remove(base + ".pdmodel")
         return
     if not callable(layer):
         raise TypeError(
@@ -430,12 +439,21 @@ def save(layer, path, input_spec=None, **config):
 
     def _pure(*arrs):
         with no_grad():
-            out = layer(*[Tensor(a) for a in arrs])
-        return jax.tree.map(lambda t: t.value if isinstance(t, Tensor) else t,
-                            out, is_leaf=lambda t: isinstance(t, Tensor))
+            return _unwrap(layer(*[Tensor(a) for a in arrs]))
 
-    exp = jexport.export(jax.jit(_pure))(*[_spec_struct(s, i)
-                                           for i, s in enumerate(input_spec)])
+    # trace in eval mode: an inference artifact must not bake in dropout,
+    # and a train-mode BatchNorm would _rebind its running stats with the
+    # export tracer (leaking it into the live layer's buffers)
+    was_training = bool(getattr(layer, "training", False))
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        scope = jexport.SymbolicScope()
+        exp = jexport.export(jax.jit(_pure))(
+            *[_spec_struct(s, i, scope) for i, s in enumerate(input_spec)])
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
     with open(base + ".pdmodel", "wb") as f:
         f.write(exp.serialize())
 
@@ -481,7 +499,9 @@ def load(path, **config):
 
     from ..framework import io as fio
     p = path if path.endswith(".pdparams") else path + ".pdparams"
-    state = fio.load(p)
+    # the .pdmodel alone is a complete inference artifact (weights baked
+    # in), so a missing params sidecar is fine when the program exists
+    state = fio.load(p) if _os.path.exists(p) else None
     model_p = (path[:-len(".pdparams")] if path.endswith(".pdparams")
                else path) + ".pdmodel"
     if _os.path.exists(model_p):
@@ -489,6 +509,8 @@ def load(path, **config):
         with open(model_p, "rb") as f:
             exported = jexport.deserialize(f.read())
         return TranslatedLayer(exported, state)
+    if state is None:
+        raise FileNotFoundError(f"no {p} or {model_p}")
     return state
 
 

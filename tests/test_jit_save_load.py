@@ -95,3 +95,97 @@ class TestJitSaveLoad:
         jit.save(net, path, input_spec=[x])
         with pytest.raises(RuntimeError, match="inference artifact"):
             jit.load(path).train()
+
+
+class TestHapiInferenceExport:
+    """Model.save(training=False) -> jit.save inference artifact
+    (reference hapi contract: the deploy path out of fit())."""
+
+    def test_export_and_reload(self, tmp_path):
+        net = _net()
+        model = paddle.Model(net, inputs=[InputSpec(shape=[None, 4],
+                                                    dtype="float32")])
+        path = str(tmp_path / "deploy")
+        model.save(path, training=False)
+        assert sorted(os.listdir(tmp_path)) == ["deploy.pdmodel",
+                                                "deploy.pdparams"]
+        loaded = jit.load(path)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(3, 4).astype(np.float32))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   atol=1e-6)
+
+    def test_export_without_spec_raises(self, tmp_path):
+        import pytest
+        model = paddle.Model(_net())
+        with pytest.raises(ValueError, match="InputSpec"):
+            model.save(str(tmp_path / "x"), training=False)
+
+
+class TestJitSaveLoadHardening:
+    """r5 review findings: eval-mode trace, shared symbolic scope,
+    pdmodel-only load, stale-program removal."""
+
+    def test_trace_is_eval_mode_and_restores(self, tmp_path):
+        # dropout must not bake into the artifact; BatchNorm running stats
+        # must not catch export tracers; the layer's mode is restored
+        paddle.seed(3)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.BatchNorm1D(8),
+                                   paddle.nn.Dropout(0.5),
+                                   paddle.nn.Linear(8, 2))
+        net.train()
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(6, 4).astype(np.float32))
+        path = str(tmp_path / "ev")
+        jit.save(net, path, input_spec=[x])
+        assert net.training is True  # restored
+        loaded = jit.load(path)
+        net.eval()
+        ref = net(x).numpy()  # eval forward with the stats as exported
+        # deterministic (no dropout baked in) and matches eval-mode forward
+        np.testing.assert_allclose(loaded(x).numpy(), ref, atol=1e-5)
+        np.testing.assert_allclose(loaded(x).numpy(), loaded(x).numpy())
+        # live layer still usable in train mode (no leaked tracers in the
+        # BatchNorm buffers)
+        net.train()
+        _ = net(x).numpy()
+
+    def test_two_dynamic_inputs_share_scope(self, tmp_path):
+        class Two(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.lin(a) + b.sum()
+
+        net = Two()
+        path = str(tmp_path / "two")
+        jit.save(net, path, input_spec=[
+            InputSpec(shape=[None, 4], dtype="float32"),
+            InputSpec(shape=[None, 3], dtype="float32")])
+        loaded = jit.load(path)
+        a = paddle.to_tensor(np.ones((2, 4), np.float32))
+        b = paddle.to_tensor(np.ones((5, 3), np.float32))
+        np.testing.assert_allclose(loaded(a, b).numpy(),
+                                   net(a, b).numpy(), atol=1e-6)
+
+    def test_pdmodel_alone_is_loadable(self, tmp_path):
+        net = _net()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        path = str(tmp_path / "solo")
+        jit.save(net, path, input_spec=[x])
+        os.remove(path + ".pdparams")
+        loaded = jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   atol=1e-6)
+
+    def test_params_only_save_clears_stale_program(self, tmp_path):
+        net = _net()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        path = str(tmp_path / "stale")
+        jit.save(net, path, input_spec=[x])
+        jit.save(_net(), path)  # params-only re-save after retrain
+        assert not os.path.exists(path + ".pdmodel")
+        assert isinstance(jit.load(path), dict)
