@@ -60,13 +60,31 @@ def _unwrap(tree):
                         tree, is_leaf=_tensor_leaf)
 
 
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag):
+    """Global to_static switch (reference paddle.jit.enable_to_static †):
+    when False, decorated callables run eagerly — the standard debugging
+    escape hatch for translated programs."""
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
+
+def ignore_module(modules):
+    """Accepted for reference parity (paddle.jit.ignore_module †). The
+    AST translator skips these modules' source; the tracing design here
+    has no per-module translation to skip, so registration is a no-op."""
+    return None
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               full_graph=True, backend=None):
     """paddle.jit.to_static — returns a compiled callable.
 
     For a Layer, compiles ``forward`` (buffers threaded functionally and
     written back after each call). For a plain function over Tensors,
-    jit-compiles it directly.
+    jit-compiles it directly. ``enable_to_static(False)`` makes the
+    returned callable run the original eager code instead.
     """
     def decorate(obj):
         from ..nn.layer import Layer
@@ -76,6 +94,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         compiled = {}
 
         def wrapper(*args, **kwargs):
+            if not _TO_STATIC_ENABLED[0]:
+                # same detach semantics as the compiled path (which traces
+                # under no_grad): the switch changes execution mode only
+                with no_grad():
+                    return obj(*args, **kwargs)
+
             def pure(vals, kw):
                 with no_grad():
                     t_args = jax.tree.map(Tensor, vals)
@@ -120,6 +144,9 @@ class StaticLayer:
         return out, new_buffers
 
     def __call__(self, *args):
+        if not _TO_STATIC_ENABLED[0]:
+            # debugging escape hatch: run the original eager forward
+            return self._layer(*args)
         params, buffers = split_state(self._layer)
         key = random_mod.next_key()
         out, new_buffers = self._jit(params, buffers, _unwrap(args), key,

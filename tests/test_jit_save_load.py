@@ -189,3 +189,52 @@ class TestJitSaveLoadHardening:
         jit.save(_net(), path)  # params-only re-save after retrain
         assert not os.path.exists(path + ".pdmodel")
         assert isinstance(jit.load(path), dict)
+
+
+class TestToStaticSwitch:
+    """paddle.jit.enable_to_static global switch + ignore_module parity."""
+
+    def test_disable_runs_eager(self):
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)  # side effect visible only on eager re-entry
+            return x * 2
+
+        x = paddle.to_tensor(np.float32([1.0]))
+        f(x); f(x)
+        traced_calls = len(calls)  # jit traces once, then cached
+        assert traced_calls == 1
+        try:
+            jit.enable_to_static(False)
+            f(x); f(x)
+            assert len(calls) == traced_calls + 2  # eager: every call runs
+        finally:
+            jit.enable_to_static(True)
+        np.testing.assert_allclose(f(x).numpy(), [2.0])
+
+    def test_ignore_module_accepts(self):
+        import numpy
+        assert jit.ignore_module([numpy]) is None
+
+    def test_disable_covers_layers(self):
+        # the escape hatch must also apply to to_static(Layer)
+        net = jit.to_static(_net())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        ref = net(x).numpy()
+        calls = []
+        orig_fwd = net._layer.forward
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig_fwd(*a, **k)
+
+        try:
+            jit.enable_to_static(False)
+            net._layer.forward = spy
+            np.testing.assert_allclose(net(x).numpy(), ref, atol=1e-6)
+            assert calls  # eager forward actually ran
+        finally:
+            net._layer.forward = orig_fwd
+            jit.enable_to_static(True)
