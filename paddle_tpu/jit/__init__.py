@@ -145,8 +145,11 @@ class StaticLayer:
 
     def __call__(self, *args):
         if not _TO_STATIC_ENABLED[0]:
-            # debugging escape hatch: run the original eager forward
-            return self._layer(*args)
+            # debugging escape hatch: run the original eager forward with
+            # the compiled path's detach semantics (it traces under
+            # no_grad), so the switch changes execution mode only
+            with no_grad():
+                return self._layer(*args)
         params, buffers = split_state(self._layer)
         key = random_mod.next_key()
         out, new_buffers = self._jit(params, buffers, _unwrap(args), key,

@@ -186,3 +186,42 @@ def calculate_gain(nonlinearity, param=None):
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convolutions
+    (reference: python/paddle/nn/initializer/Bilinear †). Every
+    (out_ch, in_ch) slice gets the same 2-D bilinear interpolation filter,
+    so a stride-s conv_transpose initialized with it performs bilinear
+    upsampling."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer expects a 4-D conv weight, got "
+                f"shape {shape}")
+        kh, kw = int(shape[2]), int(shape[3])
+
+        def filt_1d(k):
+            # caffe-style formula the reference uses: f = ceil(k/2),
+            # c = (2f - 1 - f%2) / (2f); e.g. k=3 -> [0.25, 0.75, 0.75],
+            # k=4 -> [0.25, 0.75, 0.75, 0.25]
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return 1.0 - np.abs(np.arange(k) / f - c)
+
+        filt = np.outer(filt_1d(kh), filt_1d(kw)).astype(np.float32)
+        out = np.tile(filt, (int(shape[0]), int(shape[1]), 1, 1))
+        return jnp.asarray(out, dtype)
+
+
+# ------------------------------------------------- global default override
+# (reference paddle.nn.initializer.set_global_initializer †: replaces the
+# framework-wide default weight/bias initializers consulted by
+# Layer.create_parameter when no explicit initializer is given)
+_global_init = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    _global_init["weight"] = weight_init
+    _global_init["bias"] = bias_init

@@ -96,9 +96,11 @@ class Layer:
         elif default_initializer is not None:
             initializer = default_initializer
         elif is_bias:
-            initializer = init_mod.Constant(0.0)
+            initializer = (init_mod._global_init["bias"]
+                           or init_mod.Constant(0.0))
         else:
-            initializer = init_mod.XavierUniform()
+            initializer = (init_mod._global_init["weight"]
+                           or init_mod.XavierUniform())
         value = initializer(shape, dtype)
         p = Parameter(value)
         if attr is not None and getattr(attr, "name", None):

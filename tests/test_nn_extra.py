@@ -253,3 +253,41 @@ class TestLossZoo:
         reg = 0.25 * 0.002 * ((anchor ** 2).sum(1).mean()
                               + (pos ** 2).sum(1).mean())
         np.testing.assert_allclose(npl, ce + reg, rtol=1e-4)
+
+
+class TestInitializerR5:
+    """Bilinear init + set_global_initializer (reference
+    nn/initializer surface †)."""
+
+    def test_bilinear_upsamples(self):
+        import paddle_tpu.nn.initializer as I
+        w = I.Bilinear()((1, 1, 4, 4), np.float32)
+        # stride-2 conv_transpose with this kernel bilinearly upsamples a
+        # constant image to a constant image (interior)
+        x = paddle.to_tensor(np.ones((1, 1, 3, 3), np.float32))
+        out = paddle.nn.functional.conv2d_transpose(
+            x, paddle.to_tensor(np.asarray(w)), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy()[0, 0, 1:-1, 1:-1], 1.0,
+                                   atol=1e-6)
+
+    def test_set_global_initializer(self):
+        import paddle_tpu.nn.initializer as I
+        try:
+            I.set_global_initializer(I.Constant(0.5), I.Constant(0.25))
+            lin = paddle.nn.Linear(3, 2)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+            np.testing.assert_allclose(lin.bias.numpy(), 0.25)
+        finally:
+            I.set_global_initializer(None, None)
+        lin2 = paddle.nn.Linear(3, 2)
+        assert not np.allclose(lin2.weight.numpy(), 0.5)
+
+    def test_bilinear_filter_values(self):
+        import paddle_tpu.nn.initializer as I
+        w3 = np.asarray(I.Bilinear()((1, 1, 3, 3), np.float32))
+        np.testing.assert_allclose(w3[0, 0, 0], [0.0625, 0.1875, 0.1875],
+                                   atol=1e-6)  # 0.25*[0.25,0.75,0.75]
+        w4 = np.asarray(I.Bilinear()((1, 1, 4, 4), np.float32))
+        np.testing.assert_allclose(w4[0, 0, 1],
+                                   0.75 * np.float32([0.25, 0.75, 0.75, 0.25]),
+                                   atol=1e-6)
