@@ -417,24 +417,32 @@ class TrainStep:
         return self._opt_state
 
 
+def _struct_from_shape(dims, dt, pos, scope):
+    """(dims with -1 dynamics, dtype) -> jax.ShapeDtypeStruct. Dynamic
+    dims become jax.export symbolic dimensions in the SHARED ``scope``
+    (mixing scopes across inputs is rejected). A dynamic AXIS-0 dim uses
+    one shared symbol across all inputs — multi-input models share their
+    batch axis, and independent symbols would fail export-time shape
+    checks on any op combining two inputs; non-leading dynamic dims stay
+    independent (varlen axes need not agree)."""
+    if not any(d == -1 for d in dims):
+        return jax.ShapeDtypeStruct(tuple(dims), dt)
+    from jax import export as jexport
+    sym = ",".join(("_dynb" if i == 0 else f"_dyn{pos}_{i}") if d == -1
+                   else str(d) for i, d in enumerate(dims))
+    return jax.ShapeDtypeStruct(jexport.symbolic_shape(sym, scope=scope),
+                                dt)
+
+
 def _spec_struct(s, pos, scope):
-    """InputSpec / Tensor / array-like -> jax.ShapeDtypeStruct. Dynamic
-    dims (InputSpec None/-1, e.g. the batch axis) become jax.export
-    symbolic dimensions in the SHARED ``scope`` (mixing scopes across
-    inputs is rejected by jax.export), so the exported program accepts
-    any size there."""
+    """InputSpec / Tensor / array-like -> jax.ShapeDtypeStruct (dynamic
+    dims via :func:`_struct_from_shape`)."""
     from ..core import dtype as dtype_mod
     if isinstance(s, Tensor):
         return jax.ShapeDtypeStruct(tuple(s.shape), s.value.dtype)
     dims = [int(d) if d is not None else -1 for d in s.shape]
     dt = dtype_mod.to_jax_dtype(getattr(s, "dtype", "float32"))
-    if any(d == -1 for d in dims):
-        from jax import export as jexport
-        sym = ",".join(f"_dyn{pos}_{i}" if d == -1 else str(d)
-                       for i, d in enumerate(dims))
-        return jax.ShapeDtypeStruct(
-            jexport.symbolic_shape(sym, scope=scope), dt)
-    return jax.ShapeDtypeStruct(tuple(dims), dt)
+    return _struct_from_shape(dims, dt, pos, scope)
 
 
 def save(layer, path, input_spec=None, **config):

@@ -106,3 +106,82 @@ def default_startup_program():
 
 def name_scope(name):
     return jax.named_scope(name)
+
+
+class _LoadedInference:
+    """Deserialized inference program returned by load_inference_model —
+    runnable via ``Executor.run(program=..., feed=..., fetch_list=...)``
+    exactly like a live StaticProgram (reference contract)."""
+
+    def __init__(self, exported, feed_names, fetch_count):
+        self._exported = exported
+        self.feed_names = list(feed_names)
+        self.fetch_count = int(fetch_count)
+
+    def run(self, feed_vals):
+        import jax.numpy as jnp
+        args = [jnp.asarray(feed_vals[n]) for n in self.feed_names]
+        out = self._exported.call(*args)
+        return out if isinstance(out, (list, tuple)) else (out,)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference ``paddle.static.save_inference_model`` †: persist the
+    captured program as a deployable artifact. TPU-native form: the
+    program's pure replay (feeds -> fetches, weights baked as constants)
+    is serialized as StableHLO via jax.export into ``<prefix>.pdmodel``,
+    with feed/fetch metadata in ``<prefix>.pdiparams`` (the reference's
+    sidecar name; params live inside the program here). Dynamic (-1) feed
+    dims export as symbolic shapes."""
+    import jax as _jax
+    from jax import export as jexport
+
+    from ..framework import io as fio
+    prog = program or default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_names = [prog.feed_names[id(t)] for t in feed_vars]
+    fetch_ids = tuple(id(t) for t in fetch_vars)
+    # the export prunes to what the fetches reach (training-only feeds
+    # like labels drop out), but every feed the pruned graph DOES need
+    # must be in feed_vars
+    required = set(prog.required_feed_names(fetch_ids))
+    missing = required - set(feed_names)
+    if missing:
+        raise ValueError(
+            f"save_inference_model: the fetch targets depend on feeds "
+            f"{sorted(missing)} not listed in feed_vars")
+    feed_names = [n for n in feed_names if n in required]
+
+    def pure(*arrs):
+        fv = dict(zip(feed_names, arrs))
+        return prog._replay_pruned(fv, fetch_ids)
+
+    from ..jit import _struct_from_shape
+    scope = jexport.SymbolicScope()
+    structs = [
+        _struct_from_shape(list(prog._feed_shapes[name][0]),
+                           prog._feed_shapes[name][1], i, scope)
+        for i, name in enumerate(feed_names)]
+    exp = jexport.export(_jax.jit(pure))(*structs)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    fio.save({"feed_names": feed_names, "fetch_count": len(fetch_ids)},
+             path_prefix + ".pdiparams")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns ``[program, feed_target_names, fetch_targets]`` (reference
+    signature); run with ``exe.run(program, feed={name: arr},
+    fetch_list=fetch_targets)``."""
+    from jax import export as jexport
+
+    from ..framework import io as fio
+    meta = fio.load(path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    prog = _LoadedInference(exported, meta["feed_names"],
+                            meta["fetch_count"])
+    fetch_targets = list(range(prog.fetch_count))
+    return [prog, list(prog.feed_names), fetch_targets]

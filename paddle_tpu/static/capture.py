@@ -109,6 +109,42 @@ class StaticProgram:
                 env[oid] = leaf
         return tuple(env[fid] for fid in fetch_ids)
 
+    def _prune(self, fetch_ids):
+        """Backward reachability from the fetches: (ops_used, needed_ids).
+        The reference's inference-model export prunes the graph to what
+        the fetch targets require, so feeds only the training half uses
+        (labels) drop out."""
+        needed = set(fetch_ids)
+        ops_used = []
+        for op in reversed(self.ops):
+            _fn, _name, slots, _treedef, out_ids = op
+            if any(o in needed for o in out_ids):
+                ops_used.append(op)
+                needed.update(s[1] for s in slots if s[0] == "var")
+        ops_used.reverse()
+        return ops_used, needed
+
+    def _replay_pruned(self, feed_vals, fetch_ids):
+        """Pure replay over only the ops the fetches need; feeds not in
+        the pruned graph are never touched."""
+        ops_used, needed = self._prune(fetch_ids)
+        env = dict(self._const)
+        for tid, fname in self.feed_names.items():
+            if tid in needed:
+                env[tid] = feed_vals[fname]
+        for fn, _name, slots, treedef, out_ids in ops_used:
+            vals = [env[s[1]] if s[0] == "var" else s[1] for s in slots]
+            a, k = jax.tree.unflatten(treedef, vals)
+            out = fn(*a, **k)
+            for oid, leaf in zip(out_ids, jax.tree.leaves(out)):
+                env[oid] = leaf
+        return tuple(env[fid] for fid in fetch_ids)
+
+    def required_feed_names(self, fetch_ids):
+        _ops, needed = self._prune(fetch_ids)
+        return [fname for tid, fname in self.feed_names.items()
+                if tid in needed]
+
     def run(self, feed, fetch_ids, jit=True):
         key = (tuple(sorted(feed)), tuple(fetch_ids), jit)
         fn = self._compiled.get(key)
@@ -178,13 +214,19 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, jit=True):
+        feed_vals = {k: (v.value if isinstance(v, Tensor) else v)
+                     for k, v in (feed or {}).items()}
+        # a deserialized inference program (load_inference_model) runs its
+        # exported StableHLO directly
+        if program is not None and hasattr(program, "_exported"):
+            import numpy as np
+            outs = program.run(feed_vals)
+            sel = fetch_list if fetch_list else range(len(outs))
+            return [np.asarray(outs[i]) for i in sel]
         if program is None or not isinstance(program, StaticProgram):
             raise ValueError("Executor.run needs the StaticProgram that "
                              "captured the graph (program_guard target)")
-        feed = feed or {}
         fetch_list = fetch_list or []
-        feed_vals = {k: (v.value if isinstance(v, Tensor) else v)
-                     for k, v in feed.items()}
         missing = set(program.feed_names.values()) - set(feed_vals)
         if missing:
             raise ValueError(f"missing feeds: {sorted(missing)}")

@@ -141,3 +141,85 @@ class TestStaticProgram:
                 warnings.simplefilter("always")
                 paddle.add(frozen, x)
             assert any("BUILD-TIME CONSTANT" in str(wi.message) for wi in w)
+
+
+class TestInferenceModelSaveLoad:
+    """static.save_inference_model / load_inference_model (reference
+    deployment pair †): the captured program's pure replay exported as
+    StableHLO with feeds as (symbolic-batch) arguments, reloadable and
+    runnable through the same Executor.run contract."""
+
+    def _build(self):
+        paddle.seed(0)
+        main = static.StaticProgram()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 4], "float32")
+            lin = paddle.nn.Linear(4, 3)
+            y = paddle.nn.functional.relu(lin(x))
+        return main, x, y
+
+    def test_roundtrip_dynamic_batch(self, tmp_path):
+        main, x, y = self._build()
+        exe = static.Executor()
+        prefix = str(tmp_path / "infer")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        assert sorted(p.name for p in tmp_path.iterdir()) == [
+            "infer.pdiparams", "infer.pdmodel"]
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        assert feeds == ["x"]
+        for b in (5, 9):
+            xs = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+            ref = exe.run(main, feed={"x": xs}, fetch_list=[y])[0]
+            out = exe.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
+            np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_prunes_training_only_feeds(self, tmp_path):
+        # the canonical train-then-deploy flow: label feeds the loss only
+        # and must drop out of the exported inference graph
+        paddle.seed(0)
+        main = static.StaticProgram()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 4], "float32")
+            label = static.data("label", [-1, 1], "float32")
+            lin = paddle.nn.Linear(4, 1)
+            y = lin(x)
+            loss = ((y - label) ** 2).mean()  # noqa: F841 (training half)
+        exe = static.Executor()
+        prefix = str(tmp_path / "pruned")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        assert feeds == ["x"]
+        xs = np.ones((3, 4), np.float32)
+        ref = exe.run(main, feed={"x": xs, "label": np.zeros((3, 1),
+                                                            np.float32)},
+                      fetch_list=[y])[0]
+        out = exe.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_missing_required_feed_raises(self, tmp_path):
+        main = static.StaticProgram()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 4], "float32")
+            y = paddle.nn.functional.relu(x)
+        with pytest.raises(ValueError, match="depend on feeds"):
+            static.save_inference_model(str(tmp_path / "m"), [], [y],
+                                        program=main)
+
+    def test_two_dynamic_inputs_share_batch(self, tmp_path):
+        # both feeds share the batch axis: one shared symbol must let
+        # add(a, b) export (independent symbols fail shape checks)
+        main = static.StaticProgram()
+        with static.program_guard(main):
+            a = static.data("a", [-1, 4], "float32")
+            b = static.data("b", [-1, 4], "float32")
+            c = a + b
+        exe = static.Executor()
+        prefix = str(tmp_path / "two")
+        static.save_inference_model(prefix, [a, b], [c], exe, program=main)
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        for n in (2, 6):
+            av = np.full((n, 4), 2.0, np.float32)
+            bv = np.full((n, 4), 3.0, np.float32)
+            out = exe.run(prog, feed={"a": av, "b": bv},
+                          fetch_list=fetches)[0]
+            np.testing.assert_allclose(out, 5.0)
