@@ -208,3 +208,17 @@ class GradScaler:
         self._bad_steps = state.get("bad_steps", 0)
 
     set_state_dict = load_state_dict
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native mixed-precision dtype (MXU computes in it);
+    XLA also lowers bf16 on CPU, so this is True on every backend this
+    framework targets (reference: paddle.amp.is_bfloat16_supported †)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """XLA compiles fp16 on TPU/CPU, but TPU hardware has no native fp16
+    path (it upcasts around the MXU) — supported, with bf16 preferred
+    (reference: paddle.amp.is_float16_supported †)."""
+    return True
