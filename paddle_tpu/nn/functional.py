@@ -2238,3 +2238,9 @@ def flash_attn_unpadded(*args, **kwargs):
 def flash_attn_qkvpacked(*args, **kwargs):
     from ..incubate.nn.functional import flash_attn_qkvpacked as _faq
     return _faq(*args, **kwargs)
+
+
+def relu_(x, name=None):
+    """Inplace relu (reference F.relu_ †): rebinds x to relu(x)."""
+    from ..ops.inplace import _inplace_of
+    return _inplace_of(relu, "relu_")(x)

@@ -100,7 +100,8 @@ def _install():
     for base in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh",
                  "cosh", "asinh", "acosh", "atanh", "expm1", "log", "log2",
                  "log10", "log1p", "digamma", "lgamma", "i0", "gammaln",
-                 "gammainc", "gammaincc", "hypot", "ldexp", "copysign"):
+                 "gammainc", "gammaincc", "hypot", "ldexp", "copysign",
+                 "gcd", "lcm"):
         sources[base + "_"] = OP_REGISTRY[base]
     sources["tril_"] = creation.tril
     sources["triu_"] = creation.triu

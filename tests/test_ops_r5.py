@@ -680,3 +680,25 @@ class TestNormNuclear:
         x = np.zeros((3, 4), np.float32)
         with pytest.raises(ValueError, match="matrix norm"):
             paddle.linalg.norm(paddle.to_tensor(x), "nuc", axis=0)
+
+
+class TestInplaceR5Session3:
+    """gcd_/lcm_ (2.6 inplace batch) + F.relu_ with autograd through the
+    rebind."""
+
+    def test_gcd_lcm_inplace(self):
+        t = paddle.to_tensor(np.int32([12, 18]))
+        assert t.gcd_(paddle.to_tensor(np.int32([8, 27]))) is t
+        np.testing.assert_array_equal(t.numpy(), [4, 9])
+        t2 = paddle.to_tensor(np.int32([4, 6]))
+        t2.lcm_(paddle.to_tensor(np.int32([6, 4])))
+        np.testing.assert_array_equal(t2.numpy(), [12, 12])
+
+    def test_relu_inplace_grad(self):
+        p = paddle.to_tensor(np.float32([-1.0, 3.0]))
+        p.stop_gradient = False
+        y = p * 2.0
+        out = F.relu_(y)
+        assert out is y
+        y.sum().backward()
+        np.testing.assert_array_equal(p.grad.numpy(), [0.0, 2.0])
