@@ -2240,7 +2240,11 @@ def flash_attn_qkvpacked(*args, **kwargs):
     return _faq(*args, **kwargs)
 
 
-def relu_(x, name=None):
-    """Inplace relu (reference F.relu_ †): rebinds x to relu(x)."""
+def _make_relu_():
     from ..ops.inplace import _inplace_of
-    return _inplace_of(relu, "relu_")(x)
+    fn = _inplace_of(relu, "relu_")
+    fn.__doc__ = "Inplace relu (reference F.relu_ †): rebinds x to relu(x)."
+    return fn
+
+
+relu_ = _make_relu_()

@@ -150,12 +150,29 @@ def _to_sparse(t: Tensor, kind="coo"):
     return SparseCooTensor(bcoo) if kind == "coo" else SparseCsrTensor(bcoo)
 
 
-# patched onto dense Tensor by paddle parity: paddle.Tensor.to_sparse_coo
+# patched onto dense Tensor below (paddle parity: Tensor.to_sparse_coo /
+# to_sparse_csr and the module-level spellings are the SAME function)
 def to_sparse_coo(t, sparse_dim=None):
+    nd = _val(t).ndim
+    sparse_dim = nd if sparse_dim is None else int(sparse_dim)
+    if not 0 < sparse_dim <= nd:
+        raise ValueError(f"sparse_dim must be in [1, {nd}], got "
+                         f"{sparse_dim}")
+    if sparse_dim != nd:
+        # a hybrid BCOO (n_dense > 0) would flow into ops (csr layout,
+        # transpose, elementwise rebuilds) that assume fully-sparse
+        # indices — refuse rather than misbehave downstream
+        raise NotImplementedError(
+            f"to_sparse_coo with sparse_dim ({sparse_dim}) < ndim ({nd}) "
+            f"(hybrid dense/sparse layout) is not supported; omit "
+            f"sparse_dim for the fully-sparse form")
     return _to_sparse(t, "coo")
 
 
 def to_sparse_csr(t):
+    if _val(t).ndim != 2:
+        raise ValueError(
+            f"to_sparse_csr expects a 2-D tensor, got {_val(t).ndim}-D")
     return _to_sparse(t, "csr")
 
 
@@ -261,3 +278,11 @@ class _nn:
 
 
 nn = _nn()
+
+
+# ------------------------------------------------ Tensor method spellings
+# (reference: Tensor.to_sparse_coo / to_sparse_csr patched in
+# python/paddle/tensor/__init__.py †) — the module-level functions above,
+# bound as methods so both spellings share one validation path
+Tensor.to_sparse_coo = to_sparse_coo
+Tensor.to_sparse_csr = to_sparse_csr

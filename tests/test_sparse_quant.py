@@ -152,3 +152,33 @@ class TestQuantization:
         assert cm.fc1.qweight.numpy().dtype == np.int8
         out = cm(x).numpy()
         assert np.max(np.abs(out - ref)) < 0.15
+
+
+class TestTensorToSparseR5:
+    """Tensor.to_sparse_coo / to_sparse_csr method spellings vs scipy."""
+
+    def test_roundtrip_and_csr_layout(self):
+        import scipy.sparse as sp
+        rng = np.random.RandomState(47)
+        d = rng.rand(5, 6).astype(np.float32); d[d < 0.6] = 0
+        t = paddle.to_tensor(d)
+        sc = t.to_sparse_coo(2)
+        np.testing.assert_allclose(sc.to_dense().numpy(), d)
+        csr = t.to_sparse_csr()
+        ref = sp.csr_matrix(d)
+        np.testing.assert_array_equal(np.asarray(csr.crows().numpy()),
+                                      ref.indptr)
+        np.testing.assert_array_equal(np.asarray(csr.cols().numpy()),
+                                      ref.indices)
+        np.testing.assert_allclose(np.asarray(csr.values().numpy()),
+                                   ref.data)
+
+    def test_validation(self):
+        import pytest
+        t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="sparse_dim"):
+            t.to_sparse_coo(5)
+        with pytest.raises(NotImplementedError, match="hybrid"):
+            t.to_sparse_coo(1)  # hybrid layouts refused, not mis-handled
+        with pytest.raises(ValueError, match="2-D"):
+            paddle.to_tensor(np.zeros((2, 3, 4), np.float32)).to_sparse_csr()
