@@ -26,10 +26,20 @@ import numpy as np
 
 
 class _GlobalGenerator:
+    """LAZY global PRNG: the key materializes on first use, not at
+    construction. Creating a jax array at import time would initialize the
+    XLA backend, and ``jax.distributed.initialize`` (init_parallel_env's
+    multi-host path) must run before ANY backend-touching call — an eager
+    key would make `import paddle_tpu` itself break multi-host setup."""
+
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._seed = seed
         self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
 
     def manual_seed(self, seed: int):
         self._key = jax.random.PRNGKey(seed)
@@ -37,11 +47,14 @@ class _GlobalGenerator:
 
     def next_key(self):
         with self._lock:
+            self._ensure()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:  # _ensure must not race next_key's lazy init
+            self._ensure()
+            return self._key
 
     def set_state(self, key):
         self._key = key

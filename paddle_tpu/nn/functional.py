@@ -2242,9 +2242,12 @@ def flash_attn_qkvpacked(*args, **kwargs):
 
 def _make_relu_():
     from ..ops.inplace import _inplace_of
-    fn = _inplace_of(relu, "relu_")
-    fn.__doc__ = "Inplace relu (reference F.relu_ †): rebinds x to relu(x)."
-    return fn
+    return _inplace_of(relu, "relu_")
 
 
-relu_ = _make_relu_()
+_relu_inplace = _make_relu_()
+
+
+def relu_(x, name=None):
+    """Inplace relu (reference F.relu_ †): rebinds x to relu(x)."""
+    return _relu_inplace(x)

@@ -42,6 +42,38 @@ def init_parallel_env():
             raise RuntimeError(
                 "multi-process run needs PADDLE_MASTER or "
                 "PADDLE_TRAINER_ENDPOINTS")
+        host, _, port = master.rpartition(":")
+        if port in ("", "0"):
+            # the launcher passes --master through verbatim; port 0 is an
+            # "auto-pick" request that cannot rendezvous as-is. Agree on a
+            # real coordinator port through the rendezvous store: rank 0
+            # picks a free port and publishes the endpoint, others poll.
+            kv = os.environ.get("PADDLE_MASTER_KV")
+            if not kv:
+                raise RuntimeError(
+                    f"PADDLE_MASTER '{master}' has no fixed port and no "
+                    f"rendezvous store (PADDLE_MASTER_KV) is available to "
+                    f"agree on one; pass --master host:<nonzero-port>")
+            from .launch.rendezvous import connect
+            store = connect(kv)
+            key = (f"/job/{os.environ.get('PADDLE_JOB_ID', 'default')}"
+                   f"/jaxcoord")
+            if pid == 0:
+                import socket
+                s = socket.socket()
+                s.bind((host or "127.0.0.1", 0))
+                master = f"{host or '127.0.0.1'}:{s.getsockname()[1]}"
+                s.close()  # freed instants before jax re-binds it
+                store.put(key, master)
+            else:
+                import time as _time
+                deadline = _time.time() + 60.0
+                while (master := store.get(key)) is None:
+                    if _time.time() > deadline:
+                        raise TimeoutError(
+                            "rank 0 never published the jax coordinator "
+                            "endpoint")
+                    _time.sleep(0.1)
         jax.distributed.initialize(coordinator_address=master,
                                    num_processes=nproc, process_id=pid)
     _STATE["initialized"] = True

@@ -72,6 +72,7 @@ def _child_env(args, local_rank, nnodes_min, kv_endpoint=None):
     if kv_endpoint:
         env["PADDLE_MASTER_KV"] = kv_endpoint
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    env["PADDLE_JOB_ID"] = args.job_id
     env["FLAGS_selected_tpus"] = str(local_rank)
     return env
 
@@ -337,6 +338,9 @@ def _drop_stale_ranks(kv_server, job_id):
             cli.delete(key)
         for key in cli.get_prefix("/objcol"):
             cli.delete(key)
+        # the previous incarnation's jax coordinator endpoint is equally
+        # stale: a restarted rank polling it would dial a dead port
+        cli.delete(f"/job/{job_id}/jaxcoord")
     except Exception as e:
         logger.warning(f"stale-rank cleanup failed: {e}")
 

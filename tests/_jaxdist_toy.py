@@ -1,0 +1,17 @@
+"""Worker for the REAL 2-process jax.distributed rendezvous test: imports
+paddle_tpu (must NOT initialize the backend), init_parallel_env (agrees a
+coordinator port via the rendezvous store when --master has port 0), then
+proves the distributed runtime is actually up with process_count()."""
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+import jax  # noqa: E402
+
+x = paddle.to_tensor(np.float32([1.0 + dist.get_rank()]))
+print(f"JAXDIST rank={jax.process_index()} nproc={jax.process_count()} "
+      f"val={float(x.numpy()[0])}", flush=True)

@@ -285,3 +285,42 @@ class TestNativeTCPStore:
             assert m.add("/ctr", 1) == 6
         finally:
             m.stop_server()
+
+
+class TestRealJaxDistributed:
+    """End-to-end 2-process jax.distributed rendezvous through the
+    launcher (the multi-host bring-up path, SURVEY §5.8): import must not
+    touch the backend, and init_parallel_env agrees a real coordinator
+    port through the rendezvous store when --master requests port 0."""
+
+    def test_two_process_rendezvous(self, tmp_path):
+        toy = os.path.join(REPO, "tests", "_jaxdist_toy.py")
+        p = _run_launch(["--procs", "2", "--master", "127.0.0.1:0",
+                         "--log_dir", str(tmp_path / "logs"), toy],
+                        timeout=180)
+        assert p.returncode == 0, (p.stdout[-300:], p.stderr[-500:])
+        logs = p.stdout  # rank 0 streams to the launcher console
+        for f in (tmp_path / "logs").iterdir():
+            logs += f.read_text()
+        assert "JAXDIST rank=0 nproc=2" in logs
+        assert "JAXDIST rank=1 nproc=2" in logs
+
+    def test_import_does_not_init_backend(self):
+        # the lazy global PRNG is what keeps multi-host init possible
+        code = ("import jax\n"
+                "orig = jax._src.xla_bridge.backends\n"
+                "hits = []\n"
+                "jax._src.xla_bridge.backends = "
+                "lambda *a, **k: (hits.append(1), orig(*a, **k))[1]\n"
+                "import paddle_tpu\n"
+                "assert not hits, 'import initialized the XLA backend'\n"
+                "print('IMPORT CLEAN')\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert p.returncode == 0, p.stderr[-500:]
+        assert "IMPORT CLEAN" in p.stdout
