@@ -45,7 +45,12 @@ BACKOFFS_S = (5, 10, 15, 20, 30, 45, 60, 60, 60)
 # tunnel death mid-sweep still leaves a machine-readable artifact (VERDICT
 # r3 weak 2: the r3 sweep survived only as prose in ROUND3_NOTES.md).
 SELF_BENCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_SELF_r05.json")
+                               "BENCH_SELF_r06.json")
+# previous round's artifact: its measured configs ride along as priors so
+# the _fail_line fallback never regresses to 0.0 just because the file
+# name rolled over
+LEGACY_SELF_BENCH_PATHS = (os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SELF_r05.json"),)
 
 
 # Candidate configs, one child subprocess each, best MFU reported. Measured
@@ -308,18 +313,19 @@ def _load_prior_configs():
     loaded doc's measured_at/git_head stamp (entries from prior_configs
     already carry their own), so provenance stays with the measurement it
     belongs to rather than with whichever run last rewrote the file."""
-    try:
-        with open(SELF_BENCH_PATH) as f:
-            doc = json.load(f)
-    except (OSError, ValueError):
-        return []
-    doc_stamp = {"measured_at": doc.get("measured_at", "unknown"),
-                 "git_head": doc.get("git_head", "unknown")}
     merged = {}
-    for c in doc.get("prior_configs", []) + doc.get("configs", []):
-        if c.get("mfu") and (c["name"] not in merged
-                             or c["mfu"] > merged[c["name"]]["mfu"]):
-            merged[c["name"]] = {**doc_stamp, **c}
+    for path in (SELF_BENCH_PATH,) + LEGACY_SELF_BENCH_PATHS:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        doc_stamp = {"measured_at": doc.get("measured_at", "unknown"),
+                     "git_head": doc.get("git_head", "unknown")}
+        for c in doc.get("prior_configs", []) + doc.get("configs", []):
+            if c.get("mfu") and (c["name"] not in merged
+                                 or c["mfu"] > merged[c["name"]]["mfu"]):
+                merged[c["name"]] = {**doc_stamp, **c}
     return sorted(merged.values(), key=lambda c: -c["mfu"])
 
 
@@ -332,14 +338,17 @@ def _flush_self_bench(results, extra=None, prior=None):
     # carry forward the single reserved hand-maintained key (historical
     # notes, e.g. the decode kernel's prior Mosaic rejection) that a
     # rebuilt doc would otherwise destroy; everything else in the doc is
-    # owned by this function and rebuilt fresh each flush
-    try:
-        with open(SELF_BENCH_PATH) as f:
-            old = json.load(f)
+    # owned by this function and rebuilt fresh each flush. The legacy
+    # (previous-round) artifact seeds it across the file-name rollover.
+    for path in (SELF_BENCH_PATH,) + LEGACY_SELF_BENCH_PATHS:
+        try:
+            with open(path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            continue
         if "record" in old:
             doc["record"] = old["record"]
-    except (OSError, ValueError):
-        pass
+            break
     # provenance stamp so a later _fail_line fallback can say WHEN the
     # numbers were measured rather than implying the current run took them
     doc["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -393,11 +402,17 @@ def _fail_line(reason):
     }))
 
 
-def _run(args, timeout):
-    """Run a python subprocess; return (rc, stdout) with rc=124 on timeout."""
+def _run(args, timeout, env=None):
+    """Run a python subprocess; return (rc, stdout) with rc=124 on timeout.
+    ``env`` entries override the inherited environment (e.g. forcing
+    JAX_PLATFORMS=cpu for legs that must not touch the flaky tunnel)."""
+    child_env = None
+    if env:
+        child_env = dict(os.environ)
+        child_env.update(env)
     try:
         p = subprocess.run([sys.executable] + args, timeout=timeout,
-                           capture_output=True, text=True,
+                           capture_output=True, text=True, env=child_env,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
         return p.returncode, p.stdout, p.stderr
     except subprocess.TimeoutExpired as e:
@@ -423,6 +438,20 @@ def _parse_result(rc, out):
 
 
 def watchdog():
+    me = os.path.abspath(__file__)
+    # Continuous-batching scheduling leg FIRST, on a CPU-forced child: it
+    # measures the serving engine's scheduling win (engine vs
+    # restart-per-batch on a staggered trace) which is platform-agnostic,
+    # and running it before the probe means even a dead tunnel leaves the
+    # decode_cb evidence in the artifact.
+    rc, out, err = _run([me, "--decode-cb"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    cb = _parse_result(rc, out)
+    cb_extra = {"decode_cb": cb if cb is not None else
+                {"ok": False, "rc": rc,
+                 "stderr_tail": err.strip()[-300:]}}
+    _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
+
     last_err = "unknown"
     for attempt, backoff in enumerate(BACKOFFS_S + (None,)):
         rc, out, err = _run(
@@ -442,7 +471,6 @@ def watchdog():
     # per-kernel Mosaic accept/reject before the sweep relies on them
     # (VERDICT r4 item 2). Statuses stream per line, so even a mid-smoke
     # tunnel death leaves the kernels that did compile on record.
-    me = os.path.abspath(__file__)
     rc, out, err = _run([me, "--smoke"], SMOKE_TIMEOUT_S)
     smoke = [s for s in (_parse_result(0, ln) for ln in out.splitlines())
              if s is not None]
@@ -452,7 +480,8 @@ def watchdog():
                               if rc == 124 else
                               f"rc={rc}; stderr tail: {err.strip()[-300:]}")})
     prior = _load_prior_configs()
-    _flush_self_bench([], extra={"pallas_smoke": smoke}, prior=prior)
+    _flush_self_bench([], extra={"pallas_smoke": smoke, **cb_extra},
+                      prior=prior)
 
     # one subprocess per config: a hang in one config costs only its own
     # timeout, and a successful measurement is never discarded
@@ -463,7 +492,8 @@ def watchdog():
             parsed = _parse_result(rc, out)
             if parsed is not None:
                 results.append(parsed)
-                _flush_self_bench(results, extra={"pallas_smoke": smoke},
+                _flush_self_bench(results,
+                                  extra={"pallas_smoke": smoke, **cb_extra},
                                   prior=prior)
                 break
             last_err = (f"config {name} attempt {attempt} rc={rc}"
@@ -493,7 +523,7 @@ def watchdog():
     rc, out, err = _run([me, "--trace", str(best_idx)], CONFIG_TIMEOUT_S)
     rt = _parse_result(rc, out)
     extra = {"best": best["name"], "layer7b": r7, "trace": rt,
-             "pallas_smoke": smoke}
+             "pallas_smoke": smoke, **cb_extra}
     _flush_self_bench(results, prior=prior, extra=extra)
 
     decode = ""
@@ -540,6 +570,13 @@ if __name__ == "__main__":
         sys.exit(main_smoke())
     if "--layer7b" in sys.argv:
         sys.exit(main_7b_layer())
+    if "--decode-cb" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_decode import measure_continuous_batching
+        print(json.dumps({"name": "decode_cb", "ok": True,
+                          **measure_continuous_batching(quick=True)}))
+        sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1
         attn = sys.argv[pos] if pos < len(sys.argv) else "pallas"

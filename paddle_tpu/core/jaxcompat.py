@@ -1,0 +1,47 @@
+"""jax version-compat shims, installed once at package import.
+
+The parallel stack is written against the promoted jax APIs
+(``jax.shard_map`` with ``axis_names``/``check_vma``,
+``jax.sharding.get_abstract_mesh``). Older jax (0.4.x) ships the same
+machinery under ``jax.experimental.shard_map`` with the pre-rename
+keywords (``auto``/``check_rep``) and has no abstract-mesh context
+accessor. Installing forward-looking aliases here keeps every call site
+on the modern spelling — when the container's jax catches up, the shims
+become no-ops.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def install():
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                      check_vma=True, **kw):
+            kwargs = dict(mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=bool(check_vma))
+            if axis_names is not None:
+                # modern API names the MANUAL axes; the legacy one names
+                # the complement (axes left to GSPMD) via `auto`. Do NOT
+                # forward partial-manual programs to legacy jax: its
+                # partitioner CHECK-aborts the whole process on them
+                # (observed: ring attention under 0.4.x) — a clean raise
+                # keeps one bad program from killing the test run
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+                if auto:
+                    raise NotImplementedError(
+                        "partial-manual shard_map (manual axes "
+                        f"{sorted(axis_names)} of {sorted(mesh.axis_names)})"
+                        " is not supported by this jax version's "
+                        "partitioner; upgrade jax for context/sequence/"
+                        "pipeline parallelism")
+            return _sm(f, **kwargs, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        # no abstract-mesh tracking on old jax: report "no context mesh"
+        # and let callers fall back to the concrete mesh
+        jax.sharding.get_abstract_mesh = lambda: None

@@ -42,8 +42,9 @@ class _GlobalGenerator:
             self._key = jax.random.PRNGKey(self._seed)
 
     def manual_seed(self, seed: int):
-        self._key = jax.random.PRNGKey(seed)
-        self._seed = int(seed)
+        with self._lock:  # a concurrent next_key must not split a stale key
+            self._key = jax.random.PRNGKey(seed)
+            self._seed = int(seed)
 
     def next_key(self):
         with self._lock:
@@ -57,7 +58,8 @@ class _GlobalGenerator:
             return self._key
 
     def set_state(self, key):
-        self._key = key
+        with self._lock:
+            self._key = key
 
 
 _GENERATOR = _GlobalGenerator(0)

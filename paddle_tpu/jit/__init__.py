@@ -516,6 +516,15 @@ class TranslatedLayer:
     forward = __call__
 
     def state_dict(self):
+        if self._state is None:
+            raise FileNotFoundError(
+                "TranslatedLayer.state_dict(): this artifact was loaded "
+                "from a .pdmodel with no .pdparams sidecar (the exported "
+                "program is self-contained — weights are baked in as "
+                "constants, so inference works without it). To get a state "
+                "dict for inspection or finetune hand-off, re-save with "
+                "jit.save(layer, path) so the .pdparams sidecar is written "
+                "next to the .pdmodel")
         return self._state
 
     def eval(self):

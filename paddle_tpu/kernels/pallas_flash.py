@@ -23,9 +23,12 @@ NEG_INF = -1e30
 
 
 def _cparams(dims):
+    # the class was renamed TPUCompilerParams -> CompilerParams across
+    # jax releases; missing name raises AttributeError, wrong kwargs
+    # TypeError — tolerate both and fall back to compiler defaults
     try:
         return pltpu.CompilerParams(dimension_semantics=dims)
-    except TypeError:
+    except (AttributeError, TypeError):
         try:
             return pltpu.TPUCompilerParams(dimension_semantics=dims)
         except Exception:

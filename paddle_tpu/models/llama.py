@@ -21,7 +21,6 @@ TPU-native design, not a port:
 """
 from __future__ import annotations
 
-import functools
 import math
 from dataclasses import dataclass, field
 
@@ -490,132 +489,34 @@ class LlamaPretrainCriterion(nn.Layer):
 
 
 # ----------------------------------------------------------------- generate
-def _llama_generate_fn(ids, max_new, s_max, nh, nkv, hd, eps, theta, tied,
-                       temperature, top_k, key, *, embed, wq, wk, wv, wo,
-                       w_gate, w_up, w_down, input_ln, post_ln, final_norm,
-                       lm_head, decode_attn="pallas"):
-    """Jitted prefill + decode (reference: the generation loop over
-    ``fused_multi_transformer`` with in-place KV cache, SURVEY §3.5 —
-    here the cache is a functional scan carry updated with
-    dynamic_update_slice, one compiled program for all steps).
-
-    Greedy when temperature == 0, else top-k temperature sampling.
-    """
-    B, S = ids.shape
-    L = wq.shape[0]
-    sin, cos = _rope_tables(s_max, hd, theta)
-    stack = (wq, wk, wv, wo, w_gate, w_up, w_down, input_ln, post_ln)
-    head = lm_head.T if tied else lm_head
-
-    def qkv_proj(hn, lwq, lwk, lwv, Bh, Sh):
-        return _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
-
-    def ffn(h, lpost, lg, lu, ld):
-        return h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
-
-    # ---------------- prefill: full-prompt pass, collect per-layer k/v
-    def prefill_layer(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
-        hn = _rms(h, lin, eps)
-        q, k, v = qkv_proj(hn, lwq, lwk, lwv, B, S)
-        q = _apply_rope(q, sin[:S], cos[:S])
-        k = _apply_rope(k, sin[:S], cos[:S])
-        attn = _attention(q, k, v, causal=True)
-        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(B, S, nh * hd), lwo)
-        h = ffn(h, lpost, lg, lu, ld)
-        return h, (k, v)
-
-    x = jnp.take(embed, ids, axis=0)
-    x, (pk, pv) = jax.lax.scan(prefill_layer, x, stack)
-    cache_k = jnp.zeros((L, B, s_max, nkv, hd), x.dtype)
-    cache_v = jnp.zeros((L, B, s_max, nkv, hd), x.dtype)
-    cache_k = jax.lax.dynamic_update_slice(cache_k, pk, (0, 0, 0, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, pv, (0, 0, 0, 0, 0))
-
-    def sample(logits, k):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(ids.dtype)
-        lg = logits / temperature
-        if top_k > 0:
-            k_eff = min(top_k, lg.shape[-1])  # HF/paddle convention: clamp
-            kth = jnp.sort(lg, axis=-1)[..., -k_eff][..., None]
-            lg = jnp.where(lg < kth, -1e30, lg)
-        return jax.random.categorical(k, lg, axis=-1).astype(ids.dtype)
-
-    last_h = _rms(x[:, -1], final_norm, eps)
-    first_logits = jnp.einsum("bh,hv->bv", last_h, head)
-    key, sk = jax.random.split(key)
-    tok0 = sample(first_logits, sk)
-
-    # ---------------- decode: one token per tick, cache in the carry
-    def decode_layer(carry, lp_cache):
-        h, pos = carry
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost), ck, cv = lp_cache
-        hn = _rms(h, lin, eps)
-        q, k, v = qkv_proj(hn, lwq, lwk, lwv, B, 1)
-        sin_p = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 0)
-        cos_p = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 0)
-        q = _apply_rope(q, sin_p, cos_p)
-        k = _apply_rope(k, sin_p, cos_p)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        if decode_attn == "pallas":
-            # ragged single-query kernel: GQA resolved in-kernel (no
-            # G×-repeated cache read) and kv blocks past pos+1 skipped
-            from ..kernels.pallas_decode import decode_attention_pallas
-            lens = jnp.full((B,), pos + 1, jnp.int32)
-            attn = decode_attention_pallas(q[:, 0], ck, cv, lens)[:, None]
-        else:
-            kr, vr = ck, cv
-            if nkv != nh:
-                kr = jnp.repeat(kr, nh // nkv, axis=2)
-                vr = jnp.repeat(vr, nh // nkv, axis=2)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
-                                preferred_element_type=jnp.float32) \
-                / jnp.sqrt(jnp.float32(hd))
-            valid = jnp.arange(s_max)[None, None, None, :] <= pos
-            logits = jnp.where(valid, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
-        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(B, 1, nh * hd), lwo)
-        h = ffn(h, lpost, lg, lu, ld)
-        return (h, pos), (ck, cv)
-
-    def step(carry, _):
-        tok, ck_all, cv_all, pos, k = carry
-        x = jnp.take(embed, tok[:, None], axis=0)
-
-        def layer_wrap(hp, xs):
-            lp = xs[:9]
-            ck, cv = xs[9], xs[10]
-            (h, p), (nck, ncv) = decode_layer(hp, (lp, ck, cv))
-            return (h, p), (nck, ncv)
-
-        (x, _), (nck, ncv) = jax.lax.scan(
-            layer_wrap, (x, pos), stack + (ck_all, cv_all))
-        last = _rms(x[:, -1], final_norm, eps)
-        logits = jnp.einsum("bh,hv->bv", last, head)
-        k, sk = jax.random.split(k)
-        nxt = sample(logits, sk)
-        return (nxt, nck, ncv, pos + 1, k), tok
-
-    (_, _, _, _, _), toks = jax.lax.scan(
-        step, (tok0, cache_k, cache_v, jnp.int32(S), key),
-        None, length=max_new)
-    return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
-
-
 def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-         top_k=0, max_cache_len=None, seed=None):
-    """Autoregressive generation with a jit-compiled KV-cache decode
-    loop (greedy by default; temperature>0 enables top-k sampling)."""
+             top_k=0, max_cache_len=None, seed=None, eos_token_id=None):
+    """Autoregressive generation over the continuous-batching decode
+    engine (``serving/engine.py``): a jitted per-prompt prefill feeds a
+    slot KV cache, then one compiled single-token decode program —
+    shapes depend only on ``(batch, cache_len)``, sampling knobs are
+    runtime arrays — ticks all rows together. Greedy by default;
+    ``temperature>0`` enables top-k sampling; ``eos_token_id`` stops a
+    row early (its tail is padded with the EOS id).
+
+    The decode/prefill executables live on the model (``_serving_jit``)
+    and are reused per cache shape: sampling-knob changes (temperature /
+    top_k / seed) never retrace; max_new_tokens changes retrace only
+    when they change the cache length — pin ``max_cache_len`` (or rely
+    on the ``max_position_embeddings`` clamp) to make every call share
+    one set of executables.
+    """
+    import numpy as np
+
     from ..core import random as _random_mod
     from ..core.tensor import Tensor as _T
+    from ..serving import ContinuousBatchingEngine, GenerationRequest
 
     c = self.config
     ids = input_ids.value if isinstance(input_ids, _T) else \
         jnp.asarray(input_ids)
-    B, S = ids.shape
+    ids_np = np.asarray(ids)
+    B, S = ids_np.shape
     s_max = int(max_cache_len or min(c.max_position_embeddings,
                                      S + max_new_tokens))
     if S + int(max_new_tokens) > s_max:
@@ -623,35 +524,27 @@ def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
             f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"the KV cache length ({s_max}); raise max_cache_len / "
             f"max_position_embeddings or generate fewer tokens")
-    key = (jax.random.PRNGKey(seed) if seed is not None
-           else _random_mod.next_key())
-    params = dict(
-        embed=self.embed_tokens.value, wq=self.wq.value,
-        wk=self.wk.value, wv=self.wv.value, wo=self.wo.value,
-        w_gate=self.w_gate.value, w_up=self.w_up.value,
-        w_down=self.w_down.value, input_ln=self.input_ln.value,
-        post_ln=self.post_ln.value, final_norm=self.final_norm.value,
-        lm_head=(self.embed_tokens.value if self.lm_head is None
-                 else self.lm_head.value))
-    if c.decode_attention not in ("pallas", "jnp"):
-        raise ValueError(
-            f"decode_attention must be 'pallas' or 'jnp', got "
-            f"{c.decode_attention!r}")
-    cache_key = (int(max_new_tokens), s_max, float(temperature),
-                 int(top_k), c.decode_attention)
-    jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-    fn = jit_cache.get(cache_key)
-    if fn is None:
-        fn = jax.jit(functools.partial(
-            _llama_generate_fn, max_new=int(max_new_tokens), s_max=s_max,
-            nh=c.num_attention_heads, nkv=c.num_key_value_heads,
-            hd=c.head_dim, eps=float(c.rms_norm_eps),
-            theta=float(c.rope_theta), tied=self.lm_head is None,
-            temperature=float(temperature), top_k=int(top_k),
-            decode_attn=c.decode_attention))
-        jit_cache[cache_key] = fn
-    out = fn(ids, key=key, **params)
-    return _T(out)
+    base_key = (jax.random.PRNGKey(seed) if seed is not None
+                else _random_mod.next_key())
+    engine = ContinuousBatchingEngine(
+        self, num_slots=B, max_seq_len=s_max,
+        # exact-length prefill: same-shape prompts compile one program,
+        # exactly like the pre-engine monolith did. chunk=16 bounds the
+        # host round-trips of this offline all-at-once case (no queue to
+        # starve) — floor(m/16)+m%16 dispatches for m decode steps
+        prefill_bucketing="exact", decode_chunk=16,
+        jit_cache=self.__dict__.setdefault("_serving_jit", {}))
+    reqs = [GenerationRequest(
+        prompt=ids_np[i], max_new_tokens=int(max_new_tokens),
+        temperature=float(temperature), top_k=int(top_k),
+        eos_token_id=eos_token_id,
+        prng_key=jax.random.fold_in(base_key, i)) for i in range(B)]
+    outs = engine.generate(reqs)
+    pad = int(eos_token_id) if eos_token_id is not None else 0
+    out = np.stack([
+        np.pad(o, (0, int(max_new_tokens) - len(o)), constant_values=pad)
+        for o in outs])
+    return _T(jnp.asarray(out.astype(ids_np.dtype)))
 
 
 LlamaForCausalLM.generate = generate

@@ -918,6 +918,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         # reference semantics: area = adaptive average pooling over the
         # target grid (NOT a linear resize)
         from ..ops import transpose as _tr
+        if len(size) != len(spatial):
+            # same rank-vs-size contract as _spatial_axes on the other
+            # resize paths; the pool lookup below would KeyError (or pool
+            # the wrong dims) instead of naming the mismatch
+            raise ValueError(
+                f"interpolate: size has {len(size)} element(s) but the "
+                f"input has {len(spatial)} spatial dim(s) for data_format "
+                f"{data_format!r}")
         nd = len(size)
         pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
                 3: adaptive_avg_pool3d}[nd]

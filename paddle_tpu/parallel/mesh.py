@@ -43,15 +43,21 @@ def build_mesh(axis_degrees: Dict[str, int], devices=None) -> Mesh:
     # Auto axis types = GSPMD propagation from annotations (jax>=0.9 defaults
     # make_mesh to Explicit sharding-in-types, which type-checks eager dots —
     # not what the paddle-shaped annotate-and-let-XLA-partition model wants).
-    from jax.sharding import AxisType
-    auto = (AxisType.Auto,) * len(names)
+    # Older jax (< 0.5) predates AxisType entirely — everything is Auto
+    # there, so the plain Mesh constructor is the same semantics.
     try:
-        mesh = jax.make_mesh(tuple(degrees), tuple(names), devices=devices,
-                             axis_types=auto)
-    except TypeError:
-        arr = np.asarray(devices).reshape(degrees)
-        mesh = Mesh(arr, tuple(names))
-    return mesh
+        from jax.sharding import AxisType
+    except ImportError:
+        AxisType = None
+    if AxisType is not None:
+        auto = (AxisType.Auto,) * len(names)
+        try:
+            return jax.make_mesh(tuple(degrees), tuple(names),
+                                 devices=devices, axis_types=auto)
+        except TypeError:
+            pass
+    arr = np.asarray(devices).reshape(degrees)
+    return Mesh(arr, tuple(names))
 
 
 def set_mesh(mesh: Mesh):

@@ -1,0 +1,26 @@
+"""Continuous-batching serving subsystem (L7, SURVEY §3.5 / PAPERS.md).
+
+Orca-style iteration-level scheduling on top of a slot-based paged KV
+cache: one compiled single-token ``decode_step_fn`` whose shapes depend
+only on ``(num_slots, max_seq_len)`` serves every request mix; requests
+are admitted into free cache slots mid-flight, and a slot is freed the
+moment its sequence hits EOS or its token budget — the ragged Pallas
+decode kernel (``kernels/pallas_decode.py``) already skips KV blocks past
+``lengths[b]``, so a freed slot's stale cache costs no HBM traffic.
+
+Public surface:
+
+- :class:`GenerationRequest` / :class:`Sequence` — request & in-flight state
+- :class:`SlotKVCache` — the paged per-slot KV cache manager
+- :class:`FIFOScheduler` — admission + fused-chunk step policy
+- :class:`ContinuousBatchingEngine` — the step-function serving API
+"""
+from .engine import ContinuousBatchingEngine
+from .kv_cache import SlotKVCache
+from .request import GenerationRequest, Sequence
+from .scheduler import FIFOScheduler
+
+__all__ = [
+    "ContinuousBatchingEngine", "GenerationRequest", "Sequence",
+    "SlotKVCache", "FIFOScheduler",
+]

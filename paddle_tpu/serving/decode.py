@@ -1,0 +1,211 @@
+"""Jitted prefill / decode-step functions for the LLaMA decode path.
+
+This is the split of the old monolithic ``_llama_generate_fn``
+(models/llama.py) into the two programs a continuous-batching engine
+needs:
+
+- ``prefill`` — one prompt's full forward, returning its per-layer K/V
+  (to be installed into a cache slot), the first sampled token, and the
+  advanced PRNG key. Prompt lengths are padded to buckets by the engine,
+  so compilations are bounded by the bucket count, not the prompt count.
+- ``decode_steps`` — ``n_steps`` single-token ticks over ALL slots in
+  one device call. Shapes depend only on ``(num_slots, max_seq_len)``:
+  per-slot sampling knobs (temperature / top-k / PRNG key) and per-slot
+  ragged ``lengths`` are runtime ARRAYS, not trace constants, so one
+  compilation serves every request mix — the old path recompiled per
+  ``(max_new_tokens, temperature, top_k)`` tuple.
+
+Per-row raggedness: each slot writes its new K/V at its own
+``lengths[b]`` (scatter) and attends over ``lengths[b]+1`` entries —
+through the ragged Pallas kernel (``decode_attention_pallas``) or the
+jnp oracle with identical semantics. Rows of freed/empty slots compute
+garbage that is never read (their scatter lands in row 0 of a dead slot
+and the engine never surfaces their sampled tokens).
+
+Sampling is row-vectorized: greedy where ``temps <= 0``, else top-k
+temperature sampling with a per-row ``jax.random.categorical`` under a
+per-row key; keys advance by the same split-per-token walk the old path
+used, so a request's token stream depends only on its own key — not on
+batch composition, admission timing, or the other slots (the property
+the mid-flight-admission tests pin down).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..kernels.flash_attention import attention as _attention
+from ..kernels.pallas_decode import (decode_attention_pallas,
+                                     decode_attention_reference)
+from ..models.llama import _apply_rope, _qkv_bshd, _rms, _rope_tables, \
+    _swiglu_raw
+
+NEG_INF = -1e30
+
+_STACK_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "input_ln", "post_ln")
+
+
+def llama_decode_params(model):
+    """Raw-array param pytree (+tied flag) for the decode programs."""
+    p = dict(
+        embed=model.embed_tokens.value, wq=model.wq.value,
+        wk=model.wk.value, wv=model.wv.value, wo=model.wo.value,
+        w_gate=model.w_gate.value, w_up=model.w_up.value,
+        w_down=model.w_down.value, input_ln=model.input_ln.value,
+        post_ln=model.post_ln.value, final_norm=model.final_norm.value,
+        lm_head=(model.embed_tokens.value if model.lm_head is None
+                 else model.lm_head.value))
+    return p, model.lm_head is None
+
+
+def _apply_rope_rows(x, sin_p, cos_p):
+    """Rope with a DIFFERENT position per batch row (ragged decode).
+
+    x: [B, 1, H, D]; sin_p/cos_p: [B, D] gathered at each row's position.
+    """
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos_p[:, None, None, :]
+            + rotated * sin_p[:, None, None, :]).astype(x.dtype)
+
+
+def sample_rows(logits, keys, temps, top_ks):
+    """Per-row sampling: greedy where temps<=0, else top-k temperature.
+
+    logits: [B, V]; keys: [B, 2] uint32; temps: [B] f32; top_ks: [B] i32
+    (<=0 = no top-k filter). All knobs are runtime values — no retrace.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        lg = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+        k_eff = jnp.clip(jnp.where(top_ks <= 0, V, top_ks), 1, V)
+        srt = jnp.sort(lg, axis=-1)  # ascending; kth-largest = srt[V - k]
+        kth = jnp.take_along_axis(srt, (V - k_eff)[:, None], axis=-1)
+        lg = jnp.where(lg < kth, NEG_INF, lg)
+        sampled = jax.vmap(jax.random.categorical)(keys, lg)
+        return jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
+
+    # all-greedy batches (the model.generate default) must not pay the
+    # [B, V] sort + categorical every tick just to discard the result
+    return jax.lax.cond(jnp.any(temps > 0.0), _sampled,
+                        lambda _: greedy, None)
+
+
+# ------------------------------------------------------------------ prefill
+def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
+                  hd, eps, theta, tied):
+    """Batched prefill: ids [G, S_pad] (right-padded prompts), lengths
+    [G] real token counts, per-row keys/temps/top_ks.
+
+    Returns (pk, pv, tok0, keys') with pk/pv: [L, G, S_pad, Hkv, D] —
+    one admission group in one device call (the engine pads G to a power
+    of two so the compile count stays bounded). Padding rows/columns
+    produce K/V garbage past each row's ``lengths`` — causal masking
+    keeps it out of every real position's attention, and the cache slot
+    masks it by ``lengths`` until decode overwrites it.
+    """
+    B, S = ids.shape
+    sin, cos = _rope_tables(S, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    def prefill_layer(h, lp):
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope(q, sin, cos)
+        k = _apply_rope(k, sin, cos)
+        attn = _attention(q, k, v, causal=True)
+        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(B, S, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (k, v)
+
+    x = jnp.take(params["embed"], ids, axis=0)
+    x, (pk, pv) = jax.lax.scan(prefill_layer, x, stack)
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [G, H]
+    last_h = _rms(last, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    both = jax.vmap(jax.random.split)(keys)  # [G, 2, 2]
+    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
+    return pk, pv, tok0, both[:, 0]
+
+
+def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied):
+    """One jitted prefill; jax retraces per (group, prompt-bucket)
+    shape — both padded to powers of two by the engine."""
+    return jax.jit(functools.partial(
+        _prefill_impl, nh=nh, nkv=nkv, hd=hd, eps=eps, theta=theta,
+        tied=tied))
+
+
+# -------------------------------------------------------------- decode step
+def _decode_steps_impl(params, cache_k, cache_v, tokens, lengths, keys,
+                       temps, top_ks, *, n_steps, nh, nkv, hd, eps, theta,
+                       tied, decode_attn):
+    """``n_steps`` fused single-token decode ticks over all slots.
+
+    tokens:  [B] int32 — each slot's last sampled token
+    lengths: [B] int32 — valid cache rows per slot (ragged)
+    keys:    [B, 2] uint32; temps: [B] f32; top_ks: [B] int32
+
+    Returns (toks [n_steps, B], cache_k', cache_v', keys').
+    """
+    B = tokens.shape[0]
+    s_max = cache_k.shape[2]
+    sin, cos = _rope_tables(s_max, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    def one_step(carry, _):
+        tok, ck_all, cv_all, lens, kys = carry
+        x = jnp.take(params["embed"], tok[:, None], axis=0)  # [B,1,H]
+        sin_p = jnp.take(sin, lens, axis=0)  # [B, D] per-row position
+        cos_p = jnp.take(cos, lens, axis=0)
+
+        def layer(h, xs):
+            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv = xs
+            hn = _rms(h, lin, eps)
+            q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+            q = _apply_rope_rows(q, sin_p, cos_p)
+            k = _apply_rope_rows(k, sin_p, cos_p)
+            # ragged scatter: each row appends at its own position
+            ck = ck.at[jnp.arange(B), lens].set(k[:, 0])
+            cv = cv.at[jnp.arange(B), lens].set(v[:, 0])
+            if decode_attn == "pallas":
+                attn = decode_attention_pallas(q[:, 0], ck, cv, lens + 1)
+            else:
+                attn = decode_attention_reference(q[:, 0], ck, cv, lens + 1)
+            h = h + jnp.einsum("bsd,dh->bsh",
+                               attn.reshape(B, 1, nh * hd), lwo)
+            h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+            return h, (ck, cv)
+
+        x, (nck, ncv) = jax.lax.scan(layer, x, stack + (ck_all, cv_all))
+        last = _rms(x[:, 0], params["final_norm"], eps)
+        logits = jnp.einsum("bh,hv->bv", last, head)
+        both = jax.vmap(jax.random.split)(kys)  # [B, 2, 2]
+        nxt = sample_rows(logits, both[:, 1], temps, top_ks)
+        return (nxt, nck, ncv, lens + 1, both[:, 0]), nxt
+
+    carry0 = (tokens, cache_k, cache_v, lengths, keys)
+    (_, ck, cv, _, kf), toks = jax.lax.scan(one_step, carry0, None,
+                                            length=n_steps)
+    return toks, ck, cv, kf
+
+
+def build_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
+                          decode_attn, donate=None):
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(
+            _decode_steps_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+        donate_argnums=(1, 2) if donate else ())

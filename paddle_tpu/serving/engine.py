@@ -1,0 +1,259 @@
+"""Continuous-batching decode engine (the Orca/vLLM serving loop on the
+TPU decode path, SURVEY §3.5 / PAPERS.md).
+
+One engine owns ``num_slots`` KV-cache slots and drives a step function:
+each :meth:`step` (1) admits queued requests into free slots — one
+bucketed prefill each — then (2) runs one fused device call of
+``n`` single-token decode ticks over ALL slots, then (3) retires
+sequences that hit EOS or their token budget, freeing their slots for
+the next admission. Requests join and leave the batch between any two
+steps, so short requests never wait for long ones and the batch never
+restarts.
+
+Compile discipline (the perf contract): the decode program's shapes
+depend only on ``(num_slots, max_seq_len)``; per-request sampling knobs
+and per-slot ragged lengths are runtime arrays. One compilation serves
+every request mix — :meth:`decode_compilations` counts traces so tests
+can pin this. Prefill compiles once per prompt-length bucket.
+
+Offline use::
+
+    engine = ContinuousBatchingEngine(model, num_slots=8)
+    outs = engine.generate([GenerationRequest(prompt=ids, ...), ...])
+
+Online use: call :meth:`submit` at arrival time and :meth:`step` in a
+loop; finished sequences come back from the step that retired them.
+``model.generate()`` is a thin offline wrapper over this engine.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import build_decode_steps_fn, build_prefill_fn, \
+    llama_decode_params
+from .kv_cache import SlotKVCache
+from .request import GenerationRequest, Sequence
+from .scheduler import FIFOScheduler
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a LLaMA-family model."""
+
+    def __init__(self, model, num_slots=8, max_seq_len=None, decode_chunk=8,
+                 prefill_bucketing="pow2", jit_cache=None):
+        c = model.config
+        if c.decode_attention not in ("pallas", "jnp"):
+            raise ValueError(
+                f"decode_attention must be 'pallas' or 'jnp', got "
+                f"{c.decode_attention!r}")
+        if prefill_bucketing not in ("pow2", "exact"):
+            raise ValueError(
+                f"prefill_bucketing must be 'pow2' or 'exact', got "
+                f"{prefill_bucketing!r}")
+        self.model = model
+        self.config = c
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len or c.max_position_embeddings)
+        self._bucketing = prefill_bucketing
+        self._params, self._tied = llama_decode_params(model)
+        self.cache = SlotKVCache(
+            c.num_hidden_layers, self.num_slots, self.max_seq_len,
+            c.num_key_value_heads, c.head_dim,
+            dtype=self._params["embed"].dtype)
+        self.scheduler = FIFOScheduler(decode_chunk)
+        self._slots = [None] * self.num_slots
+        self._last_tok = np.zeros(self.num_slots, np.int32)
+        self._temps = np.zeros(self.num_slots, np.float32)
+        self._topks = np.zeros(self.num_slots, np.int32)
+        self._keys = jnp.zeros((self.num_slots, 2), jnp.uint32)
+        # jitted programs, shareable across engines of the same model so
+        # a fresh engine never re-traces (model.generate passes the
+        # model-level dict)
+        self._jit = jit_cache if jit_cache is not None else {}
+        self.stats = {"steps": 0, "decode_calls": 0, "decode_steps": 0,
+                      "slot_steps": 0, "active_slot_steps": 0,
+                      "prefills": 0, "prefill_tokens": 0,
+                      "tokens_generated": 0}
+
+    # ------------------------------------------------------------ programs
+    def _fn_consts(self):
+        c = self.config
+        return dict(nh=c.num_attention_heads, nkv=c.num_key_value_heads,
+                    hd=c.head_dim, eps=float(c.rms_norm_eps),
+                    theta=float(c.rope_theta), tied=self._tied)
+
+    def _prefill_fn(self):
+        key = ("prefill",)
+        if key not in self._jit:
+            self._jit[key] = build_prefill_fn(**self._fn_consts())
+        return self._jit[key]
+
+    def _decode_fn(self, n_steps):
+        key = ("decode", int(n_steps), self.config.decode_attention)
+        if key not in self._jit:
+            self._jit[key] = build_decode_steps_fn(
+                n_steps=int(n_steps),
+                decode_attn=self.config.decode_attention,
+                **self._fn_consts())
+        return self._jit[key]
+
+    def decode_compilations(self) -> int:
+        """Total decode-program traces (the compiles-once assertion hook):
+        stays at one per ``(num_slots, max_seq_len, n_steps)`` no matter
+        how request sampling params / token budgets vary."""
+        return sum(fn._cache_size() for key, fn in self._jit.items()
+                   if key[0] == "decode")
+
+    # ------------------------------------------------------------- intake
+    def _key_for(self, request):
+        if request.prng_key is not None:
+            return jnp.asarray(request.prng_key)
+        if request.seed is not None:
+            return jax.random.PRNGKey(int(request.seed))
+        from ..core import random as random_mod
+        return random_mod.next_key()
+
+    def submit(self, request) -> Sequence:
+        """Queue a request; returns its live Sequence handle."""
+        if not isinstance(request, GenerationRequest):
+            raise TypeError(
+                f"submit() takes a GenerationRequest, got "
+                f"{type(request).__name__}")
+        seq = Sequence(request, key=self._key_for(request),
+                       submit_step=self.stats["steps"])
+        if seq.prompt_len < 1:
+            raise ValueError("empty prompt")
+        if int(request.max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
+        if seq.prompt_len + int(request.max_new_tokens) > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({seq.prompt_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the KV cache length "
+                f"({self.max_seq_len}); raise max_seq_len or generate "
+                f"fewer tokens")
+        self.scheduler.submit(seq)
+        return seq
+
+    # ------------------------------------------------------------ stepping
+    def _bucket(self, plen):
+        if self._bucketing == "exact":
+            return plen
+        return min(max(8, 1 << (plen - 1).bit_length()), self.max_seq_len)
+
+    def _admit_group(self, seqs, finished):
+        """Admit a batch of sequences: ONE prefill device call per
+        prompt-length bucket, with the group dim padded to a power of
+        two so compile count stays bounded at
+        O(log(num_slots) × buckets)."""
+        by_bucket = {}
+        for seq in seqs:
+            by_bucket.setdefault(self._bucket(seq.prompt_len), []).append(seq)
+        for s_pad, group in sorted(by_bucket.items()):
+            G = len(group)
+            Gp = 1 << (G - 1).bit_length()
+            ids = np.zeros((Gp, s_pad), np.int32)
+            lens = np.ones(Gp, np.int32)  # pad rows: 1 valid token
+            temps = np.zeros(Gp, np.float32)
+            topks = np.zeros(Gp, np.int32)
+            keys = np.zeros((Gp, 2), np.uint32)
+            for i, seq in enumerate(group):
+                ids[i, :seq.prompt_len] = seq.prompt
+                lens[i] = seq.prompt_len
+                temps[i] = float(seq.request.temperature)
+                topks[i] = int(seq.request.top_k)
+                keys[i] = np.asarray(seq.key)
+            pk, pv, tok0s, keys2 = self._prefill_fn()(
+                self._params, jnp.asarray(ids), lens, jnp.asarray(keys),
+                temps, topks)
+            tok0s = np.asarray(tok0s)
+            for i, seq in enumerate(group):
+                req = seq.request
+                slot = self.cache.alloc()
+                self.cache.write_prefill(slot, pk[:, i], pv[:, i],
+                                         seq.prompt_len)
+                seq.slot = slot
+                seq.status = "running"
+                seq.tokens = [int(tok0s[i])]
+                self._slots[slot] = seq
+                self._last_tok[slot] = seq.tokens[0]
+                self._temps[slot] = float(req.temperature)
+                self._topks[slot] = int(req.top_k)
+                self._keys = self._keys.at[slot].set(keys2[i])
+                self.stats["prefills"] += 1
+                self.stats["prefill_tokens"] += seq.prompt_len
+                self.stats["tokens_generated"] += 1
+                self._maybe_finish(seq, finished)
+
+    def _maybe_finish(self, seq, finished):
+        req = seq.request
+        t = seq.tokens[-1]
+        if req.eos_token_id is not None and t == int(req.eos_token_id):
+            self._finish(seq, "eos", finished)
+        elif len(seq.tokens) >= int(req.max_new_tokens):
+            self._finish(seq, "length", finished)
+
+    def _finish(self, seq, reason, finished):
+        slot = seq.slot
+        seq.status = "finished"
+        seq.finish_reason = reason
+        self._slots[slot] = None
+        # reset the slot's knobs: a stale temperature would keep the
+        # sampler's all-greedy fast path (decode.sample_rows) disabled
+        # for every later greedy-only batch
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._last_tok[slot] = 0
+        self.cache.free(slot)
+        finished.append(seq)
+
+    def step(self):
+        """Admit + one fused decode call + retire. Returns the sequences
+        finished by this step (possibly empty)."""
+        finished = []
+        admitted = self.scheduler.admissions(self.cache.num_free)
+        if admitted:
+            self._admit_group(admitted, finished)
+        active = [s for s in self._slots if s is not None]
+        if active:
+            n = self.scheduler.choose_num_steps(active)
+            toks, nk, nv, keys = self._decode_fn(n)(
+                self._params, self.cache.k, self.cache.v,
+                jnp.asarray(self._last_tok), jnp.asarray(self.cache.lengths),
+                self._keys, jnp.asarray(self._temps),
+                jnp.asarray(self._topks))
+            self.cache.update(nk, nv)
+            self._keys = keys
+            toks_np = np.asarray(toks)  # [n, num_slots]
+            self.stats["decode_calls"] += 1
+            self.stats["decode_steps"] += n
+            self.stats["slot_steps"] += n * self.num_slots
+            for i in range(n):
+                for slot in range(self.num_slots):
+                    seq = self._slots[slot]
+                    if seq is None:
+                        continue  # freed slot (or finished mid-chunk)
+                    t = int(toks_np[i, slot])
+                    seq.tokens.append(t)
+                    self.cache.lengths[slot] += 1
+                    self._last_tok[slot] = t
+                    self.stats["active_slot_steps"] += 1
+                    self.stats["tokens_generated"] += 1
+                    self._maybe_finish(seq, finished)
+        self.stats["steps"] += 1
+        return finished
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.num_queued
+                    or any(s is not None for s in self._slots))
+
+    # ------------------------------------------------------------- offline
+    def generate(self, requests):
+        """Submit all, run to completion, return each request's generated
+        ids (np.int32, EOS included when hit) in submission order."""
+        seqs = [self.submit(r) for r in requests]
+        while self.has_work():
+            self.step()
+        return [s.output_ids() for s in seqs]

@@ -1,0 +1,93 @@
+"""Slot-based paged KV cache for continuous-batching decode.
+
+The cache is two dense arrays ``[L, num_slots, max_seq_len, Hkv, D]``
+(the paddle cache layout the ragged Pallas decode kernel reads in place,
+``kernels/pallas_decode.py``) plus a host-side ``lengths[num_slots]``
+mirror and a free-slot list. "Paged" here is at slot granularity — the
+TPU-friendly degenerate page size of one sequence per page: admission
+claims a free slot, finish releases it, and the freed slot's stale rows
+are never touched again (the ragged kernel skips KV blocks past
+``lengths[b]``, so garbage costs no HBM traffic and no zeroing pass).
+
+The device arrays are functionally updated (donated through the jitted
+writers on non-CPU backends, so XLA updates in place); the host mirror is
+the scheduling truth — device-side lengths are always re-fed from it, so
+a freed slot resets by writing one host int, not a device op.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _write_prefill(cache_k, cache_v, pk, pv, slot):
+    # pk/pv: [L, S_pad, Hkv, D] -> one slot's leading rows. Rows past the
+    # real prompt length hold prefill padding garbage; they sit beyond
+    # lengths[slot] (masked) until the decode loop overwrites them.
+    ck = jax.lax.dynamic_update_slice(cache_k, pk[:, None], (0, slot, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cache_v, pv[:, None], (0, slot, 0, 0, 0))
+    return ck, cv
+
+
+@functools.lru_cache(maxsize=None)
+def _writer(donate):
+    # module-level so every cache instance (one per engine, one engine
+    # per model.generate call) shares the jitted program instead of
+    # re-tracing it
+    return jax.jit(_write_prefill, donate_argnums=(0, 1) if donate else ())
+
+
+class SlotKVCache:
+    """KV-cache manager: device arrays + slot allocator + lengths mirror."""
+
+    def __init__(self, num_layers, num_slots, max_seq_len, num_kv_heads,
+                 head_dim, dtype=jnp.float32, donate=None):
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        shape = (num_layers, num_slots, max_seq_len, num_kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # host mirror is the source of truth; device lengths are re-fed
+        # from it every step
+        self.lengths = np.zeros(num_slots, np.int32)
+        self._free = list(range(num_slots))
+        if donate is None:
+            # donation is a no-op (warning) on CPU; an in-place cache
+            # update is the whole point everywhere else
+            donate = jax.default_backend() != "cpu"
+        self._write = _writer(bool(donate))
+
+    # ------------------------------------------------------------- slots
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self):
+        """Claim a free slot (lowest index first, deterministic)."""
+        if not self._free:
+            return None
+        self._free.sort()
+        return self._free.pop(0)
+
+    def free(self, slot: int):
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    # ------------------------------------------------------------ writes
+    def write_prefill(self, slot, pk, pv, prompt_len):
+        """Install a prefilled prompt's K/V into ``slot``."""
+        if pk.shape[1] > self.max_seq_len:
+            raise ValueError(
+                f"prefill length {pk.shape[1]} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        self.k, self.v = self._write(self.k, self.v, pk, pv, np.int32(slot))
+        self.lengths[slot] = int(prompt_len)
+
+    def update(self, new_k, new_v):
+        """Adopt the decode step's functionally-updated cache arrays."""
+        self.k, self.v = new_k, new_v

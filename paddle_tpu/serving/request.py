@@ -1,0 +1,83 @@
+"""Request / sequence state for the continuous-batching engine.
+
+A :class:`GenerationRequest` is the immutable user order (prompt +
+decoding knobs); a :class:`Sequence` is its mutable in-flight state —
+queue position, cache slot, generated tokens, finish reason. The split
+mirrors the request/sequence separation in the Orca / vLLM schedulers
+(PAPERS.md): the scheduler owns Sequences, users hold Requests.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+_next_request_id = itertools.count()
+
+
+@dataclass(frozen=True)
+class GenerationRequest:
+    """One generation order.
+
+    ``prompt`` is a 1-D int array/list of token ids. Sampling is greedy
+    when ``temperature <= 0``, else top-k temperature sampling
+    (``top_k <= 0`` = no top-k filter). ``eos_token_id`` enables early
+    exit; ``None`` always decodes ``max_new_tokens`` tokens. Randomness
+    comes from ``seed`` (or ``prng_key`` for callers that manage keys,
+    e.g. ``model.generate``'s per-row fold_in); with both unset the
+    process-global generator supplies a key at submit time.
+    """
+    prompt: object
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_token_id: Optional[int] = None
+    seed: Optional[int] = None
+    prng_key: object = None
+
+
+class Sequence:
+    """In-flight state of one request inside the engine.
+
+    ``tokens`` holds ONLY generated ids (the first entry is the token
+    sampled from the prefill logits). ``status`` walks
+    queued -> running -> finished; ``finish_reason`` is ``"eos"`` or
+    ``"length"``.
+    """
+
+    __slots__ = ("request", "request_id", "prompt", "tokens", "status",
+                 "finish_reason", "slot", "key", "submit_step")
+
+    def __init__(self, request: GenerationRequest, key, submit_step=0):
+        self.request = request
+        self.request_id = next(_next_request_id)
+        self.prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        self.tokens = []
+        self.status = "queued"
+        self.finish_reason = None
+        self.slot = None
+        self.key = key
+        self.submit_step = submit_step
+
+    @property
+    def done(self) -> bool:
+        return self.status == "finished"
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def remaining(self) -> int:
+        """Decode steps still needed (0 when the budget is spent)."""
+        return max(self.request.max_new_tokens - len(self.tokens), 0)
+
+    def output_ids(self) -> np.ndarray:
+        return np.asarray(self.tokens, np.int32)
+
+    def __repr__(self):
+        return (f"Sequence(id={self.request_id}, status={self.status}, "
+                f"slot={self.slot}, generated={len(self.tokens)}/"
+                f"{self.request.max_new_tokens})")

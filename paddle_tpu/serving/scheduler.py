@@ -1,0 +1,64 @@
+"""Admission + step-size policy for the continuous-batching engine.
+
+Orca-style iteration-level scheduling (PAPERS.md): the schedulable unit
+is ONE decode step, so a request can join or leave the batch between any
+two steps. The FIFO policy here does two jobs:
+
+- **Admission**: pop queued sequences into free cache slots, oldest
+  first, at the top of every engine step.
+- **Chunk fusion**: when nothing schedulable can change for a while
+  (queue empty), tell the engine to run several decode steps in one
+  fused device call (a ``lax.scan`` inside the jitted step) — the
+  largest power of two fitting both ``decode_chunk`` and every active
+  sequence's remaining budget. This amortizes per-step host dispatch
+  (the tunneled-TPU round trip is the expensive part) without ever
+  delaying an admission: any queued request forces single-stepping.
+  The compiled step-size set is bounded at
+  ``{1, 2, 4, …, decode_chunk}`` — log2(chunk)+1 programs.
+
+EOS is the one event a fused chunk cannot see coming; a sequence that
+hits EOS mid-chunk wastes the chunk's tail tokens (they are computed and
+discarded). That is the standard multi-step-scheduling trade — bound it
+by keeping ``decode_chunk`` modest, or set it to 1 to disable fusion.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+
+class FIFOScheduler:
+    """First-come-first-served admission; fused chunks when safe."""
+
+    def __init__(self, decode_chunk: int = 8):
+        self.decode_chunk = max(int(decode_chunk), 1)
+        self.queue = deque()
+
+    def submit(self, seq):
+        self.queue.append(seq)
+
+    @property
+    def num_queued(self) -> int:
+        return len(self.queue)
+
+    def admissions(self, num_free: int):
+        """Sequences to admit this step (pops up to ``num_free``)."""
+        out = []
+        while self.queue and len(out) < num_free:
+            out.append(self.queue.popleft())
+        return out
+
+    def choose_num_steps(self, active_seqs) -> int:
+        """How many decode steps to fuse into the next device call:
+        the largest power of two that fits both ``decode_chunk`` and
+        every active sequence's remaining budget. Powers of two keep the
+        compiled step-size set bounded (⊆ {1, 2, 4, …, decode_chunk})
+        while letting a near-finished batch still fuse most of its tail
+        instead of falling back to single-stepping. EOS-enabled
+        sequences may finish early inside a chunk (tail discarded)."""
+        if self.decode_chunk == 1 or self.queue or not active_seqs:
+            return 1
+        m = min(s.remaining for s in active_seqs)
+        n = 1
+        while n * 2 <= min(m, self.decode_chunk):
+            n *= 2
+        return n

@@ -32,6 +32,9 @@ def _load_bench(tmp_path, artifact=None):
     spec.loader.exec_module(bench)
     bench.BACKOFFS_S = (0,)
     bench.SELF_BENCH_PATH = str(tmp_path / "self_bench.json")
+    # keep the repo's real previous-round artifact out of the tests —
+    # prior-config/record rollover must come from the fixture only
+    bench.LEGACY_SELF_BENCH_PATHS = ()
     if artifact is not None:
         with open(bench.SELF_BENCH_PATH, "w") as f:
             json.dump(artifact, f)
@@ -59,22 +62,33 @@ PRIOR = {
 class TestBenchDriverFlow:
     def test_total_failure_reports_prior_with_provenance(self, tmp_path):
         bench = _load_bench(tmp_path, artifact=PRIOR)
-        bench._run = lambda args, timeout: (124, "", "dead")
+        bench._run = lambda args, timeout, env=None: (124, "", "dead")
         doc = _headline(bench)
         assert doc["metric"] == bench.METRIC
         assert doc["value"] == pytest.approx(0.4548)
         assert "2026-07-31T01:55:00Z" in doc["unit"]
         assert "4eab7ea" in doc["unit"]
+        # even with the tunnel dead, the CPU-forced decode_cb leg's
+        # outcome (here: failed) is banked in the artifact up front
+        art = json.load(open(bench.SELF_BENCH_PATH))
+        assert art["decode_cb"]["ok"] is False
+        assert any(c["mfu"] == pytest.approx(0.4548)
+                   for c in art["prior_configs"])
 
     def test_success_flow_decode_last_and_diagnosed(self, tmp_path):
         bench = _load_bench(tmp_path, artifact=PRIOR)
         order = []
 
-        def fake_run(args, timeout):
+        def fake_run(args, timeout, env=None):
             if args[0] == "-c":
                 return 0, "NDEV 1", ""
             leg = next(a for a in args if a.startswith("--"))
             order.append(leg)
+            if leg == "--decode-cb":
+                # scheduling leg must be hang-proof: CPU-forced child
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "decode_cb", "ok": True,
+                                      "speedup": 1.47}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -105,8 +119,11 @@ class TestBenchDriverFlow:
         doc = _headline(bench)
         assert doc["value"] > 0
         assert "decode[jnp] 321" in doc["unit"]
-        # decode is the final leg: a wedge there cannot cost the trace
+        # decode is the final leg: a wedge there cannot cost the trace —
+        # and the tunnel-independent scheduling leg runs before anything
+        # that can wedge
         assert order[-1] == "--decode" and "--trace" in order
+        assert order[0] == "--decode-cb"
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         # the pallas attempt's forensic trail rides along with the success

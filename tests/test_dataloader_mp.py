@@ -22,12 +22,15 @@ class TestMultiprocessDataLoader:
         np.testing.assert_allclose(
             flat, np.stack([[i, i * i] for i in range(32)]).astype(np.float32))
 
+    @pytest.mark.slow  # each mp-worker spawn costs ~14 s on this image;
+    # test_order_and_values is the default-run representative
     def test_worker_exception_propagates(self):
         ds = RaisingDataset(16, bad=5)
         dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False, worker_mode="process")
         with pytest.raises(RuntimeError, match="bad sample 5"):
             list(dl)
 
+    @pytest.mark.slow
     def test_worker_crash_detected(self):
         """A worker hard-exiting (os._exit) must surface as a RuntimeError,
         not a hang."""
@@ -38,6 +41,7 @@ class TestMultiprocessDataLoader:
                            match="exited unexpectedly|timed out"):
             list(dl)
 
+    @pytest.mark.slow
     def test_get_worker_info_in_workers(self):
         dl = DataLoader(WorkerIdDataset(), batch_size=4, num_workers=2,
                         shuffle=False, worker_mode="process")
@@ -65,6 +69,7 @@ class TestMultiprocessDataLoader:
         np.testing.assert_allclose(
             flat, np.stack([[i, i * i] for i in range(32)]).astype(np.float32))
 
+    @pytest.mark.slow
     def test_queue_fallback_when_shm_disabled(self):
         ds = RangeSquareDataset(16)
         dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,

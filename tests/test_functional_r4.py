@@ -480,6 +480,17 @@ class TestInterpolateModeParityR5:
         with pytest.raises(ValueError, match="spatial dim"):
             F.interpolate(_t(x), size=[9], mode="nearest")
 
+    def test_area_size_rank_mismatch_raises(self):
+        # area skipped the rank-vs-size validation the other resize paths
+        # run; a 1-elem size on a 2-spatial-dim input selected pool1d and
+        # crashed (or pooled the wrong dims) instead of naming the problem
+        x = np.zeros((1, 2, 6, 6), np.float32)
+        with pytest.raises(ValueError, match="spatial dim"):
+            F.interpolate(_t(x), size=[9], mode="area")
+        with pytest.raises(ValueError, match="spatial dim"):
+            F.interpolate(_t(np.zeros((1, 6, 6, 2), np.float32)),
+                          size=[3, 3, 3], mode="area", data_format="NHWC")
+
 
 class TestConvPaddingFormsR5:
     """Reference conv padding forms (caught in r5: the flat-2*spatial

@@ -7,6 +7,7 @@ dict (.pdparams)."""
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.jit as jit
@@ -183,6 +184,10 @@ class TestJitSaveLoadHardening:
         loaded = jit.load(path)
         np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
                                    atol=1e-6)
+        # inference works without the sidecar, but asking for the state
+        # dict must raise a descriptive error, not hand back None
+        with pytest.raises(FileNotFoundError, match="sidecar"):
+            loaded.state_dict()
 
     def test_params_only_save_clears_stale_program(self, tmp_path):
         net = _net()

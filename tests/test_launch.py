@@ -53,6 +53,8 @@ class TestLaunchCLI:
         assert os.path.exists(log1)
         assert "rank=1 ok" in open(log1).read()
 
+    @pytest.mark.slow  # subprocess-launch family: procs2 test is the
+    # default-run representative
     def test_master_env_propagated(self, tmp_path):
         p = _run_launch(["--procs", "1", "--master", "127.0.0.1:0",
                          "--log_dir", str(tmp_path / "logs"), TOY,
@@ -63,12 +65,14 @@ class TestLaunchCLI:
         assert env0["PADDLE_MASTER"].startswith("127.0.0.1")
         assert "PADDLE_CURRENT_ENDPOINT" in env0
 
+    @pytest.mark.slow
     def test_failure_exit_code(self, tmp_path):
         p = _run_launch(["--procs", "1", "--log_dir", str(tmp_path / "logs"),
                          FLAKY, str(tmp_path)])
         # no elastic: first failure is fatal
         assert p.returncode == 1
 
+    @pytest.mark.slow
     def test_elastic_restart_with_backoff(self, tmp_path):
         t0 = time.time()
         p = _run_launch(["--procs", "1", "--elastic_level", "1",
@@ -257,6 +261,8 @@ class TestNativeTCPStore:
         finally:
             srv.stop()
 
+    @pytest.mark.slow  # CLI-subprocess variant; the in-process TCPStore
+    # tests above keep covering the native store in the default run
     def test_launch_cli_tcp_backend(self, tmp_path):
         p = _run_launch(["--procs", "1", "--master", "127.0.0.1:0",
                          "--rdzv_backend", "tcp",
@@ -293,6 +299,8 @@ class TestRealJaxDistributed:
     touch the backend, and init_parallel_env agrees a real coordinator
     port through the rendezvous store when --master requests port 0."""
 
+    @pytest.mark.slow  # 2 real jax procs (~15 s); the import-safety
+    # canary below stays in the default run
     def test_two_process_rendezvous(self, tmp_path):
         toy = os.path.join(REPO, "tests", "_jaxdist_toy.py")
         p = _run_launch(["--procs", "2", "--master", "127.0.0.1:0",

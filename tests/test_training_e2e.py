@@ -199,6 +199,8 @@ class TestHapiModel:
 
 
 class TestResNet:
+    @pytest.mark.slow  # ~47 s eager conv net; the jitted train smoke
+    # below keeps resnet18 fwd+bwd+opt covered in the default run
     def test_resnet18_fwd_bwd(self):
         paddle.seed(10)
         from paddle_tpu.vision.models import resnet18
