@@ -450,6 +450,15 @@ def watchdog():
     cb_extra = {"decode_cb": cb if cb is not None else
                 {"ok": False, "rc": rc,
                  "stderr_tail": err.strip()[-300:]}}
+    # HTTP serving-gateway overhead leg: same contract as decode_cb —
+    # platform-agnostic (localhost HTTP vs in-process engine, same
+    # kernel both legs), CPU-forced so a dead tunnel can't cost it, and
+    # banked up front
+    rc, out, err = _run([me, "--serve-http"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    sh = _parse_result(rc, out)
+    cb_extra["serve_http"] = sh if sh is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -576,6 +585,13 @@ if __name__ == "__main__":
         from bench_decode import measure_continuous_batching
         print(json.dumps({"name": "decode_cb", "ok": True,
                           **measure_continuous_batching(quick=True)}))
+        sys.exit(0)
+    if "--serve-http" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_serve import measure_serve_http
+        print(json.dumps({"name": "serve_http", "ok": True,
+                          **measure_serve_http(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

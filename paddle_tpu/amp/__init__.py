@@ -198,14 +198,27 @@ class GradScaler:
         return self._scale
 
     def state_dict(self):
+        # the FULL schedule state: a resumed fp16 run must keep its
+        # loss-scale cadence (incr/decr windows + enable/dynamic flags),
+        # not just the current scale — see the save/load round-trip test
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+                "bad_steps": self._bad_steps, "enable": self._enable,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "use_dynamic_loss_scaling": self._dynamic}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._incr_ratio = state.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = state.get("decr_ratio", self._decr_ratio)
+        self._enable = state.get("enable", self._enable)
+        self._incr_every = state.get("incr_every_n_steps", self._incr_every)
+        self._decr_every = state.get("decr_every_n_nan_or_inf",
+                                     self._decr_every)
+        self._dynamic = state.get("use_dynamic_loss_scaling", self._dynamic)
 
     set_state_dict = load_state_dict
 

@@ -5,9 +5,16 @@ MFU = achieved model FLOP/s / peak chip FLOP/s. The FLOP formula is stated
 explicitly (BASELINE.md requirement): ``6 * n_params * tokens`` for
 transformer training (fwd+bwd), optionally + attention term
 ``12 * n_layers * hidden * seq`` per token when ``include_attention``.
+
+Also here: a dependency-free Prometheus text-exposition layer
+(:class:`Counter` / :class:`Gauge` / :class:`Histogram` collected by a
+:class:`MetricsRegistry`) — the serving gateway's ``GET /metrics``
+endpoint renders through it, and anything else (training loops, bench
+scripts) can register series the same way.
 """
 from __future__ import annotations
 
+import threading
 import time
 
 import jax
@@ -148,3 +155,206 @@ class DecodeMeter:
                   self.bytes_per_param)
             out["decode_mbu"] = bw / (self.n_chips * self.hbm_bw)
         return out
+
+
+# --------------------------------------------------- prometheus exposition
+# Text format per the Prometheus exposition spec v0.0.4: one HELP + TYPE
+# comment per metric family, then one sample line per (label set), with
+# histograms expanded to cumulative ``_bucket{le=...}`` series plus
+# ``_sum``/``_count``. No client_golang-style background machinery — a
+# scrape renders the current values under one registry lock.
+
+def _escape_help(s):
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s):
+    return (str(s).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_value(v):
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _label_str(labels):
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Base: one metric family, keyed by label values. Thread-safe —
+    the serving gateway increments from its driver thread while HTTP
+    handler threads render scrapes."""
+
+    kind = None
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series = {}  # label-items tuple -> value/state
+
+    def _key(self, labels):
+        return tuple(sorted(labels.items()))
+
+    def expose(self):
+        """Exposition lines for this family (HELP/TYPE + samples).
+        Samples render UNDER the lock: a histogram's counts/sum/count
+        must come from one consistent instant or a concurrent observe()
+        can produce a non-cumulative (corrupt-looking) scrape."""
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key, state in sorted(self._series.items()):
+                lines.extend(self._sample_lines(dict(key), state))
+        return lines
+
+    def _sample_lines(self, labels, state):
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing value (e.g. total tokens generated)."""
+
+    kind = "counter"
+
+    def inc(self, value=1, **labels):
+        if value < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + value
+
+    def value(self, **labels):
+        with self._lock:
+            return self._series.get(self._key(labels), 0)
+
+    def _sample_lines(self, labels, state):
+        return [f"{self.name}{_label_str(labels)} {_fmt_value(state)}"]
+
+
+class Gauge(_Metric):
+    """Point-in-time value (e.g. queue depth, active slots). ``set_fn``
+    registers a callable sampled at scrape time so the gauge can't go
+    stale between updates."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        with self._lock:
+            self._series[self._key(labels)] = value
+
+    def inc(self, value=1, **labels):
+        key = self._key(labels)
+        with self._lock:
+            cur = self._series.get(key, 0)
+            self._series[key] = (cur() if callable(cur) else cur) + value
+
+    def dec(self, value=1, **labels):
+        self.inc(-value, **labels)
+
+    def set_fn(self, fn, **labels):
+        with self._lock:
+            self._series[self._key(labels)] = fn
+
+    def value(self, **labels):
+        with self._lock:
+            v = self._series.get(self._key(labels), 0)
+        return v() if callable(v) else v
+
+    def _sample_lines(self, labels, state):
+        v = state() if callable(state) else state
+        return [f"{self.name}{_label_str(labels)} {_fmt_value(v)}"]
+
+
+# request latencies span ~ms (CPU tiny model) to minutes (long decodes)
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (latency distributions)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.buckets = tuple(b)
+
+    def observe(self, value, **labels):
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {"counts": [0] * len(self.buckets),
+                         "sum": 0.0, "count": 0}
+                self._series[key] = state
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    state["counts"][i] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+    def _sample_lines(self, labels, state):
+        lines = []
+        for ub, c in zip(self.buckets, state["counts"]):
+            bl = dict(labels, le=_fmt_value(ub))
+            lines.append(f"{self.name}_bucket{_label_str(bl)} {c}")
+        bl = dict(labels, le="+Inf")
+        lines.append(f"{self.name}_bucket{_label_str(bl)} {state['count']}")
+        lines.append(f"{self.name}_sum{_label_str(labels)} "
+                     f"{_fmt_value(state['sum'])}")
+        lines.append(f"{self.name}_count{_label_str(labels)} "
+                     f"{state['count']}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named collection of metric families; ``render()`` is the whole
+    ``GET /metrics`` response body."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _register(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                return m
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="") -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name, help="") -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def histogram(self, name, help="",
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            fams = [self._metrics[k] for k in sorted(self._metrics)]
+        lines = []
+        for m in fams:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"

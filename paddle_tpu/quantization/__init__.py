@@ -146,9 +146,21 @@ class ConvertedLinear(nn.Layer):
         self.bias = bias
 
     def forward(self, x):
-        w = self.qweight.value.astype(jnp.float32) * self.w_scale.value
+        # dequantize to the INPUT's dtype, not hard-coded fp32: under
+        # amp.auto_cast(dtype="bfloat16") a bf16 activation must meet a
+        # bf16 weight or the matmul silently promotes back to fp32
+        # (breaking the int8 + autocast composition); integer inputs
+        # (never valid for linear anyway) fall back to fp32
+        dt = x.value.dtype
+        if not jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.float32
+        w = (self.qweight.value.astype(dt)
+             * self.w_scale.value.astype(dt))
+        b = self.bias
+        if b is not None and b.dtype != dt:
+            b = Tensor(b.value.astype(dt))  # fp32 bias would re-promote
         from ..nn import functional as F
-        return F.linear(x, Tensor(w), self.bias)
+        return F.linear(x, Tensor(w), b)
 
 
 # ------------------------------------------------------------- config/API

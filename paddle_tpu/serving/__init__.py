@@ -10,17 +10,26 @@ decode kernel (``kernels/pallas_decode.py``) already skips KV blocks past
 
 Public surface:
 
-- :class:`GenerationRequest` / :class:`Sequence` — request & in-flight state
+- :class:`GenerationRequest` / :class:`Sequence` — request & in-flight
+  state (per-request deadlines via ``timeout_s``; ``finish_reason`` ∈
+  :data:`FINISH_REASONS` = stop|length|cancelled|timeout)
+- :class:`GenerationResult` — array-like generate() output + finish_reason
 - :class:`SlotKVCache` — the paged per-slot KV cache manager
 - :class:`FIFOScheduler` — admission + fused-chunk step policy
 - :class:`ContinuousBatchingEngine` — the step-function serving API
+  (``cancel()``, deadline sweeps, ``on_token``/``on_finish`` streaming
+  hooks)
+
+The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
+(imported lazily — the engine has no HTTP dependency).
 """
 from .engine import ContinuousBatchingEngine
 from .kv_cache import SlotKVCache
-from .request import GenerationRequest, Sequence
+from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
+                      Sequence)
 from .scheduler import FIFOScheduler
 
 __all__ = [
-    "ContinuousBatchingEngine", "GenerationRequest", "Sequence",
-    "SlotKVCache", "FIFOScheduler",
+    "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
+    "Sequence", "SlotKVCache", "FIFOScheduler", "FINISH_REASONS",
 ]

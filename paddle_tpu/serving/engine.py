@@ -27,6 +27,8 @@ loop; finished sequences come back from the step that retired them.
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,7 +36,7 @@ import numpy as np
 from .decode import build_decode_steps_fn, build_prefill_fn, \
     llama_decode_params
 from .kv_cache import SlotKVCache
-from .request import GenerationRequest, Sequence
+from .request import GenerationRequest, GenerationResult, Sequence
 from .scheduler import FIFOScheduler
 
 
@@ -75,7 +77,15 @@ class ContinuousBatchingEngine:
         self.stats = {"steps": 0, "decode_calls": 0, "decode_steps": 0,
                       "slot_steps": 0, "active_slot_steps": 0,
                       "prefills": 0, "prefill_tokens": 0,
-                      "tokens_generated": 0}
+                      "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
+        # streaming hooks (the gateway's wire into the step loop):
+        # on_token(seq, token_id) fires for EVERY generated token the
+        # moment the host sees it; on_finish(seq) fires exactly once per
+        # sequence, for every finish_reason — including cancel(), whose
+        # retirements never appear in a step() return. Both run on the
+        # thread driving step() — keep them cheap and non-reentrant.
+        self.on_token = None
+        self.on_finish = None
 
     # ------------------------------------------------------------ programs
     def _fn_consts(self):
@@ -115,27 +125,55 @@ class ContinuousBatchingEngine:
         from ..core import random as random_mod
         return random_mod.next_key()
 
-    def submit(self, request) -> Sequence:
-        """Queue a request; returns its live Sequence handle."""
+    def validate(self, request):
+        """Raise the submit-time errors without mutating engine state —
+        callable from any thread (the HTTP front door pre-validates here
+        so a bad request 400s on the handler thread instead of poisoning
+        the driver loop)."""
         if not isinstance(request, GenerationRequest):
             raise TypeError(
                 f"submit() takes a GenerationRequest, got "
                 f"{type(request).__name__}")
-        seq = Sequence(request, key=self._key_for(request),
-                       submit_step=self.stats["steps"])
-        if seq.prompt_len < 1:
+        prompt_len = int(np.asarray(request.prompt).reshape(-1).shape[0])
+        if prompt_len < 1:
             raise ValueError("empty prompt")
         if int(request.max_new_tokens) < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
-        if seq.prompt_len + int(request.max_new_tokens) > self.max_seq_len:
+        if prompt_len + int(request.max_new_tokens) > self.max_seq_len:
             raise ValueError(
-                f"prompt ({seq.prompt_len}) + max_new_tokens "
+                f"prompt ({prompt_len}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds the KV cache length "
                 f"({self.max_seq_len}); raise max_seq_len or generate "
                 f"fewer tokens")
+        if request.timeout_s is not None and float(request.timeout_s) <= 0:
+            raise ValueError(
+                f"timeout_s must be > 0, got {request.timeout_s}")
+
+    def submit(self, request) -> Sequence:
+        """Queue a request; returns its live Sequence handle."""
+        self.validate(request)
+        deadline = (time.monotonic() + float(request.timeout_s)
+                    if request.timeout_s is not None else None)
+        seq = Sequence(request, key=self._key_for(request),
+                       submit_step=self.stats["steps"], deadline=deadline)
         self.scheduler.submit(seq)
         return seq
+
+    def cancel(self, seq: Sequence) -> bool:
+        """Retire a sequence with ``finish_reason="cancelled"`` — queued
+        (dropped before ever touching a slot) or running (KV slot freed
+        mid-decode; the ragged kernel skips the dead slot from the next
+        step on). Must be called from the thread driving :meth:`step`.
+        Returns False if the sequence already finished."""
+        if seq.done:
+            return False
+        if seq.status == "queued":
+            if not self.scheduler.remove(seq):
+                return False
+        self.stats["cancelled"] += 1
+        self._finish(seq, "cancelled", [])
+        return True
 
     # ------------------------------------------------------------ stepping
     def _bucket(self, plen):
@@ -185,34 +223,66 @@ class ContinuousBatchingEngine:
                 self.stats["prefills"] += 1
                 self.stats["prefill_tokens"] += seq.prompt_len
                 self.stats["tokens_generated"] += 1
+                self._emit(seq, seq.tokens[0])
                 self._maybe_finish(seq, finished)
 
     def _maybe_finish(self, seq, finished):
         req = seq.request
         t = seq.tokens[-1]
         if req.eos_token_id is not None and t == int(req.eos_token_id):
-            self._finish(seq, "eos", finished)
+            self._finish(seq, "stop", finished)
         elif len(seq.tokens) >= int(req.max_new_tokens):
             self._finish(seq, "length", finished)
 
     def _finish(self, seq, reason, finished):
-        slot = seq.slot
         seq.status = "finished"
         seq.finish_reason = reason
-        self._slots[slot] = None
-        # reset the slot's knobs: a stale temperature would keep the
-        # sampler's all-greedy fast path (decode.sample_rows) disabled
-        # for every later greedy-only batch
-        self._temps[slot] = 0.0
-        self._topks[slot] = 0
-        self._last_tok[slot] = 0
-        self.cache.free(slot)
+        slot = seq.slot
+        if slot is not None and self._slots[slot] is seq:
+            self._slots[slot] = None
+            # reset the slot's knobs: a stale temperature would keep the
+            # sampler's all-greedy fast path (decode.sample_rows)
+            # disabled for every later greedy-only batch
+            self._temps[slot] = 0.0
+            self._topks[slot] = 0
+            self._last_tok[slot] = 0
+            self.cache.free(slot)
         finished.append(seq)
+        if self.on_finish is not None:
+            self.on_finish(seq)
+
+    def _expire_deadlines(self, seqs, finished):
+        """Retire every sequence whose deadline has passed. Runs once at
+        the top of each step, over the queue (an expired request must
+        not claim a slot) and the active slots (a running sequence stops
+        paying for decode at the first step boundary past its
+        deadline)."""
+        now = time.monotonic()
+        for seq in seqs:
+            if seq.done or seq.deadline is None or now < seq.deadline:
+                continue
+            if seq.status == "queued" and not self.scheduler.remove(seq):
+                continue
+            self.stats["timeouts"] += 1
+            self._finish(seq, "timeout", finished)
+
+    def _emit(self, seq, token):
+        if self.on_token is not None:
+            self.on_token(seq, token)
 
     def step(self):
-        """Admit + one fused decode call + retire. Returns the sequences
-        finished by this step (possibly empty)."""
+        """Admit + one fused decode call + retire. Returns every
+        sequence this step finished (possibly empty), deadline expiries
+        included — queue-side timeouts come back with ``slot=None`` and
+        no tokens. Only :meth:`cancel` retires outside a step; those
+        surface through ``on_finish`` / the Sequence handle alone."""
         finished = []
+        # deadline sweep BEFORE admission: an expired queued request
+        # must never claim a slot (and a running one stops paying for
+        # decode at the first step boundary past its deadline)
+        self._expire_deadlines(
+            list(self.scheduler.queue)
+            + [s for s in self._slots if s is not None], finished)
         admitted = self.scheduler.admissions(self.cache.num_free)
         if admitted:
             self._admit_group(admitted, finished)
@@ -241,6 +311,7 @@ class ContinuousBatchingEngine:
                     self._last_tok[slot] = t
                     self.stats["active_slot_steps"] += 1
                     self.stats["tokens_generated"] += 1
+                    self._emit(seq, t)
                     self._maybe_finish(seq, finished)
         self.stats["steps"] += 1
         return finished
@@ -249,11 +320,19 @@ class ContinuousBatchingEngine:
         return bool(self.scheduler.num_queued
                     or any(s is not None for s in self._slots))
 
+    @property
+    def num_active(self) -> int:
+        """Slots currently decoding (the /metrics active-slots gauge)."""
+        return self.num_slots - self.cache.num_free
+
     # ------------------------------------------------------------- offline
     def generate(self, requests):
-        """Submit all, run to completion, return each request's generated
-        ids (np.int32, EOS included when hit) in submission order."""
+        """Submit all, run to completion, return each request's
+        :class:`GenerationResult` (array-like generated ids, np.int32,
+        EOS included when hit, plus ``finish_reason``) in submission
+        order."""
         seqs = [self.submit(r) for r in requests]
         while self.has_work():
             self.step()
-        return [s.output_ids() for s in seqs]
+        return [GenerationResult(s.output_ids(), s.finish_reason,
+                                 s.request_id) for s in seqs]

@@ -28,6 +28,11 @@ class GenerationRequest:
     comes from ``seed`` (or ``prng_key`` for callers that manage keys,
     e.g. ``model.generate``'s per-row fold_in); with both unset the
     process-global generator supplies a key at submit time.
+
+    ``timeout_s`` is a wall-clock deadline measured from submit time:
+    the engine retires the sequence with ``finish_reason="timeout"`` at
+    the first step boundary past it — queued (never admitted) or
+    mid-decode (slot freed) alike. ``None`` = no deadline.
     """
     prompt: object
     max_new_tokens: int = 32
@@ -36,6 +41,13 @@ class GenerationRequest:
     eos_token_id: Optional[int] = None
     seed: Optional[int] = None
     prng_key: object = None
+    timeout_s: Optional[float] = None
+
+
+#: the closed finish_reason vocabulary (OpenAI-style names): "stop" =
+#: EOS hit, "length" = token budget spent, "cancelled" = caller cancel,
+#: "timeout" = deadline expired.
+FINISH_REASONS = ("stop", "length", "cancelled", "timeout")
 
 
 class Sequence:
@@ -43,14 +55,17 @@ class Sequence:
 
     ``tokens`` holds ONLY generated ids (the first entry is the token
     sampled from the prefill logits). ``status`` walks
-    queued -> running -> finished; ``finish_reason`` is ``"eos"`` or
-    ``"length"``.
+    queued -> running -> finished; ``finish_reason`` is one of
+    :data:`FINISH_REASONS`. ``deadline`` is the absolute
+    ``time.monotonic()`` instant derived from the request's
+    ``timeout_s`` at submit time (``None`` = no deadline).
     """
 
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
-                 "finish_reason", "slot", "key", "submit_step")
+                 "finish_reason", "slot", "key", "submit_step", "deadline")
 
-    def __init__(self, request: GenerationRequest, key, submit_step=0):
+    def __init__(self, request: GenerationRequest, key, submit_step=0,
+                 deadline=None):
         self.request = request
         self.request_id = next(_next_request_id)
         self.prompt = np.asarray(request.prompt, np.int32).reshape(-1)
@@ -60,6 +75,7 @@ class Sequence:
         self.slot = None
         self.key = key
         self.submit_step = submit_step
+        self.deadline = deadline
 
     @property
     def done(self) -> bool:
@@ -81,3 +97,42 @@ class Sequence:
         return (f"Sequence(id={self.request_id}, status={self.status}, "
                 f"slot={self.slot}, generated={len(self.tokens)}/"
                 f"{self.request.max_new_tokens})")
+
+
+class GenerationResult:
+    """One finished request's output: the generated ids plus the
+    ``finish_reason`` the engine retired it with.
+
+    Array-like on purpose: ``__array__``/``__len__``/``__iter__`` make
+    it a drop-in for the bare ``np.ndarray`` that
+    ``ContinuousBatchingEngine.generate()`` used to return
+    (``np.stack(outs)``, ``np.pad(out, ...)``, ``len(out)`` all keep
+    working), while gateways and tests can read ``.finish_reason``.
+    """
+
+    __slots__ = ("ids", "finish_reason", "request_id")
+
+    def __init__(self, ids, finish_reason, request_id):
+        self.ids = np.asarray(ids, np.int32)
+        self.finish_reason = finish_reason
+        self.request_id = request_id
+
+    def __array__(self, dtype=None, copy=None):
+        return self.ids if dtype is None else self.ids.astype(dtype)
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __iter__(self):
+        return iter(self.ids)
+
+    def __getitem__(self, i):
+        return self.ids[i]
+
+    def tolist(self):
+        return self.ids.tolist()
+
+    def __repr__(self):
+        return (f"GenerationResult(id={self.request_id}, "
+                f"finish_reason={self.finish_reason!r}, "
+                f"ids={self.ids.tolist()})")

@@ -47,6 +47,15 @@ class FIFOScheduler:
             out.append(self.queue.popleft())
         return out
 
+    def remove(self, seq) -> bool:
+        """Drop a still-queued sequence (cancellation / deadline expiry
+        before admission). Returns whether it was found."""
+        try:
+            self.queue.remove(seq)
+            return True
+        except ValueError:
+            return False
+
     def choose_num_steps(self, active_seqs) -> int:
         """How many decode steps to fuse into the next device call:
         the largest power of two that fits both ``decode_chunk`` and

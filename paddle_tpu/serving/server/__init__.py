@@ -1,0 +1,24 @@
+"""Async serving gateway: streaming HTTP front-end over the
+continuous-batching engine.
+
+Two layers (both stdlib-only):
+
+- :mod:`.gateway` — :class:`ServingGateway`, the engine-driver thread
+  plus a thread-safe front door handing back per-token
+  :class:`TokenStream` iterators, with cancellation, deadlines,
+  bounded-queue admission control, and graceful drain;
+- :mod:`.httpd` — :class:`ServingHTTPServer` / :func:`serve`, the
+  OpenAI-style HTTP surface (``POST /v1/completions`` blocking + SSE,
+  ``GET /healthz``, ``GET /metrics`` in Prometheus text format).
+
+Run one with ``python -m paddle_tpu.serving.server`` (or
+``scripts/serve.py``).
+"""
+from .gateway import (GatewayClosedError, QueueFullError, ServingGateway,
+                      TokenStream)
+from .httpd import ServingHTTPServer, serve
+
+__all__ = [
+    "ServingGateway", "TokenStream", "QueueFullError",
+    "GatewayClosedError", "ServingHTTPServer", "serve",
+]

@@ -1,0 +1,84 @@
+"""CLI entry point: ``python -m paddle_tpu.serving.server``.
+
+Stands up a LLaMA-family model behind the async gateway and serves
+OpenAI-style completions over HTTP until SIGINT/SIGTERM, then drains
+gracefully (in-flight requests finish; new ones get 503).
+
+The ``tiny`` preset is the CPU-runnable smoke config; ``350m`` is the
+bench-sized model for real chips. Prompts are token-id arrays (the
+framework ships no tokenizer) — see README "Serving over HTTP" for
+curl examples.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def build_model(preset, decode_attention, seed):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    paddle.seed(seed)
+    if preset == "tiny":
+        return LlamaForCausalLM(llama_tiny(decode_attention=decode_attention))
+    if preset == "350m":
+        return LlamaForCausalLM(LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", decode_attention=decode_attention))
+    raise ValueError(f"unknown preset {preset!r}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.server",
+        description="Streaming HTTP serving gateway over the "
+                    "continuous-batching engine.")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="0 = ephemeral (printed at startup)")
+    ap.add_argument("--preset", choices=("tiny", "350m"), default="tiny")
+    ap.add_argument("--decode-attention", choices=("pallas", "jnp"),
+                    default="jnp",
+                    help="ragged Pallas decode kernel or the jnp oracle")
+    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--decode-chunk", type=int, default=1,
+                    help=">1 fuses decode ticks (adds streaming latency)")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="waiting-room bound before 429s")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-request access logs")
+    args = ap.parse_args(argv)
+
+    from .httpd import serve
+    model = build_model(args.preset, args.decode_attention, args.seed)
+    server = serve(
+        model, host=args.host, port=args.port, num_slots=args.num_slots,
+        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
+        max_queue=args.max_queue, model_name=f"llama-{args.preset}",
+        log_fn=None if args.quiet else
+        (lambda m: print(m, file=sys.stderr)))
+    print(json.dumps({"listening": server.url, "preset": args.preset,
+                      "num_slots": args.num_slots,
+                      "endpoints": ["/v1/completions", "/healthz",
+                                    "/metrics"]}), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("# draining...", file=sys.stderr)
+    server.shutdown(drain=True, timeout=60)
+    print("# stopped", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,361 @@
+"""Async request gateway over the continuous-batching engine.
+
+The engine is single-threaded by contract (``step()`` mutates slot
+state, host length mirrors, and jitted-program caches with no locks).
+This module makes it servable without breaking that contract: ONE
+driver thread owns the engine and pumps ``step()``; every other thread
+talks to the gateway through a thread-safe front door —
+
+- :meth:`ServingGateway.submit` enqueues a request from any thread and
+  hands back a :class:`TokenStream`, a per-token iterator fed by the
+  engine's ``on_token`` callback the moment each token reaches the
+  host;
+- :meth:`TokenStream.cancel` flags a request from any thread; the
+  driver applies it between steps via ``engine.cancel`` — the KV slot
+  frees mid-decode and the ragged decode kernel skips it from the next
+  step on, so cancellation costs nothing;
+- admission control is a bounded waiting-room: submissions past
+  ``max_queue`` raise :class:`QueueFullError` (the HTTP layer's 429)
+  instead of growing an unbounded backlog;
+- :meth:`ServingGateway.shutdown` drains gracefully — the front door
+  closes, in-flight sequences run to completion, then the driver
+  exits (or ``drain=False`` cancels everything in flight).
+
+Deadlines ride on the engine itself (``GenerationRequest.timeout_s``,
+checked at step boundaries), so a request expires whether it is queued
+or mid-decode, and the gateway just observes the ``"timeout"`` finish.
+
+The compile-once property survives serving: the gateway adds no
+device-side work, so ``decode_compilations()`` stays at one per
+``(num_slots, max_seq_len, n_steps)`` no matter the HTTP traffic mix —
+pinned by tests/test_serving_server.py.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import itertools
+import queue
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ...profiler.metrics import MetricsRegistry
+
+
+class QueueFullError(RuntimeError):
+    """Waiting room at capacity — shed load (HTTP 429)."""
+
+
+class GatewayClosedError(RuntimeError):
+    """Gateway is draining or stopped — no new work (HTTP 503)."""
+
+
+class TokenStream:
+    """Live handle for one submitted request.
+
+    Iterating yields generated token ids as the engine produces them and
+    stops when the sequence finishes; ``finish_reason`` is set by then.
+    ``result()`` drains to completion and returns
+    ``(ids, finish_reason)``. Both are safe from any single consumer
+    thread; ``cancel()`` is safe from any thread.
+    """
+
+    def __init__(self, gateway, request, stream_id):
+        self.gateway = gateway
+        self.request = request
+        self.id = stream_id
+        self.finish_reason = None
+        self.seq = None            # set by the driver at engine-submit
+        self.submit_time = time.monotonic()
+        self.first_token_time = None
+        self.finish_time = None
+        self._events = queue.SimpleQueue()  # ("token", id) | ("finish", r) | ("error", msg)
+        self._collected = []
+        self._cancel = False
+        self._waiting = True       # still counted against max_queue
+        self._drained = False      # consumer saw the finish event
+
+    # ------------------------------------------------------- consumer side
+    def __iter__(self):
+        # event-driven on purpose: the driver sets finish_reason BEFORE
+        # queueing the finish event, so gating on finish_reason here
+        # would drop still-queued tokens of a finished stream
+        while not self._drained:
+            kind, payload = self._events.get()
+            if kind == "token":
+                self._collected.append(payload)
+                yield payload
+            elif kind == "finish":
+                self._drained = True
+            else:
+                self._drained = True
+                raise RuntimeError(payload)
+
+    def result(self):
+        """Block until the sequence finishes; return
+        ``(np.int32 ids, finish_reason)``."""
+        for _ in self:
+            pass
+        return np.asarray(self._collected, np.int32), self.finish_reason
+
+    def tokens(self):
+        """Tokens consumed so far (complete after ``result()`` /
+        exhausting the iterator)."""
+        return list(self._collected)
+
+    @property
+    def done(self):
+        """Finished engine-side (tokens may still await consumption)."""
+        return self.finish_reason is not None
+
+    def cancel(self):
+        """Request cancellation (idempotent, any thread). The driver
+        applies it between engine steps."""
+        self._cancel = True
+        self.gateway._wake.set()
+
+    # --------------------------------------------------------- driver side
+    def _push_token(self, token):
+        self._events.put(("token", int(token)))
+
+    def _push_finish(self, reason):
+        self.finish_time = time.monotonic()
+        self.finish_reason = reason
+        self._events.put(("finish", reason))
+
+    def _push_error(self, msg):
+        self.finish_time = time.monotonic()
+        self.finish_reason = "error"
+        self._events.put(("error", str(msg)))
+
+
+class _RateWindow:
+    """Sliding-window event rate (the tokens/s gauge): O(1) record via a
+    deque of (second-bucket, count) pairs, pruned at read time."""
+
+    def __init__(self, window_s=10.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._buckets = collections.deque()  # (int second, count)
+
+    def record(self, n=1):
+        sec = int(time.monotonic())
+        with self._lock:
+            if self._buckets and self._buckets[-1][0] == sec:
+                self._buckets[-1][1] += n
+            else:
+                self._buckets.append([sec, n])
+
+    def rate(self):
+        now = time.monotonic()
+        horizon = now - self.window_s
+        with self._lock:
+            while self._buckets and self._buckets[0][0] < horizon:
+                self._buckets.popleft()
+            total = sum(c for _, c in self._buckets)
+        return total / self.window_s
+
+
+class ServingGateway:
+    """Thread-safe front door + engine-driver thread.
+
+    ``max_queue`` bounds the waiting room: requests submitted but not
+    yet decoding (gateway intake + engine scheduler queue). Running
+    sequences never count — capacity there is ``num_slots``.
+    """
+
+    def __init__(self, engine, max_queue=64, idle_wait_s=0.02,
+                 registry=None, start=True):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.idle_wait_s = float(idle_wait_s)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._intake = collections.deque()   # TokenStreams pre engine-submit
+        self._live = {}                      # seq.request_id -> TokenStream
+        self._backlog = 0                    # waiting-room occupancy
+        self._closed = False
+        self._drain = True
+        self._ids = itertools.count(1)
+        engine.on_token = self._on_token
+        engine.on_finish = self._on_finish
+        self._init_metrics(registry)
+        self._thread = threading.Thread(target=self._run,
+                                        name="engine-driver", daemon=True)
+        # a daemon driver killed mid-XLA-dispatch at interpreter teardown
+        # aborts the process (observed: LLVM "Invalid size request") —
+        # stop it via atexit instead. weakref so the hook never keeps a
+        # dropped gateway alive.
+        ref = weakref.ref(self)
+        self._atexit_hook = lambda: (lambda gw: gw and gw.shutdown(
+            drain=False, timeout=10))(ref())
+        atexit.register(self._atexit_hook)
+        if start:
+            self._thread.start()
+
+    # ------------------------------------------------------------- metrics
+    def _init_metrics(self, registry):
+        self.registry = registry if registry is not None else \
+            MetricsRegistry()
+        r = self.registry
+        self._m_requests = r.counter(
+            "serving_requests_total", "Requests accepted by the gateway.")
+        self._m_rejected = r.counter(
+            "serving_rejected_total",
+            "Requests shed by admission control (queue full).")
+        self._m_finished = r.counter(
+            "serving_finished_total",
+            "Finished sequences by finish_reason.")
+        self._m_tokens = r.counter(
+            "serving_generated_tokens_total", "Generated tokens.")
+        self._m_ttft = r.histogram(
+            "serving_ttft_seconds", "Submit-to-first-token latency.")
+        self._m_latency = r.histogram(
+            "serving_request_latency_seconds",
+            "Submit-to-finish latency per request.")
+        self._rate = _RateWindow()
+        r.gauge("serving_queue_depth",
+                "Requests waiting for a slot (intake + scheduler queue)."
+                ).set_fn(lambda: self._backlog)
+        r.gauge("serving_active_slots",
+                "KV slots currently decoding.").set_fn(
+            lambda: self.engine.num_active)
+        r.gauge("serving_num_slots", "KV slot capacity.").set(
+            self.engine.num_slots)
+        r.gauge("serving_tokens_per_second",
+                "Generated tokens/s over a 10s sliding window.").set_fn(
+            self._rate.rate)
+        r.gauge("serving_decode_compilations",
+                "Decode-program traces (compile-once contract: stays at "
+                "one per (num_slots, max_seq_len, n_steps)).").set_fn(
+            self.engine.decode_compilations)
+
+    # ---------------------------------------------------------- front door
+    def submit(self, request) -> TokenStream:
+        """Enqueue from any thread. Raises ValueError/TypeError on a bad
+        request, QueueFullError past ``max_queue``, GatewayClosedError
+        after shutdown began."""
+        # validate on the caller's thread: a bad request must 400 here,
+        # not poison the driver loop later
+        self.engine.validate(request)
+        with self._lock:
+            if self._closed:
+                raise GatewayClosedError("gateway is draining")
+            if self._backlog >= self.max_queue:
+                self._m_rejected.inc()
+                raise QueueFullError(
+                    f"waiting room full ({self.max_queue} requests)")
+            self._backlog += 1
+            stream = TokenStream(self, request, f"cmpl-{next(self._ids)}")
+            self._intake.append(stream)
+        self._m_requests.inc()
+        self._wake.set()
+        return stream
+
+    @property
+    def queue_depth(self):
+        return self._backlog
+
+    @property
+    def closed(self):
+        return self._closed
+
+    # ------------------------------------------------------- engine events
+    def _leave_waiting_room(self, stream):
+        if stream._waiting:
+            stream._waiting = False
+            with self._lock:
+                self._backlog -= 1
+
+    def _on_token(self, seq, token):
+        stream = self._live.get(seq.request_id)
+        self._m_tokens.inc()
+        self._rate.record()
+        if stream is None:
+            return
+        if stream.first_token_time is None:
+            stream.first_token_time = time.monotonic()
+            self._m_ttft.observe(stream.first_token_time
+                                 - stream.submit_time)
+            self._leave_waiting_room(stream)
+        stream._push_token(token)
+
+    def _on_finish(self, seq):
+        stream = self._live.pop(seq.request_id, None)
+        self._m_finished.inc(reason=seq.finish_reason)
+        if stream is None:
+            return
+        self._leave_waiting_room(stream)  # finished while still queued
+        self._m_latency.observe(time.monotonic() - stream.submit_time)
+        stream._push_finish(seq.finish_reason)
+
+    # ------------------------------------------------------- driver thread
+    def _admit_intake(self):
+        while True:
+            with self._lock:
+                if not self._intake:
+                    return
+                stream = self._intake.popleft()
+            if stream._cancel:
+                self._leave_waiting_room(stream)
+                self._m_finished.inc(reason="cancelled")
+                stream._push_finish("cancelled")
+                continue
+            try:
+                seq = self.engine.submit(stream.request)
+            except Exception as e:  # validated at submit(); belt+braces
+                self._leave_waiting_room(stream)
+                stream._push_error(e)
+                continue
+            stream.seq = seq
+            self._live[seq.request_id] = stream
+
+    def _apply_cancels(self):
+        for stream in [s for s in self._live.values() if s._cancel]:
+            self.engine.cancel(stream.seq)  # fires _on_finish
+
+    def _run(self):
+        try:
+            while True:
+                self._admit_intake()
+                self._apply_cancels()
+                if self.engine.has_work():
+                    self.engine.step()
+                    continue
+                with self._lock:
+                    drained = not self._intake and not self._live
+                    if self._closed and drained:
+                        return
+                self._wake.wait(self.idle_wait_s)
+                self._wake.clear()
+        except BaseException as e:
+            # the driver is the only thread that can unblock consumers —
+            # a dying engine must not strand them mid-result()
+            with self._lock:
+                self._closed = True
+                stranded = list(self._intake) + list(self._live.values())
+                self._intake.clear()
+                self._live.clear()
+            for s in stranded:
+                s._push_error(f"engine driver died: {e!r}")
+            raise
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self, drain=True, timeout=None):
+        """Close the front door; ``drain=True`` lets in-flight and
+        queued work finish, ``drain=False`` cancels it. Blocks until the
+        driver exits (or ``timeout``). Returns True if it did."""
+        with self._lock:
+            self._closed = True
+            streams = ([] if drain else
+                       list(self._intake) + list(self._live.values()))
+        for s in streams:
+            s._cancel = True
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        atexit.unregister(self._atexit_hook)
+        return not self._thread.is_alive()

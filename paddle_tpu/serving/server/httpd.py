@@ -1,0 +1,294 @@
+"""OpenAI-style HTTP front-end over :class:`ServingGateway`.
+
+Stdlib only (``http.server`` on a thread-per-connection
+``ThreadingHTTPServer``) — no new dependencies; the heavy lifting is
+the gateway's single engine-driver thread, so handler threads only
+parse JSON, block on token queues, and write bytes.
+
+Endpoints:
+
+- ``POST /v1/completions`` — body ``{"prompt": [token ids], ...}``.
+  Blocking by default (one JSON response), per-token SSE with
+  ``"stream": true`` (``data: {...}`` chunks, then ``data: [DONE]``).
+  This framework ships no tokenizer, so prompts and completions are
+  token-id arrays — the ``choices[].token_ids`` field stands in for
+  OpenAI's ``text``.
+- ``GET /healthz`` — liveness + drain state + slot/queue occupancy.
+- ``GET /metrics`` — Prometheus text exposition
+  (``profiler.metrics.MetricsRegistry``).
+
+Load shedding maps gateway signals onto status codes: full waiting
+room → 429 (with Retry-After), draining gateway → 503, validation →
+400. A client that disconnects mid-SSE cancels its request — the
+broken-pipe write error reaches ``TokenStream.cancel()``, the engine
+frees the KV slot at the next step boundary, and the remaining
+streams are untouched.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..request import GenerationRequest
+from .gateway import GatewayClosedError, QueueFullError, ServingGateway
+
+SSE_HEADERS = (("Content-Type", "text/event-stream"),
+               ("Cache-Control", "no-cache"),
+               ("Connection", "close"))
+
+
+def _completion_body(stream, token_ids, finish_reason, model_name,
+                     prompt_tokens):
+    return {
+        "id": stream.id,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model_name,
+        "choices": [{
+            "index": 0,
+            "token_ids": [int(t) for t in token_ids],
+            "finish_reason": finish_reason,
+        }],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": len(token_ids),
+            "total_tokens": prompt_tokens + len(token_ids),
+        },
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "paddle-tpu-serving/1.0"
+
+    # ------------------------------------------------------------- helpers
+    @property
+    def gateway(self) -> ServingGateway:
+        return self.server.gateway
+
+    def log_message(self, fmt, *args):  # route through the server hook
+        if self.server.log_fn is not None:
+            self.server.log_fn(fmt % args)
+
+    def _send_json(self, code, obj, extra_headers=()):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, message, etype, extra_headers=()):
+        self._send_json(code, {"error": {"message": message,
+                                         "type": etype}}, extra_headers)
+
+    # ----------------------------------------------------------------- GET
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            gw = self.gateway
+            self._send_json(200 if not gw.closed else 503, {
+                "status": "draining" if gw.closed else "ok",
+                "active_slots": gw.engine.num_active,
+                "num_slots": gw.engine.num_slots,
+                "queue_depth": gw.queue_depth,
+            })
+        elif path == "/metrics":
+            body = self.gateway.registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._error(404, f"no route for GET {path}", "invalid_request")
+
+    # ---------------------------------------------------------------- POST
+    def do_POST(self):
+        path = self.path.split("?", 1)[0]
+        if path != "/v1/completions":
+            self._error(404, f"no route for POST {path}", "invalid_request")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, f"invalid JSON body: {e}", "invalid_request")
+            return
+        try:
+            request = self._build_request(payload)
+            stream = self.gateway.submit(request)
+        except QueueFullError as e:
+            self._error(429, str(e), "rate_limit",
+                        extra_headers=(("Retry-After", "1"),))
+            return
+        except GatewayClosedError as e:
+            self._error(503, str(e), "unavailable")
+            return
+        except (TypeError, ValueError) as e:
+            self._error(400, str(e), "invalid_request")
+            return
+        prompt_tokens = len(request.prompt)
+        if payload.get("stream", False):
+            self._stream_response(stream, prompt_tokens)
+            return
+        # blocking path. A client that disconnects mid-generation is only
+        # detectable at write time (no socket monitoring while blocked in
+        # result()), so the sequence runs to completion either way — use
+        # "stream": true (or timeout_s) when abandonment must free the
+        # slot early.
+        try:
+            ids, reason = stream.result()
+        except RuntimeError as e:  # engine driver died mid-request
+            try:
+                self._error(500, str(e), "server_error")
+            except OSError:
+                pass
+            return
+        try:
+            self._send_json(200, _completion_body(
+                stream, ids, reason, self.server.model_name, prompt_tokens))
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True  # client gone; work already done
+
+    def _build_request(self, p):
+        prompt = p.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or \
+                not all(isinstance(t, int) for t in prompt):
+            raise ValueError(
+                "'prompt' must be a list of token ids (this server is "
+                "tokenizer-free); got "
+                f"{type(prompt).__name__}")
+        kw = {}
+        if p.get("timeout_s") is not None:
+            kw["timeout_s"] = float(p["timeout_s"])
+        eos = p.get("eos_token_id", p.get("stop_token_id"))
+        return GenerationRequest(
+            prompt=list(prompt),
+            max_new_tokens=int(p.get("max_tokens", 16)),
+            temperature=float(p.get("temperature", 0.0)),
+            top_k=int(p.get("top_k", 0)),
+            eos_token_id=None if eos is None else int(eos),
+            seed=None if p.get("seed") is None else int(p["seed"]),
+            **kw)
+
+    def _stream_response(self, stream, prompt_tokens):
+        self.send_response(200)
+        for k, v in SSE_HEADERS:
+            self.send_header(k, v)
+        self.end_headers()
+
+        def event(obj):
+            data = obj if isinstance(obj, str) else json.dumps(obj)
+            self.wfile.write(f"data: {data}\n\n".encode())
+            self.wfile.flush()
+
+        try:
+            for token in stream:
+                event({"id": stream.id, "object": "text_completion.chunk",
+                       "model": self.server.model_name,
+                       "choices": [{"index": 0, "token_id": int(token),
+                                    "finish_reason": None}]})
+            event({"id": stream.id, "object": "text_completion.chunk",
+                   "model": self.server.model_name,
+                   "choices": [{"index": 0, "token_id": None,
+                                "finish_reason": stream.finish_reason}],
+                   "usage": {"prompt_tokens": prompt_tokens,
+                             "completion_tokens": len(stream.tokens()),
+                             "total_tokens":
+                                 prompt_tokens + len(stream.tokens())}})
+            event("[DONE]")
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            # client went away mid-stream: free the KV slot, leave the
+            # rest of the batch untouched
+            stream.cancel()
+        except RuntimeError as e:  # engine-side error event
+            try:
+                event({"error": {"message": str(e), "type": "server_error"}})
+            except OSError:
+                pass
+        finally:
+            self.close_connection = True
+
+
+class ServingHTTPServer:
+    """Owns the ThreadingHTTPServer + its accept-loop thread.
+
+    ``port=0`` binds an ephemeral port (tests); read it back from
+    ``.port``. ``shutdown(drain=True)`` closes the gateway's front door,
+    waits for in-flight sequences, then stops accepting.
+    """
+
+    def __init__(self, gateway, host="127.0.0.1", port=8000,
+                 model_name="paddle-tpu-llama", log_fn=None):
+        self.gateway = gateway
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.gateway = gateway
+        self._httpd.model_name = model_name
+        self._httpd.log_fn = log_fn
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            name="http-accept", daemon=True)
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Graceful stop: close the front door (new completions 503),
+        drain (or cancel) in-flight work, then stop the accept loop."""
+        self.gateway.shutdown(drain=drain, timeout=timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def serve(model, host="127.0.0.1", port=8000, num_slots=8,
+          max_seq_len=None, decode_chunk=1, max_queue=64,
+          model_name=None, registry=None, log_fn=None, start=True):
+    """Build engine → gateway → HTTP server and start listening.
+
+    ``decode_chunk=1`` is the serving default: chunk fusion trades
+    per-token latency for dispatch amortization, the wrong trade when
+    tokens stream to a client (and it keeps the compiled decode
+    step-size set at exactly one program).
+    """
+    from ..engine import ContinuousBatchingEngine
+    engine = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_seq_len=max_seq_len,
+        decode_chunk=decode_chunk,
+        jit_cache=model.__dict__.setdefault("_serving_jit", {}))
+    gateway = ServingGateway(engine, max_queue=max_queue, registry=registry)
+    server = ServingHTTPServer(
+        gateway, host=host, port=port,
+        model_name=model_name or type(model).__name__, log_fn=log_fn)
+    return server.start() if start else server

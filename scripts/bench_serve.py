@@ -1,0 +1,143 @@
+"""HTTP serving-gateway overhead benchmark.
+
+Question answered: what does the async gateway (driver thread + token
+queues + stdlib HTTP + SSE-capable front door) cost over driving the
+``ContinuousBatchingEngine`` directly in-process?
+
+Both legs run the SAME engine configuration, kernel, and request set
+(seeded greedy, so token equality is asserted as a side effect):
+
+- **direct** — ``engine.generate(requests)`` on this thread;
+- **http** — the same requests as concurrent blocking
+  ``POST /v1/completions`` calls from client threads against a
+  localhost :func:`paddle_tpu.serving.server.serve` instance.
+
+The measured ratio isolates the gateway+HTTP layer: same decode
+programs (shared jit cache), same scheduling (decode_chunk=1), same
+host. Reported per-token overhead is the wall-clock delta spread over
+the generated tokens.
+
+Usage:
+  python scripts/bench_serve.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_decode import _models  # noqa: E402  (same model both benches)
+
+
+def _requests(n, max_new, vocab, plen=16):
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(5)
+    return [GenerationRequest(
+        prompt=rng.randint(0, vocab, (plen,)).astype(np.int32),
+        max_new_tokens=max_new) for _ in range(n)]
+
+
+def _post(url, prompt, max_new, timeout=120):
+    body = json.dumps({"prompt": [int(t) for t in prompt],
+                       "max_tokens": int(max_new)}).encode()
+    req = urllib.request.Request(
+        url + "/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _run_direct(model, reqs, num_slots, s_max):
+    from dataclasses import replace
+
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        jit_cache=model.__dict__.setdefault("_serving_jit", {}))
+    t0 = time.perf_counter()
+    outs = eng.generate([replace(r) for r in reqs])
+    dt = time.perf_counter() - t0
+    tokens = sum(len(o) for o in outs)
+    return {"wall_s": dt, "tokens": tokens, "tok_s": tokens / dt}, \
+        [o.tolist() for o in outs]
+
+
+def _run_http(server, reqs):
+    outs = [None] * len(reqs)
+
+    def worker(i):
+        doc = _post(server.url, reqs[i].prompt, reqs[i].max_new_tokens)
+        outs[i] = doc["choices"][0]["token_ids"]
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(reqs))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    tokens = sum(len(o) for o in outs)
+    return {"wall_s": dt, "tokens": tokens, "tok_s": tokens / dt}, outs
+
+
+def measure_serve_http(quick=True, n_requests=8, max_new=None,
+                       num_slots=4, repeats=3):
+    from paddle_tpu.serving.server import serve
+    max_new = max_new or (24 if quick else 64)
+    s_max = 128 if quick else 256
+    model = _models(quick)["jnp"]
+    reqs = _requests(n_requests, max_new, model.config.vocab_size)
+    server = serve(model, port=0, num_slots=num_slots, max_seq_len=s_max,
+                   max_queue=2 * n_requests, model_name="bench")
+    try:
+        # warm every program + the HTTP path end to end
+        _run_direct(model, reqs[:2], num_slots, s_max)
+        _run_http(server, reqs[:2])
+        direct = http = None
+        tokens_equal = True
+        for _ in range(repeats):   # interleave; best wall of each leg
+            d, d_toks = _run_direct(model, reqs, num_slots, s_max)
+            h, h_toks = _run_http(server, reqs)
+            tokens_equal = tokens_equal and d_toks == h_toks
+            direct = d if direct is None or d["wall_s"] < direct["wall_s"] \
+                else direct
+            http = h if http is None or h["wall_s"] < http["wall_s"] else http
+    finally:
+        server.shutdown(drain=False, timeout=30)
+    return {
+        "direct": direct, "http": http, "repeats": repeats,
+        "tokens_equal": tokens_equal,
+        "overhead_ratio": http["wall_s"] / direct["wall_s"],
+        "gateway_overhead_ms_per_token":
+            (http["wall_s"] - direct["wall_s"]) / http["tokens"] * 1e3,
+        "n_requests": n_requests, "max_new": max_new,
+        "num_slots": num_slots,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "serve_http": measure_serve_http(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

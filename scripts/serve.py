@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Launcher shim for the HTTP serving gateway — identical to
+``python -m paddle_tpu.serving.server``; see that module (or README
+"Serving over HTTP") for flags and curl examples.
+
+    python scripts/serve.py --preset tiny --port 8000
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.serving.server.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

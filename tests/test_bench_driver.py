@@ -68,10 +68,11 @@ class TestBenchDriverFlow:
         assert doc["value"] == pytest.approx(0.4548)
         assert "2026-07-31T01:55:00Z" in doc["unit"]
         assert "4eab7ea" in doc["unit"]
-        # even with the tunnel dead, the CPU-forced decode_cb leg's
-        # outcome (here: failed) is banked in the artifact up front
+        # even with the tunnel dead, the CPU-forced decode_cb and
+        # serve_http legs' outcomes (here: failed) are banked up front
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode_cb"]["ok"] is False
+        assert art["serve_http"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -89,6 +90,12 @@ class TestBenchDriverFlow:
                 assert env == {"JAX_PLATFORMS": "cpu"}
                 return 0, json.dumps({"name": "decode_cb", "ok": True,
                                       "speedup": 1.47}), ""
+            if leg == "--serve-http":
+                # gateway-overhead leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "serve_http", "ok": True,
+                                      "overhead_ratio": 1.17,
+                                      "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -120,12 +127,13 @@ class TestBenchDriverFlow:
         assert doc["value"] > 0
         assert "decode[jnp] 321" in doc["unit"]
         # decode is the final leg: a wedge there cannot cost the trace —
-        # and the tunnel-independent scheduling leg runs before anything
-        # that can wedge
+        # and the tunnel-independent scheduling + gateway legs run
+        # before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[0] == "--decode-cb"
+        assert order[:2] == ["--decode-cb", "--serve-http"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
+        assert art["serve_http"]["overhead_ratio"] == 1.17
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

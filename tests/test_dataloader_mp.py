@@ -124,6 +124,8 @@ class TestShmRing:
         finally:
             r.close(unlink=True)
 
+    @pytest.mark.slow  # ~100s spawn+compile; in-process ring transport
+    # tests above stay as the default-run shm-ring representatives
     def test_cross_process(self):
         """Producer in a real spawned process."""
         import multiprocessing as mp

@@ -46,6 +46,7 @@ class TestDecodeParity:
     def setup_method(self, _m):
         mesh_mod._STATE["mesh"] = None
 
+    @pytest.mark.slow  # the GQA variant above is the stricter default rep
     def test_prefill_then_decode_matches_full_mha(self):
         m = _model()
         x = np.random.RandomState(0).randn(2, 10, 32).astype(np.float32)
@@ -60,6 +61,8 @@ class TestDecodeParity:
         inc = _run_prefill_decode(m, x, prefill_len=4)
         np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow  # step-wise parity; covered daily by the serving
+    # engine equivalence tests at a fraction of the wall time
     def test_decode_all_tokens_one_by_one(self):
         """Pure decode from t=0 (prefill of 1)."""
         m = _model(L=2)

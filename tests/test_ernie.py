@@ -2,6 +2,8 @@
 ErnieModel + MaskedLM / SequenceClassification heads)."""
 import numpy as np
 
+import pytest
+
 import paddle_tpu as paddle
 from paddle_tpu.jit import TrainStep
 from paddle_tpu.models import (ErnieForMaskedLM,
@@ -85,6 +87,8 @@ class TestErnie:
         oracle = ((lse - picked) * keep).sum() / keep.sum()
         np.testing.assert_allclose(l_half, oracle, rtol=2e-4)
 
+    @pytest.mark.slow  # mlm_training_converges stays the default-run
+    # ernie convergence representative
     def test_sequence_classification_trains(self):
         paddle.seed(5)
         cfg = ernie_tiny(hidden_dropout_prob=0.0)

@@ -2,6 +2,8 @@
 hook-based MAC counting over a dummy forward)."""
 import numpy as np
 
+import pytest
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
@@ -31,6 +33,8 @@ class TestFlops:
             net2, [1, 4], custom_ops={Custom: lambda l, i, o: 1000})
         assert with_custom == base + 1000
 
+    @pytest.mark.slow  # full resnet50 flops walk (~6s); the op-level
+    # flops tests stay default
     def test_resnet_scale_plausible(self):
         paddle.seed(2)
         from paddle_tpu.vision.models import resnet18

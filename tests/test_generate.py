@@ -20,6 +20,8 @@ def _greedy_oracle(m, ids, n):
 
 
 class TestLlamaGenerate:
+    @pytest.mark.slow  # the MHA twin below is the default-run rep; GQA
+    # decode parity stays default via test_decode/test_serving
     def test_greedy_matches_full_forward_gqa(self):
         paddle.seed(11)
         m = LlamaForCausalLM(llama_tiny())  # nkv=2 < nh=4: GQA decode
@@ -47,6 +49,8 @@ class TestLlamaGenerate:
         np.testing.assert_array_equal(a, b)  # same seed, same tokens
         assert (a >= 0).all() and (a < 256).all()
 
+    @pytest.mark.slow  # cache-length clamping also pinned (fast) by
+    # serving submit validation + model_generate_shares_decode_program
     def test_cache_shorter_than_max_positions(self):
         paddle.seed(14)
         m = LlamaForCausalLM(llama_tiny())

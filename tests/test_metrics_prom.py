@@ -1,0 +1,180 @@
+"""Prometheus text-exposition helpers (profiler/metrics.py): the
+counter/gauge/histogram layer the serving gateway's ``GET /metrics``
+renders through. The parser here is intentionally strict about the
+v0.0.4 text format — the same parser validates live scrapes in
+tests/test_serving_server.py."""
+import math
+import re
+import threading
+
+import pytest
+
+from paddle_tpu.profiler.metrics import (Counter, Gauge, Histogram,
+                                         MetricsRegistry)
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})? '
+    r'(?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>.*)"$')
+
+
+def parse_prometheus(text):
+    """Parse exposition text -> {family: {"type", "help", "samples"}}
+    with samples as {(name, label_items): float}. Raises AssertionError
+    on any format violation (samples before TYPE, bad label syntax,
+    non-float values, missing trailing newline)."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    fams, cur = {}, None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            fams.setdefault(name, {"help": help_, "samples": {}})
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram", "summary")
+            fams.setdefault(name, {"samples": {}})["type"] = kind
+            cur = name
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group("name")
+        labels = []
+        if m.group("labels"):
+            for pair in re.findall(r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|'
+                                   r'\\.)*"', m.group("labels")):
+                lm = _LABEL_RE.match(pair)
+                assert lm, f"malformed label: {pair!r}"
+                labels.append((lm.group("k"), lm.group("v")))
+        v = m.group("value")
+        value = math.inf if v == "+Inf" else \
+            -math.inf if v == "-Inf" else float(v)
+        # samples must belong to the most recent TYPE'd family
+        assert cur is not None and name.startswith(cur), \
+            f"sample {name} outside its family block (cur={cur})"
+        fams[cur]["samples"][(name, tuple(labels))] = value
+    return fams
+
+
+class TestCounter:
+    def test_inc_and_expose(self):
+        c = Counter("requests_total", "Total requests.")
+        c.inc()
+        c.inc(4)
+        text = "\n".join(c.expose()) + "\n"
+        fams = parse_prometheus(text)
+        assert fams["requests_total"]["type"] == "counter"
+        assert fams["requests_total"]["samples"][
+            ("requests_total", ())] == 5
+
+    def test_labels_sorted_and_separate(self):
+        c = Counter("finished_total")
+        c.inc(reason="stop")
+        c.inc(reason="timeout")
+        c.inc(2, reason="stop")
+        s = parse_prometheus("\n".join(c.expose()) + "\n")[
+            "finished_total"]["samples"]
+        assert s[("finished_total", (("reason", "stop"),))] == 3
+        assert s[("finished_total", (("reason", "timeout"),))] == 1
+
+    def test_decrease_rejected(self):
+        c = Counter("n")
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("queue_depth")
+        g.set(7)
+        g.inc()
+        g.dec(3)
+        assert g.value() == 5
+
+    def test_scrape_time_callable(self):
+        """set_fn gauges sample at render time — the gateway points
+        these at engine state so a scrape can never be stale."""
+        depth = [3]
+        g = Gauge("active_slots")
+        g.set_fn(lambda: depth[0])
+        assert "active_slots 3" in g.expose()
+        depth[0] = 9
+        assert "active_slots 9" in g.expose()
+
+
+class TestHistogram:
+    def test_buckets_cumulative_sum_count(self):
+        h = Histogram("latency_seconds", "Request latency.",
+                      buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        fams = parse_prometheus("\n".join(h.expose()) + "\n")
+        s = fams["latency_seconds"]["samples"]
+        assert fams["latency_seconds"]["type"] == "histogram"
+
+        def bucket(le):
+            return s[("latency_seconds_bucket", (("le", le),))]
+
+        assert bucket("0.1") == 1
+        assert bucket("1") == 3      # cumulative, not per-bin
+        assert bucket("10") == 4
+        assert bucket("+Inf") == 5
+        assert s[("latency_seconds_count", ())] == 5
+        assert s[("latency_seconds_sum", ())] == pytest.approx(56.05)
+
+    def test_bucket_monotonicity_invariant(self):
+        h = Histogram("x", buckets=(1, 2, 4, 8))
+        import random
+        rng = random.Random(3)
+        for _ in range(200):
+            h.observe(rng.uniform(0, 10))
+        s = parse_prometheus("\n".join(h.expose()) + "\n")["x"]["samples"]
+        buckets = {float(lab[0][1].replace("+Inf", "inf")): v
+                   for (name, lab), v in s.items() if name == "x_bucket"}
+        counts = [buckets[le] for le in sorted(buckets)]
+        assert counts == sorted(counts)  # cumulative ⇒ non-decreasing
+        assert counts[-1] == 200
+
+    def test_empty_buckets_rejected(self):
+        with pytest.raises(ValueError, match="at least one bucket"):
+            Histogram("x", buckets=())
+
+
+class TestRegistry:
+    def test_render_whole_registry(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "A.").inc(2)
+        reg.gauge("b", "B.").set(1.5)
+        reg.histogram("c_seconds", buckets=(1.0,)).observe(0.5)
+        fams = parse_prometheus(reg.render())
+        assert set(fams) == {"a_total", "b", "c_seconds"}
+        assert fams["b"]["samples"][("b", ())] == 1.5
+
+    def test_reregister_returns_same_instance(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x_total")
+        c2 = reg.counter("x_total")
+        assert c1 is c2
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_thread_safety_counts_exact(self):
+        """8 threads x 1000 incs: the registry lock discipline loses
+        nothing (the gateway's driver + HTTP threads hit this path)."""
+        reg = MetricsRegistry()
+        c = reg.counter("hits_total")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.value() == 8000

@@ -137,6 +137,7 @@ class TestExpertParallel:
         hlo = step.lower_text((x,), (x,))
         assert "all-to-all" in hlo
 
+    @pytest.mark.slow  # parity_vs_dense_ffn_oracle stays the default rep
     def test_mesh_parity_vs_meshless(self):
         """Group-wise dispatch on an ep4 mesh computes the same function as
         the meshless (G=1) path when capacity is non-binding."""

@@ -53,7 +53,12 @@ def test_fwd_matches_reference(causal, s, block):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("s,block", [(256, 128), (320, 128)])
+@pytest.mark.parametrize("s,block", [
+    (256, 128),
+    # padded-tail grads at 320 are slow-marked; the fwd test keeps the
+    # tail-block coverage (320 AND 384) in the default run
+    pytest.param(320, 128, marks=pytest.mark.slow),
+])
 def test_grads_match_reference(causal, s, block):
     q, k, v = _mk(2, s, 32, seed=1)
 

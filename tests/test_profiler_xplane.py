@@ -6,6 +6,8 @@ The wire-format reader is validated against an ACTUAL jax.profiler trace,
 so an xplane.proto schema drift fails here rather than in a bench run."""
 import tempfile
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +29,8 @@ def _capture_trace():
 
 
 class TestXPlaneStatistics:
+    @pytest.mark.slow  # live jax.profiler trace (~17s); the synthetic
+    # device-plane tests stay as the default-run wire-format reps
     def test_parses_real_trace_and_finds_the_dot(self):
         d = _capture_trace()
         files = _trace_files(d)

@@ -129,6 +129,8 @@ class TestRNNWrapperAndTraining:
         np.testing.assert_allclose(_np(y_tm), _np(y_bm).transpose(1, 0, 2),
                                    rtol=1e-5)
 
+    @pytest.mark.slow  # convergence run; fused-scan torch-parity tests
+    # stay as the default-run LSTM correctness reps
     def test_lstm_trains(self):
         paddle.seed(10)
         m = nn.LSTM(I, H)

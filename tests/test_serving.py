@@ -96,7 +96,7 @@ class TestEOS:
             prompt=_prompt(5), max_new_tokens=12, eos_token_id=eos))
         while eng.has_work():
             eng.step()
-        assert seq.finish_reason == "eos"
+        assert seq.finish_reason == "stop"  # OpenAI-style reason for EOS
         assert seq.tokens == free_run[:stop_at + 1]  # EOS included
         assert eng.cache.num_free == eng.num_slots
         assert eng.cache.lengths[seq.slot] == 0  # slot really reset
@@ -207,6 +207,111 @@ class TestCompileOnce:
         import math
         chunk = 16  # model.generate's engine decode_chunk
         assert decode_traces() - before <= int(math.log2(chunk)) + 1
+
+
+class TestFinishReasons:
+    """Engine-level finish_reason surface (no gateway involved): the
+    closed vocabulary stop|length|cancelled|timeout, surfaced both on
+    the Sequence handle and on generate()'s GenerationResult."""
+
+    def test_generate_results_carry_finish_reason(self, model):
+        from paddle_tpu.serving import GenerationResult
+        eng = _engine(model)
+        probe = eng.generate([GenerationRequest(prompt=_prompt(30),
+                                                max_new_tokens=8)])[0]
+        eos = probe[2]
+        outs = eng.generate([
+            GenerationRequest(prompt=_prompt(30), max_new_tokens=8,
+                              eos_token_id=int(eos)),
+            GenerationRequest(prompt=_prompt(31), max_new_tokens=4)])
+        assert all(isinstance(o, GenerationResult) for o in outs)
+        assert outs[0].finish_reason == "stop"
+        assert outs[1].finish_reason == "length"
+        # array-likeness: the old ndarray call sites keep working
+        assert len(outs[1]) == 4
+        np.testing.assert_array_equal(np.stack([outs[1], outs[1]])[0],
+                                      outs[1].ids)
+
+    def test_cancel_running_frees_slot_mid_decode(self, model):
+        eng = _engine(model, decode_chunk=1)
+        victim = eng.submit(GenerationRequest(prompt=_prompt(32),
+                                              max_new_tokens=30))
+        bystander = eng.submit(GenerationRequest(prompt=_prompt(33),
+                                                 max_new_tokens=10))
+        solo = _solo(model, bystander.request)
+        for _ in range(4):
+            eng.step()
+        assert victim.status == "running"
+        free_before = eng.cache.num_free
+        assert eng.cancel(victim) is True
+        assert victim.finish_reason == "cancelled"
+        assert eng.cache.num_free == free_before + 1  # slot back NOW
+        assert eng.cache.lengths[victim.slot] == 0
+        assert eng.cancel(victim) is False  # idempotent on finished
+        while eng.has_work():
+            eng.step()
+        assert bystander.tokens == solo  # cancel never perturbs others
+        assert eng.stats["cancelled"] == 1
+
+    def test_cancel_queued_never_prefills(self, model):
+        eng = _engine(model, num_slots=1)
+        hog = eng.submit(GenerationRequest(prompt=_prompt(34),
+                                           max_new_tokens=6))
+        queued = eng.submit(GenerationRequest(prompt=_prompt(35),
+                                              max_new_tokens=6))
+        eng.step()  # hog takes the only slot
+        assert queued.status == "queued"
+        assert eng.cancel(queued) is True
+        while eng.has_work():
+            eng.step()
+        assert queued.finish_reason == "cancelled"
+        assert eng.stats["prefills"] == 1  # only the hog ever prefilled
+        assert hog.finish_reason == "length"
+
+    def test_timeout_running_and_queued(self, model):
+        import time as _time
+        eng = _engine(model, num_slots=1, max_seq_len=64, decode_chunk=1)
+        # warm the programs so the deadline measures steps, not compiles
+        eng.generate([GenerationRequest(prompt=_prompt(36),
+                                        max_new_tokens=2)])
+        runner = eng.submit(GenerationRequest(
+            prompt=_prompt(36), max_new_tokens=50, timeout_s=0.03))
+        starved = eng.submit(GenerationRequest(
+            prompt=_prompt(37), max_new_tokens=4, timeout_s=0.01))
+        prefills0 = eng.stats["prefills"]
+        while eng.has_work():
+            eng.step()
+            _time.sleep(0.002)  # keep wall moving on fast boxes
+        assert runner.finish_reason == "timeout"
+        assert 0 < len(runner.tokens) < 50  # partial output preserved
+        # the starved request expired in the queue: no slot, no prefill
+        # (the +1 is the runner's own admission)
+        assert starved.finish_reason == "timeout"
+        assert starved.tokens == []
+        assert eng.stats["prefills"] == prefills0 + 1
+        assert eng.stats["timeouts"] == 2
+        assert eng.cache.num_free == eng.num_slots
+
+    def test_timeout_validation(self, model):
+        eng = _engine(model)
+        with pytest.raises(ValueError, match="timeout_s"):
+            eng.submit(GenerationRequest(prompt=_prompt(38),
+                                         max_new_tokens=2, timeout_s=0))
+
+    def test_on_token_callback_streams_every_token(self, model):
+        """on_token fires once per generated token in order, including
+        the prefill-sampled first token — the gateway's wire."""
+        eng = _engine(model, decode_chunk=1)
+        seen = []
+        eng.on_token = lambda seq, tok: seen.append((seq.request_id, tok))
+        done = []
+        eng.on_finish = lambda seq: done.append(seq.request_id)
+        seq = eng.submit(GenerationRequest(prompt=_prompt(39),
+                                           max_new_tokens=5))
+        while eng.has_work():
+            eng.step()
+        assert [t for _, t in seen] == seq.tokens
+        assert done == [seq.request_id]
 
 
 class TestKVCacheManager:

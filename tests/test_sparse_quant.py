@@ -153,6 +153,34 @@ class TestQuantization:
         out = cm(x).numpy()
         assert np.max(np.abs(out - ref)) < 0.15
 
+    def test_converted_linear_dequant_follows_input_dtype(self):
+        """The int8 inference path composes with bf16 autocast: the
+        dequantized weight follows the INPUT dtype instead of forcing
+        fp32 (which silently promoted the whole matmul back)."""
+        import jax.numpy as jnp
+        m = self._model()
+        ptq = PTQ(QuantConfig(weight_bits=8, activation_bits=8))
+        om = ptq.quantize(m)
+        x32 = paddle.to_tensor(
+            np.random.RandomState(4).randn(4, 8).astype(np.float32))
+        om(x32)
+        cm = ptq.convert(om)
+        ref = cm(x32).numpy()
+
+        # direct bf16 input (no autocast): output stays bf16
+        x16 = paddle.to_tensor(x32.value.astype(jnp.bfloat16))
+        out16 = cm(x16)
+        assert out16.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out16.value.astype(jnp.float32), ref, atol=0.1)
+
+        # under autocast O1 the quantized forward runs end-to-end bf16
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out_ac = cm(x32)
+        assert out_ac.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out_ac.value.astype(jnp.float32), ref, atol=0.1)
+
 
 class TestTensorToSparseR5:
     """Tensor.to_sparse_coo / to_sparse_csr method spellings vs scipy."""

@@ -169,6 +169,46 @@ class TestAMP:
         opt.clear_grad()
         assert scaler.state_dict()["scale"] == 128.0
 
+    def test_grad_scaler_state_roundtrip_keeps_schedule(self):
+        """state_dict must carry the WHOLE loss-scale schedule (enable
+        flag + incr/decr cadence + step counters), so a resumed fp16
+        run continues the schedule instead of restarting it."""
+        src = paddle.amp.GradScaler(
+            init_loss_scaling=4096.0, incr_ratio=3.0, decr_ratio=0.25,
+            incr_every_n_steps=5, decr_every_n_nan_or_inf=3)
+        # advance mid-window: 4 good steps (one short of an increase)
+        for _ in range(4):
+            src._found_inf = False
+            src._unscaled = True
+            src.update()
+        state = src.state_dict()
+        assert state["enable"] is True
+        assert state["incr_every_n_steps"] == 5
+        assert state["decr_every_n_nan_or_inf"] == 3
+        assert state["use_dynamic_loss_scaling"] is True
+        assert state["good_steps"] == 4
+
+        # resume into a default-constructed scaler: one more good step
+        # must trigger the increase at the LOADED cadence and ratio
+        dst = paddle.amp.GradScaler()
+        dst.load_state_dict(state)
+        dst._found_inf = False
+        dst._unscaled = True
+        dst.update()
+        assert dst.get_init_loss_scaling() == 4096.0 * 3.0
+        # and the loaded decr window drives the backoff cadence too
+        for _ in range(3):
+            dst._found_inf = True
+            dst._unscaled = True
+            dst.update()
+        assert dst.get_init_loss_scaling() == 4096.0 * 3.0 * 0.25
+
+    def test_grad_scaler_disabled_roundtrip(self):
+        src = paddle.amp.GradScaler(enable=False)
+        dst = paddle.amp.GradScaler(enable=True)
+        dst.load_state_dict(src.state_dict())
+        assert dst.is_enable() is False  # passthrough survives resume
+
 
 class TestHapiModel:
     def test_fit_evaluate(self):

@@ -15,15 +15,20 @@ def _img(n=1, c=3, hw=64):
 
 class TestNewZooForwardShapes:
     # the conv-heaviest ctors are slow-marked (VERDICT r5 weak 3: suite
-    # wall time); squeezenet/shufflenet/mobilenet_v1 stay as the default
-    # run's zoo representatives
+    # wall time; widened this round to fit the 870s tier-1 cap after the
+    # serving-gateway suite landed): shufflenet_v2_x0_5 is the default
+    # run's zoo forward-shape representative — squeezenet keeps its
+    # train-step default below, every other arch runs under `-m slow`
     @pytest.mark.parametrize("ctor", [
         pytest.param(M.densenet121, marks=pytest.mark.slow),
-        M.squeezenet1_0, M.squeezenet1_1, M.mobilenet_v1,
+        pytest.param(M.squeezenet1_0, marks=pytest.mark.slow),
+        pytest.param(M.squeezenet1_1, marks=pytest.mark.slow),
+        pytest.param(M.mobilenet_v1, marks=pytest.mark.slow),
         pytest.param(M.mobilenet_v3_small, marks=pytest.mark.slow),
         pytest.param(M.mobilenet_v3_large, marks=pytest.mark.slow),
-        M.shufflenet_v2_x0_25,
-        M.shufflenet_v2_x0_5, M.shufflenet_v2_swish,
+        pytest.param(M.shufflenet_v2_x0_25, marks=pytest.mark.slow),
+        M.shufflenet_v2_x0_5,
+        pytest.param(M.shufflenet_v2_swish, marks=pytest.mark.slow),
     ], ids=lambda f: f.__name__)
     def test_forward_shape(self, ctor):
         m = ctor(num_classes=7)
@@ -59,6 +64,7 @@ class TestNewZooForwardShapes:
         m.eval()
         assert m(_img()).shape == [1, 3]
 
+    @pytest.mark.slow  # mobilenet_v3 trunk = ~25s of conv compiles
     def test_feature_mode_no_head(self):
         m = M.mobilenet_v3_small(num_classes=0, with_pool=False)
         m.eval()
