@@ -459,6 +459,15 @@ def watchdog():
     sh = _parse_result(rc, out)
     cb_extra["serve_http"] = sh if sh is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Prefix-cache leg: prefill-work reduction + hit-rate on the
+    # shared-system-prompt trace (scripts/bench_prefix.py). Same
+    # hang-proof contract: scheduling/caching win is platform-agnostic,
+    # CPU-forced, banked before the tunnel can wedge anything.
+    rc, out, err = _run([me, "--prefix-cache"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    pf = _parse_result(rc, out)
+    cb_extra["prefix_cache"] = pf if pf is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -592,6 +601,13 @@ if __name__ == "__main__":
         from bench_serve import measure_serve_http
         print(json.dumps({"name": "serve_http", "ok": True,
                           **measure_serve_http(quick=True)}))
+        sys.exit(0)
+    if "--prefix-cache" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_prefix import measure_prefix_cache
+        print(json.dumps({"name": "prefix_cache", "ok": True,
+                          **measure_prefix_cache(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -58,13 +58,42 @@ def init_parallel_env():
             store = connect(kv)
             key = (f"/job/{os.environ.get('PADDLE_JOB_ID', 'default')}"
                    f"/jaxcoord")
+            probe = None
             if pid == 0:
                 import socket
-                s = socket.socket()
-                s.bind((host or "127.0.0.1", 0))
-                master = f"{host or '127.0.0.1'}:{s.getsockname()[1]}"
-                s.close()  # freed instants before jax re-binds it
-                store.put(key, master)
+                # TOCTOU fix (ADVICE r5): the seed closed the probe
+                # socket BEFORE publishing, leaving a window where any
+                # other process could grab the port between our close()
+                # and jax's bind(). THE protection is holding the bound
+                # probe open through publish and the peers' polling,
+                # closing it only just before jax.distributed.initialize
+                # — the race window shrinks from a full rendezvous
+                # round-trip to microseconds. SO_REUSEADDR is only
+                # belt-and-braces for retry/relaunch cycles where the
+                # picked port may linger in TIME_WAIT; it does NOT let
+                # the coordinator bind while the probe is still open.
+                for _ in range(8):  # retry the pick-publish cycle
+                    s = socket.socket()
+                    s.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+                    try:
+                        s.bind((host or "127.0.0.1", 0))
+                    except OSError:
+                        s.close()
+                        continue
+                    master = (f"{host or '127.0.0.1'}:"
+                              f"{s.getsockname()[1]}")
+                    probe = s
+                    break
+                else:
+                    raise RuntimeError(
+                        "could not bind a coordinator port on "
+                        f"{host or '127.0.0.1'} after 8 attempts")
+                try:
+                    store.put(key, master)
+                except BaseException:
+                    probe.close()
+                    raise
             else:
                 import time as _time
                 deadline = _time.time() + 60.0
@@ -74,6 +103,8 @@ def init_parallel_env():
                             "rank 0 never published the jax coordinator "
                             "endpoint")
                     _time.sleep(0.1)
+            if probe is not None:
+                probe.close()  # released instants before jax binds it
         jax.distributed.initialize(coordinator_address=master,
                                    num_processes=nproc, process_id=pid)
     _STATE["initialized"] = True

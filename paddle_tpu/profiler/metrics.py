@@ -223,7 +223,14 @@ class _Metric:
 
 
 class Counter(_Metric):
-    """Monotonically increasing value (e.g. total tokens generated)."""
+    """Monotonically increasing value (e.g. total tokens generated).
+
+    ``set_fn`` registers a callable sampled at scrape time, for counters
+    whose source of truth is an existing monotonic count elsewhere (the
+    serving gateway points the prefix-cache hit/miss/eviction counters
+    at the cache's own stats dict this way). The callable must be
+    monotonically non-decreasing — Prometheus counter semantics — and a
+    series is either incremented or fn-backed, never both."""
 
     kind = "counter"
 
@@ -232,14 +239,35 @@ class Counter(_Metric):
             raise ValueError(f"counter {self.name} cannot decrease")
         key = self._key(labels)
         with self._lock:
-            self._series[key] = self._series.get(key, 0) + value
+            cur = self._series.get(key, 0)
+            if callable(cur):
+                raise ValueError(
+                    f"counter {self.name} series is scrape-time (set_fn); "
+                    f"inc() would fork its source of truth")
+            self._series[key] = cur + value
+
+    def set_fn(self, fn, **labels):
+        key = self._key(labels)
+        with self._lock:
+            cur = self._series.get(key)
+            if cur is not None and not callable(cur) and cur != 0:
+                # the registry dedupes by name, so a second component
+                # can reach a counter someone else already inc()'d;
+                # silently replacing its accumulated count would scrape
+                # as a spurious counter reset
+                raise ValueError(
+                    f"counter {self.name} series already holds "
+                    f"incremented value {cur}; set_fn() would discard it")
+            self._series[key] = fn
 
     def value(self, **labels):
         with self._lock:
-            return self._series.get(self._key(labels), 0)
+            v = self._series.get(self._key(labels), 0)
+        return v() if callable(v) else v
 
     def _sample_lines(self, labels, state):
-        return [f"{self.name}{_label_str(labels)} {_fmt_value(state)}"]
+        v = state() if callable(state) else state
+        return [f"{self.name}{_label_str(labels)} {_fmt_value(v)}"]
 
 
 class Gauge(_Metric):

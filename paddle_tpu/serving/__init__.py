@@ -18,13 +18,19 @@ Public surface:
 - :class:`FIFOScheduler` — admission + fused-chunk step policy
 - :class:`ContinuousBatchingEngine` — the step-function serving API
   (``cancel()``, deadline sweeps, ``on_token``/``on_finish`` streaming
-  hooks)
+  hooks; ``prefix_cache=True`` turns on automatic prefix caching)
+- :class:`BlockManager` / :class:`PrefixCache` — the block-granular
+  prefix-cache subsystem: ref-counted KV block pool + hash-trie over
+  prompt token blocks with LRU eviction (README "Automatic prefix
+  caching")
 
 The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
 (imported lazily — the engine has no HTTP dependency).
 """
+from .block_manager import BlockManager
 from .engine import ContinuousBatchingEngine
 from .kv_cache import SlotKVCache
+from .prefix_cache import PrefixCache
 from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
                       Sequence)
 from .scheduler import FIFOScheduler
@@ -32,4 +38,5 @@ from .scheduler import FIFOScheduler
 __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
     "Sequence", "SlotKVCache", "FIFOScheduler", "FINISH_REASONS",
+    "BlockManager", "PrefixCache",
 ]

@@ -1,0 +1,89 @@
+"""Ref-counted block pool backing the automatic prefix cache.
+
+The pool is the block-granular half of the serving KV story ("Ragged
+Paged Attention", PAPERS.md): two dense device arrays
+``[L, num_blocks, block_size, Hkv, D]`` holding published prompt-prefix
+KV blocks, plus host-side bookkeeping — a free-block min-heap (same
+O(log n) allocator discipline as :class:`~.kv_cache.SlotKVCache`) and a
+per-block reference count.
+
+Division of labor: this class owns *physical* blocks (allocation,
+refcounts, storage); :class:`~.prefix_cache.PrefixCache` owns *logical*
+identity (the hash-trie from token content to block id, LRU eviction
+order, hit/miss accounting). Blocks move between them only through the
+compile-once copy programs in ``kv_cache.py`` — a published block is
+written exactly once (at publish) and only ever read afterwards, so
+sharing a block between concurrent sequences can never alias their
+divergent continuations (each hit COPIES the block into the private
+slot; see the COW note in ``prefix_cache.py``).
+"""
+from __future__ import annotations
+
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockManager:
+    """Physical block pool: device arrays + free heap + refcounts."""
+
+    def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
+                 head_dim, dtype=jnp.float32):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        shape = (num_layers, self.num_blocks, self.block_size,
+                 num_kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._free_heap = list(range(self.num_blocks))
+        self._free_set = set(self._free_heap)
+        self._ref = np.zeros(self.num_blocks, np.int32)
+
+    # ---------------------------------------------------------- allocator
+    @property
+    def num_free(self) -> int:
+        return len(self._free_set)
+
+    @property
+    def num_used(self) -> int:
+        """Live blocks (published + pinned) — the ``kv_prefix_blocks``
+        gauge on ``/metrics``."""
+        return self.num_blocks - self.num_free
+
+    def alloc(self):
+        """Claim a free block (lowest id first, deterministic); None when
+        the pool is exhausted (the caller evicts or skips publishing)."""
+        if not self._free_set:
+            return None
+        block = heapq.heappop(self._free_heap)
+        self._free_set.discard(block)
+        return block
+
+    def free(self, block: int):
+        if block in self._free_set:
+            raise ValueError(f"block {block} double-freed")
+        if self._ref[block]:
+            raise ValueError(
+                f"block {block} freed with refcount {int(self._ref[block])}")
+        heapq.heappush(self._free_heap, block)
+        self._free_set.add(block)
+
+    # ---------------------------------------------------------- refcounts
+    def ref(self, block: int):
+        """Pin a block (a sequence's admission matched it)."""
+        self._ref[block] += 1
+
+    def unref(self, block: int) -> int:
+        """Release one pin; returns the remaining count."""
+        if self._ref[block] <= 0:
+            raise ValueError(f"block {block} unref'd below zero")
+        self._ref[block] -= 1
+        return int(self._ref[block])
+
+    def refcount(self, block: int) -> int:
+        return int(self._ref[block])

@@ -145,6 +145,117 @@ def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied):
         tied=tied))
 
 
+# ------------------------------------------------------------ suffix prefill
+def _apply_rope_grid(x, sin_p, cos_p):
+    """Rope with a different position per (row, column) — suffix prefill.
+
+    x: [G, S, H, D]; sin_p/cos_p: [G, S, D] gathered at each token's
+    global position (prefix offset + column).
+    """
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos_p[:, :, None, :]
+            + rotated * sin_p[:, :, None, :]).astype(x.dtype)
+
+
+def _suffix_prefill_impl(params, cache_k, cache_v, slots, prefix_lens, ids,
+                         suffix_lens, keys, temps, top_ks, *, nh, nkv, hd,
+                         eps, theta, tied):
+    """Prefill only the UNCOVERED suffix of prompts whose leading blocks
+    a prefix-cache hit already installed into their slots.
+
+    ids: [G, S_pad] right-padded suffix token ids; prefix_lens: [G] rows
+    already valid in each row's slot (the installed cached blocks);
+    suffix_lens: [G] real suffix token counts; slots: [G] slot indices
+    (padding rows carry ``num_slots`` so their writes drop).
+
+    Each suffix token at column i lives at global position
+    ``prefix_lens[g] + i``: its K/V scatter into the slot at that row
+    (rope'd at that position) and its query attends over rows
+    ``0..pos`` — cached prefix plus the suffix written so far, exactly
+    the rows a cold full prefill would attend. Shapes depend only on
+    (G_pad, S_pad, cache geometry): prefix/suffix lengths, slot ids, and
+    sampling knobs are runtime arrays, so compilations stay bounded by
+    the same pow2 buckets as the cold prefill.
+
+    Returns (cache_k', cache_v', tok0, keys').
+    """
+    G, S = ids.shape
+    num_slots, s_max = cache_k.shape[1], cache_k.shape[2]
+    sin, cos = _rope_tables(s_max, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    # gather each row's slot cache: [L, G, s_max, Hkv, D]. Padding rows
+    # point at slot index num_slots — the gather clips (harmless read of
+    # the last slot), every write below drops.
+    kc0 = jnp.take(cache_k, slots, axis=1, mode="clip")
+    vc0 = jnp.take(cache_v, slots, axis=1, mode="clip")
+    pos = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    sin_p = jnp.take(sin, pos, axis=0, mode="clip")   # [G, S, D]
+    cos_p = jnp.take(cos, pos, axis=0, mode="clip")
+    g_idx = jnp.arange(G)[:, None]
+    rows = jnp.arange(s_max, dtype=jnp.int32)
+    # causal-over-ragged mask: query at global pos p sees rows r <= p
+    mask = rows[None, None, :] <= pos[:, :, None]        # [G, S, s_max]
+    # rows ever valid in this slot (prefix + the S suffix writes); rows
+    # past that may hold a prior sequence's garbage — zeroed out of PV
+    row_valid = rows[None, :] < (prefix_lens + S)[:, None]  # [G, s_max]
+    grp = nh // nkv
+    scale = 1.0 / (hd ** 0.5)
+
+    def layer(h, lp):
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv) = lp
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope_grid(q, sin_p, cos_p)
+        k = _apply_rope_grid(k, sin_p, cos_p)
+        # ragged scatter: column i appends at its row's prefix_len + i;
+        # out-of-range positions (padding rows, clamped tails) drop
+        ck = ck.at[g_idx, pos].set(k, mode="drop")
+        cv = cv.at[g_idx, pos].set(v, mode="drop")
+        kf = jnp.repeat(ck, grp, axis=2) if grp > 1 else ck
+        vf = jnp.repeat(cv, grp, axis=2) if grp > 1 else cv
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # exact zeros on masked cols + zeroed garbage rows: stale cache
+        # rows can be anything (0 * NaN = NaN)
+        probs = jnp.where(mask[:, None], probs, 0.0)
+        vf = jnp.where(row_valid[:, :, None, None], vf, 0.0)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vf)
+        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(G, S, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (ck, cv)
+
+    x = jnp.take(params["embed"], ids, axis=0)
+    x, (nkc, nvc) = jax.lax.scan(layer, x, stack + (kc0, vc0))
+    last = jnp.take_along_axis(
+        x, (suffix_lens - 1)[:, None, None], axis=1)[:, 0]  # [G, H]
+    last_h = _rms(last, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    both = jax.vmap(jax.random.split)(keys)  # [G, 2, 2]
+    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
+    # scatter the updated per-slot caches back (padding rows drop)
+    cache_k = cache_k.at[:, slots].set(nkc, mode="drop")
+    cache_v = cache_v.at[:, slots].set(nvc, mode="drop")
+    return cache_k, cache_v, tok0, both[:, 0]
+
+
+def build_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied, donate=None):
+    """One jitted suffix prefill; retraces per (group, suffix-bucket)
+    shape — both padded to powers of two by the engine, same bounded
+    compile set as the cold prefill."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(_suffix_prefill_impl, nh=nh, nkv=nkv, hd=hd,
+                          eps=eps, theta=theta, tied=tied),
+        donate_argnums=(1, 2) if donate else ())
+
+
 # -------------------------------------------------------------- decode step
 def _decode_steps_impl(params, cache_k, cache_v, tokens, lengths, keys,
                        temps, top_ks, *, n_steps, nh, nkv, hd, eps, theta,

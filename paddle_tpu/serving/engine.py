@@ -34,17 +34,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import build_decode_steps_fn, build_prefill_fn, \
-    llama_decode_params
+    build_suffix_prefill_fn, llama_decode_params
 from .kv_cache import SlotKVCache
 from .request import GenerationRequest, GenerationResult, Sequence
 from .scheduler import FIFOScheduler
 
 
 class ContinuousBatchingEngine:
-    """Slot-based continuous batching over a LLaMA-family model."""
+    """Slot-based continuous batching over a LLaMA-family model.
+
+    ``prefix_cache=True`` enables automatic prefix caching
+    (``serving/prefix_cache.py``): retiring sequences publish their
+    prompt's full KV blocks into a ref-counted LRU pool, and a new
+    admission whose prompt shares a cached block chain installs it with
+    compile-once copy programs and prefills only the uncovered suffix.
+    Pass a :class:`~.prefix_cache.PrefixCache` instance to carry one
+    pool across successive engines — ONLY when every engine is driven
+    from the same single thread (the cache is lock-free by the engine's
+    single-driver contract; two concurrently-stepping engines, e.g. two
+    gateways, must not share one). Its pool geometry must match this
+    engine's layers/heads/dtype. ``prefix_blocks``/``prefix_block_size``
+    size the pool the engine builds itself (default: enough blocks to
+    cache ``num_slots`` full-length prompts at 32-token granularity).
+    """
 
     def __init__(self, model, num_slots=8, max_seq_len=None, decode_chunk=8,
-                 prefill_bucketing="pow2", jit_cache=None):
+                 prefill_bucketing="pow2", jit_cache=None,
+                 prefix_cache=False, prefix_blocks=None,
+                 prefix_block_size=32):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -64,6 +81,36 @@ class ContinuousBatchingEngine:
             c.num_hidden_layers, self.num_slots, self.max_seq_len,
             c.num_key_value_heads, c.head_dim,
             dtype=self._params["embed"].dtype)
+        self.prefix_cache = None
+        if prefix_cache:
+            from .block_manager import BlockManager
+            from .prefix_cache import PrefixCache
+            if isinstance(prefix_cache, PrefixCache):
+                # fail fast on a geometry mismatch: copies between the
+                # pool and this cache would otherwise die mid-serving
+                # with an opaque XLA shape/dtype error on the first hit
+                pool = prefix_cache.pool
+                want = (self.cache.k.shape[0],) + self.cache.k.shape[3:]
+                have = (pool.k.shape[0],) + pool.k.shape[3:]
+                if have != want or pool.k.dtype != self.cache.k.dtype:
+                    raise ValueError(
+                        f"shared PrefixCache pool geometry "
+                        f"{have}/{pool.k.dtype} does not match this "
+                        f"engine's cache {want}/{self.cache.k.dtype}")
+                self.prefix_cache = prefix_cache
+            else:
+                bs = int(prefix_block_size)
+                if bs < 1:
+                    raise ValueError(
+                        f"prefix_block_size must be >= 1, got {bs}")
+                if prefix_blocks is None:
+                    nb = self.num_slots * max(self.max_seq_len // bs, 1)
+                else:
+                    nb = int(prefix_blocks)  # 0/negative: BlockManager
+                    # raises rather than silently falling back to default
+                self.prefix_cache = PrefixCache(BlockManager(
+                    c.num_hidden_layers, nb, bs, c.num_key_value_heads,
+                    c.head_dim, dtype=self._params["embed"].dtype))
         self.scheduler = FIFOScheduler(decode_chunk)
         self._slots = [None] * self.num_slots
         self._last_tok = np.zeros(self.num_slots, np.int32)
@@ -77,6 +124,7 @@ class ContinuousBatchingEngine:
         self.stats = {"steps": 0, "decode_calls": 0, "decode_steps": 0,
                       "slot_steps": 0, "active_slot_steps": 0,
                       "prefills": 0, "prefill_tokens": 0,
+                      "prefill_tokens_saved": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
@@ -100,6 +148,12 @@ class ContinuousBatchingEngine:
             self._jit[key] = build_prefill_fn(**self._fn_consts())
         return self._jit[key]
 
+    def _suffix_fn(self):
+        key = ("suffix",)
+        if key not in self._jit:
+            self._jit[key] = build_suffix_prefill_fn(**self._fn_consts())
+        return self._jit[key]
+
     def _decode_fn(self, n_steps):
         key = ("decode", int(n_steps), self.config.decode_attention)
         if key not in self._jit:
@@ -115,6 +169,13 @@ class ContinuousBatchingEngine:
         how request sampling params / token budgets vary."""
         return sum(fn._cache_size() for key, fn in self._jit.items()
                    if key[0] == "decode")
+
+    def prefill_compilations(self) -> int:
+        """Prefill-side traces, cold + suffix: bounded by the pow2
+        (group, bucket) grid — independent of the hit/miss/eviction mix
+        (the bounded-compile half of the prefix-cache contract)."""
+        return sum(fn._cache_size() for key, fn in self._jit.items()
+                   if key[0] in ("prefill", "suffix"))
 
     # ------------------------------------------------------------- intake
     def _key_for(self, request):
@@ -176,16 +237,50 @@ class ContinuousBatchingEngine:
         return True
 
     # ------------------------------------------------------------ stepping
+    def _admission_hit_len(self, seq):
+        """THE prefix lookup for one admitted sequence (the scheduler
+        calls this once per pop): records hit/miss stats, pins the
+        matched chain immediately — before any admission this step can
+        publish-and-evict — and stores it on the sequence for
+        _admit_group to install. Returns the covered token count."""
+        matched = self.prefix_cache.lookup(seq.prompt)
+        if matched:
+            self.prefix_cache.acquire(matched)
+            seq.prefix_nodes = matched
+        return self.prefix_cache.block_size * len(matched)
+
     def _bucket(self, plen):
         if self._bucketing == "exact":
             return plen
         return min(max(8, 1 << (plen - 1).bit_length()), self.max_seq_len)
 
     def _admit_group(self, seqs, finished):
-        """Admit a batch of sequences: ONE prefill device call per
-        prompt-length bucket, with the group dim padded to a power of
-        two so compile count stays bounded at
-        O(log(num_slots) × buckets)."""
+        """Admit a batch of sequences. With the prefix cache enabled the
+        batch splits on cached-chain lookup: misses take the cold path
+        (ONE full-prompt prefill device call per prompt-length bucket),
+        hits install their cached blocks and take the suffix path (ONE
+        suffix prefill per suffix-length bucket). Both pad the group dim
+        to a power of two, so compile count stays bounded at
+        O(log(num_slots) × buckets) regardless of the hit mix."""
+        cold, hits = [], []
+        for seq in seqs:
+            # the lookup already ran (and pinned) in _admission_hit_len
+            # at scheduler pop time — before ANY admission this step: a
+            # cold sequence retiring instantly (max_new_tokens=1 /
+            # immediate EOS) publishes inside _admit_cold, and under
+            # pool pressure that publish evicts; an unpinned matched
+            # chain could be reaped and its block re-used before
+            # _admit_hits copies from it
+            if seq.prefix_nodes:
+                hits.append((seq, seq.prefix_nodes))
+            else:
+                cold.append(seq)
+        if cold:
+            self._admit_cold(cold, finished)
+        if hits:
+            self._admit_hits(hits, finished)
+
+    def _admit_cold(self, seqs, finished):
         by_bucket = {}
         for seq in seqs:
             by_bucket.setdefault(self._bucket(seq.prompt_len), []).append(seq)
@@ -208,23 +303,89 @@ class ContinuousBatchingEngine:
                 temps, topks)
             tok0s = np.asarray(tok0s)
             for i, seq in enumerate(group):
-                req = seq.request
                 slot = self.cache.alloc()
                 self.cache.write_prefill(slot, pk[:, i], pv[:, i],
                                          seq.prompt_len)
+                self._install_seq(seq, slot, tok0s[i], keys2[i],
+                                  seq.prompt_len, finished)
+
+    def _admit_hits(self, hits, finished):
+        """Admit prefix-cache hits: install each sequence's matched
+        chain into its slot (compile-once block copies), then ONE
+        suffix-prefill device call per suffix-length bucket covering
+        only the uncovered prompt tails. Group padding rows carry slot
+        index ``num_slots`` and prefix ``max_seq_len`` so every one of
+        their cache writes drops inside the jitted program."""
+        pc = self.prefix_cache
+        bs = pc.block_size
+        by_bucket = {}
+        for seq, matched in hits:
+            suffix_len = seq.prompt_len - len(matched) * bs
+            by_bucket.setdefault(self._bucket(suffix_len),
+                                 []).append((seq, matched))
+        for s_pad, group in sorted(by_bucket.items()):
+            G = len(group)
+            Gp = 1 << (G - 1).bit_length()
+            slots = np.full(Gp, self.num_slots, np.int32)   # writes drop
+            prefix_lens = np.full(Gp, self.max_seq_len, np.int32)
+            ids = np.zeros((Gp, s_pad), np.int32)
+            suf_lens = np.ones(Gp, np.int32)
+            temps = np.zeros(Gp, np.float32)
+            topks = np.zeros(Gp, np.int32)
+            keys = np.zeros((Gp, 2), np.uint32)
+            for i, (seq, matched) in enumerate(group):
+                # chain already pinned + prefix_hit_tokens already set
+                # by _admission_hit_len at scheduler pop time
+                covered = len(matched) * bs
+                slot = self.cache.alloc()
                 seq.slot = slot
-                seq.status = "running"
-                seq.tokens = [int(tok0s[i])]
-                self._slots[slot] = seq
-                self._last_tok[slot] = seq.tokens[0]
-                self._temps[slot] = float(req.temperature)
-                self._topks[slot] = int(req.top_k)
-                self._keys = self._keys.at[slot].set(keys2[i])
-                self.stats["prefills"] += 1
-                self.stats["prefill_tokens"] += seq.prompt_len
-                self.stats["tokens_generated"] += 1
-                self._emit(seq, seq.tokens[0])
-                self._maybe_finish(seq, finished)
+                for j, node in enumerate(matched):
+                    self.cache.copy_block_in(slot, j * bs, pc.pool,
+                                             node.block_id)
+                suffix = seq.prompt[covered:]
+                ids[i, :len(suffix)] = suffix
+                suf_lens[i] = len(suffix)
+                prefix_lens[i] = covered
+                slots[i] = slot
+                temps[i] = float(seq.request.temperature)
+                topks[i] = int(seq.request.top_k)
+                keys[i] = np.asarray(seq.key)
+            nk, nv, tok0s, keys2 = self._suffix_fn()(
+                self._params, self.cache.k, self.cache.v,
+                jnp.asarray(slots), jnp.asarray(prefix_lens),
+                jnp.asarray(ids), jnp.asarray(suf_lens),
+                jnp.asarray(keys), temps, topks)
+            self.cache.update(nk, nv)
+            tok0s = np.asarray(tok0s)
+            for i, (seq, matched) in enumerate(group):
+                slot = seq.slot
+                self.cache.lengths[slot] = seq.prompt_len
+                self.stats["prefill_tokens_saved"] += seq.prefix_hit_tokens
+                self._install_seq(seq, slot, tok0s[i], keys2[i],
+                                  seq.prompt_len - seq.prefix_hit_tokens,
+                                  finished)
+
+    def _install_seq(self, seq, slot, tok0, key2, prefilled_tokens,
+                     finished):
+        """Post-prefill slot bookkeeping shared by the cold and hit
+        admission paths — the ONE place a future per-slot knob gets
+        wired, so the two paths cannot silently diverge.
+        ``prefilled_tokens`` is the device prefill work actually done
+        (full prompt cold, uncovered suffix on a hit)."""
+        req = seq.request
+        seq.slot = slot
+        seq.status = "running"
+        seq.tokens = [int(tok0)]
+        self._slots[slot] = seq
+        self._last_tok[slot] = seq.tokens[0]
+        self._temps[slot] = float(req.temperature)
+        self._topks[slot] = int(req.top_k)
+        self._keys = self._keys.at[slot].set(key2)
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += int(prefilled_tokens)
+        self.stats["tokens_generated"] += 1
+        self._emit(seq, seq.tokens[0])
+        self._maybe_finish(seq, finished)
 
     def _maybe_finish(self, seq, finished):
         req = seq.request
@@ -246,7 +407,16 @@ class ContinuousBatchingEngine:
             self._temps[slot] = 0.0
             self._topks[slot] = 0
             self._last_tok[slot] = 0
+            if self.prefix_cache is not None:
+                # publish BEFORE freeing: the slot's prompt rows are
+                # intact (decode only ever appended past them) and the
+                # sequence's own pins still shield its matched chain
+                # from eviction during the publish walk
+                self.prefix_cache.publish(seq.prompt, slot, self.cache)
             self.cache.free(slot)
+        if self.prefix_cache is not None and seq.prefix_nodes:
+            self.prefix_cache.release(seq.prefix_nodes)
+            seq.prefix_nodes = []
         finished.append(seq)
         if self.on_finish is not None:
             self.on_finish(seq)
@@ -283,7 +453,10 @@ class ContinuousBatchingEngine:
         self._expire_deadlines(
             list(self.scheduler.queue)
             + [s for s in self._slots if s is not None], finished)
-        admitted = self.scheduler.admissions(self.cache.num_free)
+        admitted = self.scheduler.admissions(
+            self.cache.num_free,
+            hit_len_fn=self._admission_hit_len
+            if self.prefix_cache is not None else None)
         if admitted:
             self._admit_group(admitted, finished)
         active = [s for s in self._slots if s is not None]

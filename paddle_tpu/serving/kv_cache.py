@@ -3,7 +3,7 @@
 The cache is two dense arrays ``[L, num_slots, max_seq_len, Hkv, D]``
 (the paddle cache layout the ragged Pallas decode kernel reads in place,
 ``kernels/pallas_decode.py``) plus a host-side ``lengths[num_slots]``
-mirror and a free-slot list. "Paged" here is at slot granularity — the
+mirror and a free-slot pool. "Paged" here is at slot granularity — the
 TPU-friendly degenerate page size of one sequence per page: admission
 claims a free slot, finish releases it, and the freed slot's stale rows
 are never touched again (the ragged kernel skips KV blocks past
@@ -13,10 +13,18 @@ The device arrays are functionally updated (donated through the jitted
 writers on non-CPU backends, so XLA updates in place); the host mirror is
 the scheduling truth — device-side lengths are always re-fed from it, so
 a freed slot resets by writing one host int, not a device op.
+
+Block copy programs (the prefix-cache transport, ``serving/prefix_cache``):
+``copy_block_in`` installs one published pool block into a slot's rows and
+``copy_block_out`` publishes one slot block into the pool. Both are single
+compile-once jitted programs — shapes depend only on the cache/pool
+geometry; the slot / row / block indices are runtime scalars — so cache
+hits, evictions, and publishes never add traces.
 """
 from __future__ import annotations
 
 import functools
+import heapq
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +40,30 @@ def _write_prefill(cache_k, cache_v, pk, pv, slot):
     return ck, cv
 
 
+def _copy_block_in(cache_k, cache_v, pool_k, pool_v, slot, row0, block_id):
+    # pool block [L, 1, bs, Hkv, D] -> cache rows [row0, row0+bs) of slot
+    L, _, bs, Hkv, D = pool_k.shape
+    bk = jax.lax.dynamic_slice(pool_k, (0, block_id, 0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    bv = jax.lax.dynamic_slice(pool_v, (0, block_id, 0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    ck = jax.lax.dynamic_update_slice(cache_k, bk, (0, slot, row0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cache_v, bv, (0, slot, row0, 0, 0))
+    return ck, cv
+
+
+def _copy_block_out(pool_k, pool_v, cache_k, cache_v, slot, row0, block_id):
+    # cache rows [row0, row0+bs) of slot -> pool block (publish)
+    L, _, bs, Hkv, D = pool_k.shape
+    bk = jax.lax.dynamic_slice(cache_k, (0, slot, row0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    bv = jax.lax.dynamic_slice(cache_v, (0, slot, row0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    pk = jax.lax.dynamic_update_slice(pool_k, bk, (0, block_id, 0, 0, 0))
+    pv = jax.lax.dynamic_update_slice(pool_v, bv, (0, block_id, 0, 0, 0))
+    return pk, pv
+
+
 @functools.lru_cache(maxsize=None)
 def _writer(donate):
     # module-level so every cache instance (one per engine, one engine
@@ -40,8 +72,35 @@ def _writer(donate):
     return jax.jit(_write_prefill, donate_argnums=(0, 1) if donate else ())
 
 
+@functools.lru_cache(maxsize=None)
+def _block_in(donate):
+    # donate the CACHE arrays (they are the ones functionally updated)
+    return jax.jit(_copy_block_in, donate_argnums=(0, 1) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _block_out(donate):
+    # donate the POOL arrays (publish updates the pool in place)
+    return jax.jit(_copy_block_out, donate_argnums=(0, 1) if donate else ())
+
+
+def copy_compilations() -> int:
+    """Total traces of the block copy programs (both donate modes) — the
+    prefix-cache half of the bounded-compile contract: stays at one per
+    (geometry, donate) no matter how many hits/publishes run."""
+    return sum(fn._cache_size()
+               for fn in (_block_in(True), _block_in(False),
+                          _block_out(True), _block_out(False)))
+
+
 class SlotKVCache:
-    """KV-cache manager: device arrays + slot allocator + lengths mirror."""
+    """KV-cache manager: device arrays + slot allocator + lengths mirror.
+
+    The free-slot pool is a min-heap plus a membership set: ``alloc`` is
+    O(log n) and still deterministic (lowest index first), ``free``'s
+    double-free guard is O(1) — the seed version's ``slot in list`` scan
+    plus sort-on-alloc was O(n)/O(n log n) per admission.
+    """
 
     def __init__(self, num_layers, num_slots, max_seq_len, num_kv_heads,
                  head_dim, dtype=jnp.float32, donate=None):
@@ -53,30 +112,34 @@ class SlotKVCache:
         # host mirror is the source of truth; device lengths are re-fed
         # from it every step
         self.lengths = np.zeros(num_slots, np.int32)
-        self._free = list(range(num_slots))
+        self._free_heap = list(range(num_slots))  # already heap-ordered
+        self._free_set = set(self._free_heap)
         if donate is None:
             # donation is a no-op (warning) on CPU; an in-place cache
             # update is the whole point everywhere else
             donate = jax.default_backend() != "cpu"
-        self._write = _writer(bool(donate))
+        self._donate = bool(donate)
+        self._write = _writer(self._donate)
 
     # ------------------------------------------------------------- slots
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        return len(self._free_set)
 
     def alloc(self):
         """Claim a free slot (lowest index first, deterministic)."""
-        if not self._free:
+        if not self._free_set:
             return None
-        self._free.sort()
-        return self._free.pop(0)
+        slot = heapq.heappop(self._free_heap)
+        self._free_set.discard(slot)
+        return slot
 
     def free(self, slot: int):
-        if slot in self._free:
+        if slot in self._free_set:
             raise ValueError(f"slot {slot} double-freed")
         self.lengths[slot] = 0
-        self._free.append(slot)
+        heapq.heappush(self._free_heap, slot)
+        self._free_set.add(slot)
 
     # ------------------------------------------------------------ writes
     def write_prefill(self, slot, pk, pv, prompt_len):
@@ -91,3 +154,19 @@ class SlotKVCache:
     def update(self, new_k, new_v):
         """Adopt the decode step's functionally-updated cache arrays."""
         self.k, self.v = new_k, new_v
+
+    # ------------------------------------------------------ block copies
+    def copy_block_in(self, slot, row0, pool, block_id):
+        """Install pool block ``block_id`` into rows [row0, row0+bs) of
+        ``slot`` (a prefix-cache hit). One jitted program total — the
+        three indices are runtime scalars."""
+        self.k, self.v = _block_in(self._donate)(
+            self.k, self.v, pool.k, pool.v, np.int32(slot),
+            np.int32(row0), np.int32(block_id))
+
+    def copy_block_out(self, slot, row0, pool, block_id):
+        """Publish rows [row0, row0+bs) of ``slot`` into pool block
+        ``block_id`` (sequence retirement). One jitted program total."""
+        pool.k, pool.v = _block_out(self._donate)(
+            pool.k, pool.v, self.k, self.v, np.int32(slot),
+            np.int32(row0), np.int32(block_id))

@@ -1,0 +1,206 @@
+"""Automatic prefix caching: block-granular KV reuse across requests.
+
+The dominant serving pattern is many requests sharing a long system
+prompt / few-shot preamble; without reuse every admission re-prefills
+that shared prefix from scratch. This module keys published KV blocks by
+their *token content* so a new request's admission can skip the device
+work for every prompt block some earlier request already computed:
+
+- **Hash-trie**: each node is one ``block_size``-token block, keyed by
+  its exact token tuple under its parent (the tuple IS the hash key, so
+  a hash collision can never alias different token content — dict
+  equality confirms the match). A path root→node spells a prompt prefix.
+- **Lookup** walks the trie over a prompt's full blocks and returns the
+  longest cached chain — capped so at least the final prompt token is
+  always prefilled (the engine needs its logits to sample token 0).
+- **Acquire/release**: matched blocks are ref-pinned for the sequence's
+  lifetime (a pinned block can't be evicted out from under a later
+  publish dedupe); retirement releases the pins.
+- **Publish**: on retirement every full *prompt* block not already in
+  the trie is copied slot→pool (``kv_cache.copy_block_out``, one jitted
+  program) and inserted. Pool pressure evicts LRU zero-ref leaf blocks
+  first; if the pool is exhausted by pinned blocks the remaining
+  publishes are skipped, never failed — the cache degrades to fewer
+  hits, not errors.
+- **Copy-on-install (the COW discipline)**: a hit COPIES its matched
+  blocks into the sequence's private slot (``copy_block_in``), so pool
+  blocks are write-once/read-many and two sequences sharing a prefix
+  can diverge freely — their decode appends land in their own slots.
+  True zero-copy sharing needs block-table paged attention (ROADMAP
+  open item); at slot granularity install-copy is the aliasing-safe
+  form of COW.
+
+Compile discipline: lookups/inserts/evictions are pure host work; the
+only device programs are the two block-copy programs (compile-once, see
+``kv_cache.py``) and the bucketed suffix prefill (``decode.py``), so the
+engine's ``decode_compilations() == 1`` contract survives any mix of
+hits, misses, evictions, and divergence.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+
+class _Node:
+    """One cached block: a trie edge keyed by its token tuple."""
+
+    __slots__ = ("tokens", "parent", "children", "block_id", "tick")
+
+    def __init__(self, tokens, parent, block_id):
+        self.tokens = tokens        # the block's exact token tuple
+        self.parent = parent        # _Node or None (root-level block)
+        self.children = {}          # token tuple -> _Node
+        self.block_id = block_id    # index into the BlockManager pool
+        self.tick = 0               # LRU stamp (updated on touch)
+
+
+class PrefixCache:
+    """Hash-trie over prompt token blocks + LRU eviction policy.
+
+    Owns logical identity and lifecycle; physical blocks live in the
+    :class:`~.block_manager.BlockManager` passed in. All methods run on
+    the engine-driver thread (the engine is single-threaded by
+    contract), so no locks.
+    """
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._root = {}              # token tuple -> _Node
+        self._nodes = 0              # live trie nodes (== pool.num_used)
+        self._tick = itertools.count(1)
+        self.stats = {"lookups": 0, "hits": 0, "misses": 0,
+                      "hit_blocks": 0, "hit_tokens": 0,
+                      "published_blocks": 0, "evictions": 0,
+                      "skipped_publishes": 0}
+
+    # ------------------------------------------------------------- lookup
+    def _blocks_of(self, prompt, max_tokens):
+        """Token tuples of the prompt's full blocks within max_tokens."""
+        prompt = np.asarray(prompt).reshape(-1)
+        bs = self.block_size
+        return [tuple(int(t) for t in prompt[i:i + bs])
+                for i in range(0, (max_tokens // bs) * bs, bs)]
+
+    def lookup(self, prompt, record=True):
+        """Longest cached chain of full prompt blocks, as a list of
+        nodes (possibly empty). Never covers the final prompt token —
+        the suffix prefill needs at least one token to sample from.
+        ``record=False`` is a side-effect-free probe (introspection /
+        tests) that leaves hit/miss stats and LRU ticks untouched."""
+        prompt = np.asarray(prompt).reshape(-1)
+        matched = []
+        children = self._root
+        for key in self._blocks_of(prompt, len(prompt) - 1):
+            node = children.get(key)
+            if node is None:
+                break
+            matched.append(node)
+            children = node.children
+        if record:
+            self.stats["lookups"] += 1
+            if matched:
+                tick = next(self._tick)   # touch-on-read keeps hot
+                for node in matched:      # chains out of LRU's reach
+                    node.tick = tick
+                self.stats["hits"] += 1
+                self.stats["hit_blocks"] += len(matched)
+                self.stats["hit_tokens"] += len(matched) * self.block_size
+            else:
+                self.stats["misses"] += 1
+        return matched
+
+    def acquire(self, matched):
+        """Pin a lookup's matched chain for a sequence's lifetime."""
+        tick = next(self._tick)
+        for node in matched:
+            self.pool.ref(node.block_id)
+            node.tick = tick
+
+    def release(self, matched):
+        """Drop a sequence's pins (called exactly once at retirement)."""
+        for node in matched:
+            self.pool.unref(node.block_id)
+
+    # ------------------------------------------------------------ publish
+    def publish(self, prompt, slot, kv_cache):
+        """Insert every full prompt block into the trie, copying
+        slot→pool for blocks not already cached. Runs at retirement,
+        BEFORE the sequence's pins are released, so its own matched
+        chain can't be evicted mid-publish. Under pool pressure evicts
+        LRU zero-ref leaves; skips (never fails) when nothing is
+        evictable."""
+        prompt = np.asarray(prompt).reshape(-1)
+        bs = self.block_size
+        children, parent = self._root, None
+        tick = next(self._tick)
+        walked = []  # this walk's own chain, pinned against its evictions
+        try:
+            for i, key in enumerate(self._blocks_of(prompt, len(prompt))):
+                node = children.get(key)
+                if node is None:
+                    block = self.pool.alloc()
+                    if block is None and self._evict_one():
+                        block = self.pool.alloc()
+                    if block is None:  # everything pinned: degrade, not fail
+                        self.stats["skipped_publishes"] += 1
+                        return
+                    kv_cache.copy_block_out(slot, i * bs, self.pool, block)
+                    node = _Node(key, parent, block)
+                    children[key] = node
+                    self._nodes += 1
+                    self.stats["published_blocks"] += 1
+                node.tick = tick
+                # pin the chain-so-far: a later block's eviction pass must
+                # never reap an earlier link of the chain being published
+                # (it is zero-ref until someone matches it)
+                self.pool.ref(node.block_id)
+                walked.append(node)
+                children, parent = node.children, node
+        finally:
+            for node in walked:
+                self.pool.unref(node.block_id)
+
+    # ----------------------------------------------------------- eviction
+    def _iter_nodes(self):
+        stack = list(self._root.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def _evict_one(self) -> bool:
+        """Evict the LRU (minimum-tick) zero-ref LEAF; False when
+        nothing is evictable. Leaves-first keeps every cached chain
+        reachable from the root (evicting an interior node would orphan
+        its still-resident descendants); the refcount invariant
+        ref(parent) >= ref(child) guarantees a zero-ref leaf exists
+        whenever any zero-ref node does. One O(trie) min pass per
+        eviction — the trie is bounded by the pool size, and evictions
+        only fire on publish-under-pressure, never on the decode path.
+        """
+        node = None
+        for n in self._iter_nodes():
+            if not n.children and self.pool.refcount(n.block_id) == 0 \
+                    and (node is None or n.tick < node.tick):
+                node = n
+        if node is None:
+            return False
+        siblings = (node.parent.children if node.parent is not None
+                    else self._root)
+        del siblings[node.tokens]
+        self.pool.free(node.block_id)
+        self._nodes -= 1
+        self.stats["evictions"] += 1
+        return True
+
+    # -------------------------------------------------------------- intro
+    @property
+    def num_cached_blocks(self) -> int:
+        return self._nodes
+
+    def hit_rate(self) -> float:
+        n = self.stats["lookups"]
+        return self.stats["hits"] / n if n else 0.0

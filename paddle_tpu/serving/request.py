@@ -62,7 +62,8 @@ class Sequence:
     """
 
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
-                 "finish_reason", "slot", "key", "submit_step", "deadline")
+                 "finish_reason", "slot", "key", "submit_step", "deadline",
+                 "prefix_nodes", "prefix_hit_tokens")
 
     def __init__(self, request: GenerationRequest, key, submit_step=0,
                  deadline=None):
@@ -76,6 +77,11 @@ class Sequence:
         self.key = key
         self.submit_step = submit_step
         self.deadline = deadline
+        # prefix-cache state: the trie nodes this sequence's admission
+        # matched and ref-pinned (released at retirement), and how many
+        # prompt tokens they covered (0 = cold prefill)
+        self.prefix_nodes = []
+        self.prefix_hit_tokens = 0
 
     @property
     def done(self) -> bool:

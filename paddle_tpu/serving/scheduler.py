@@ -40,11 +40,30 @@ class FIFOScheduler:
     def num_queued(self) -> int:
         return len(self.queue)
 
-    def admissions(self, num_free: int):
-        """Sequences to admit this step (pops up to ``num_free``)."""
+    def admissions(self, num_free: int, hit_len_fn=None):
+        """Sequences to admit this step (pops up to ``num_free``).
+
+        ``hit_len_fn(seq) -> int`` makes admission prefix-cache-aware:
+        it is THE admission-time prefix lookup — the engine's hook
+        records the hit, pins the matched chain (so nothing this step
+        does can evict it before install), and returns the covered
+        token count, which lands on ``seq.prefix_hit_tokens``. The
+        admitted SET stays strictly the FIFO head (fairness — a hit
+        never jumps a colder request's place in line); the batch is
+        then ordered by ascending uncovered-suffix length, which keeps
+        slot assignment and admission bookkeeping deterministic under
+        any hit mix (device-call count is unchanged — the engine
+        buckets either way). The sort is stable, so equal-suffix
+        sequences keep FIFO order.
+        """
         out = []
         while self.queue and len(out) < num_free:
             out.append(self.queue.popleft())
+        if hit_len_fn is not None:
+            for seq in out:
+                seq.prefix_hit_tokens = int(hit_len_fn(seq))
+            if len(out) > 1:
+                out.sort(key=lambda s: s.prompt_len - s.prefix_hit_tokens)
         return out
 
     def remove(self, seq) -> bool:

@@ -52,6 +52,14 @@ def main(argv=None):
                     help=">1 fuses decode ticks (adds streaming latency)")
     ap.add_argument("--max-queue", type=int, default=64,
                     help="waiting-room bound before 429s")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="automatic prefix caching: reuse KV blocks of "
+                         "shared prompt prefixes across requests")
+    ap.add_argument("--prefix-blocks", type=int, default=None,
+                    help="prefix-cache pool size in blocks (default: "
+                         "num_slots * max_seq_len / block_size)")
+    ap.add_argument("--prefix-block-size", type=int, default=32,
+                    help="tokens per cached KV block")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-request access logs")
@@ -63,10 +71,13 @@ def main(argv=None):
         model, host=args.host, port=args.port, num_slots=args.num_slots,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
         max_queue=args.max_queue, model_name=f"llama-{args.preset}",
+        prefix_cache=args.prefix_cache, prefix_blocks=args.prefix_blocks,
+        prefix_block_size=args.prefix_block_size,
         log_fn=None if args.quiet else
         (lambda m: print(m, file=sys.stderr)))
     print(json.dumps({"listening": server.url, "preset": args.preset,
                       "num_slots": args.num_slots,
+                      "prefix_cache": bool(args.prefix_cache),
                       "endpoints": ["/v1/completions", "/healthz",
                                     "/metrics"]}), flush=True)
 

@@ -232,6 +232,31 @@ class ServingGateway:
                 "Decode-program traces (compile-once contract: stays at "
                 "one per (num_slots, max_seq_len, n_steps)).").set_fn(
             self.engine.decode_compilations)
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is not None:
+            # scrape-time counters backed by the cache's own monotonic
+            # stats (the driver thread is the only writer; a scrape reads
+            # one int — no sync needed beyond the GIL)
+            r.counter("serving_prefix_cache_hits_total",
+                      "Admissions that matched a cached prefix chain."
+                      ).set_fn(lambda: pc.stats["hits"])
+            r.counter("serving_prefix_cache_misses_total",
+                      "Admissions with no cached prefix."
+                      ).set_fn(lambda: pc.stats["misses"])
+            r.counter("serving_prefix_cache_evictions_total",
+                      "Cached blocks evicted under pool pressure."
+                      ).set_fn(lambda: pc.stats["evictions"])
+            r.counter("serving_prefill_tokens_saved_total",
+                      "Prompt tokens served from cached KV blocks "
+                      "instead of device prefill."
+                      ).set_fn(lambda: self.engine.stats[
+                          "prefill_tokens_saved"])
+            r.gauge("kv_prefix_blocks",
+                    "Prefix-cache pool blocks in use (published + "
+                    "pinned).").set_fn(lambda: pc.pool.num_used)
+            r.gauge("kv_prefix_blocks_capacity",
+                    "Prefix-cache pool size in blocks.").set(
+                pc.pool.num_blocks)
 
     # ---------------------------------------------------------- front door
     def submit(self, request) -> TokenStream:

@@ -131,9 +131,18 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     captured program as a deployable artifact. TPU-native form: the
     program's pure replay (feeds -> fetches, weights baked as constants)
     is serialized as StableHLO via jax.export into ``<prefix>.pdmodel``,
-    with feed/fetch metadata in ``<prefix>.pdiparams`` (the reference's
-    sidecar name; params live inside the program here). Dynamic (-1) feed
-    dims export as symbolic shapes."""
+    with feed/fetch metadata in a ``<prefix>.pdmeta`` sidecar. Dynamic
+    (-1) feed dims export as symbolic shapes.
+
+    Sidecar format (``.pdmeta``): a ``framework.io.save`` pickle of
+    ``{"feed_names": [str, ...], "fetch_count": int}`` — NOT serialized
+    parameters (weights are baked into the StableHLO program). Earlier
+    versions wrote this metadata under the reference's ``.pdiparams``
+    extension, whose real-paddle format IS serialized parameters; that
+    implied a compatibility the file never had (ADVICE r5), so the
+    sidecar now has its own name. ``load_inference_model`` still reads
+    a legacy ``.pdiparams`` metadata sidecar when no ``.pdmeta`` exists.
+    """
     import jax as _jax
     from jax import export as jexport
 
@@ -168,17 +177,25 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exp.serialize())
     fio.save({"feed_names": feed_names, "fetch_count": len(fetch_ids)},
-             path_prefix + ".pdiparams")
+             path_prefix + ".pdmeta")
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
     """Returns ``[program, feed_target_names, fetch_targets]`` (reference
     signature); run with ``exe.run(program, feed={name: arr},
-    fetch_list=fetch_targets)``."""
+    fetch_list=fetch_targets)``. Reads the ``.pdmeta`` feed/fetch
+    sidecar (see :func:`save_inference_model` for the format), falling
+    back to the legacy ``.pdiparams``-named metadata sidecar for
+    artifacts saved before the rename."""
+    import os as _os
+
     from jax import export as jexport
 
     from ..framework import io as fio
-    meta = fio.load(path_prefix + ".pdiparams")
+    meta_path = path_prefix + ".pdmeta"
+    if not _os.path.exists(meta_path):  # pre-rename artifact
+        meta_path = path_prefix + ".pdiparams"
+    meta = fio.load(meta_path)
     with open(path_prefix + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
     prog = _LoadedInference(exported, meta["feed_names"],

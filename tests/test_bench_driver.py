@@ -73,6 +73,7 @@ class TestBenchDriverFlow:
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode_cb"]["ok"] is False
         assert art["serve_http"]["ok"] is False
+        assert art["prefix_cache"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -95,6 +96,13 @@ class TestBenchDriverFlow:
                 assert env == {"JAX_PLATFORMS": "cpu"}
                 return 0, json.dumps({"name": "serve_http", "ok": True,
                                       "overhead_ratio": 1.17,
+                                      "tokens_equal": True}), ""
+            if leg == "--prefix-cache":
+                # prefix-cache leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "prefix_cache", "ok": True,
+                                      "prefill_work_reduction": 2.0,
+                                      "hit_rate": 0.67,
                                       "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
@@ -127,13 +135,15 @@ class TestBenchDriverFlow:
         assert doc["value"] > 0
         assert "decode[jnp] 321" in doc["unit"]
         # decode is the final leg: a wedge there cannot cost the trace —
-        # and the tunnel-independent scheduling + gateway legs run
-        # before anything that can wedge
+        # and the tunnel-independent scheduling + gateway + prefix-cache
+        # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:2] == ["--decode-cb", "--serve-http"]
+        assert order[:3] == ["--decode-cb", "--serve-http",
+                             "--prefix-cache"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
+        assert art["prefix_cache"]["prefill_work_reduction"] == 2.0
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

@@ -71,6 +71,9 @@ class TestDecodeParity:
         inc = _run_prefill_decode(m, x, prefill_len=1)
         np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow  # the GQA prefill+decode rep above covers the same
+    # kernel; every serving test also runs max_seq_len > prompt+budget,
+    # so the padded-tail property has daily default-run coverage
     def test_cache_longer_than_sequence(self):
         """s_max > S: the padded cache tail must not leak into attention."""
         m = _model(L=2)

@@ -206,6 +206,8 @@ class TestGPT:
 
 
 class TestErnieViL:
+    @pytest.mark.slow  # training-run family (VERDICT r5 weak 3 tiering);
+    # test_encoders below stays the ErnieViL default-run representative
     def test_contrastive_training(self):
         _no_mesh()
         paddle.seed(20)

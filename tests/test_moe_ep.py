@@ -72,6 +72,8 @@ class TestExpertParallel:
         assert any("w1" in n for n in names)
         assert not any("htoh4" in n for n in names)
 
+    @pytest.mark.slow  # the mesh parity test (ep_mesh_parity_vs_meshless)
+    # is the stricter default rep of the same dispatch/combine math
     def test_parity_vs_dense_ffn_oracle(self):
         """All experts identical + capacity -> inf: top-2 combine weights
         renormalize to 1, so MoE(x) == FFN(x) exactly."""

@@ -46,6 +46,8 @@ class TestXPlaneStatistics:
         assert dot["count"] >= 4 and dot["total_ms"] > 0
         assert dot["avg_us"] > 0
 
+    @pytest.mark.slow  # second live-trace capture (~10s);
+    # test_summarize_renders_table keeps a live-trace default rep
     def test_rows_sorted_by_total_and_top_limits(self):
         d = _capture_trace()
         rows = op_statistics(d, device_only=False)

@@ -331,6 +331,25 @@ class TestKVCacheManager:
         c.free(s)
         assert c.lengths[s] == 0
 
+    def test_heap_allocator_deterministic_and_double_free_guarded(self):
+        """The heap+set allocator (replacing the O(n) list scan /
+        sort-on-alloc): lowest-free-index order survives interleaved
+        frees, and the double-free guard stays O(1) AND correct across
+        alloc/free cycles — the regression the membership set pins."""
+        c = SlotKVCache(2, 4, 16, 2, 8)
+        assert [c.alloc() for _ in range(4)] == [0, 1, 2, 3]
+        c.free(2)
+        c.free(0)
+        c.free(3)
+        assert c.alloc() == 0          # lowest index first, always
+        assert c.alloc() == 2
+        c.free(2)                      # re-free after re-alloc is legal
+        with pytest.raises(ValueError, match="double-freed"):
+            c.free(2)                  # immediate double-free caught
+        assert c.alloc() == 2          # guard never corrupted the pool
+        assert c.alloc() == 3 and c.alloc() is None
+        assert c.num_free == 0
+
 
 class TestScheduler:
     def test_fifo_admission_order(self):
@@ -338,6 +357,42 @@ class TestScheduler:
         sched.submit("a"); sched.submit("b"); sched.submit("c")
         assert sched.admissions(2) == ["a", "b"]
         assert sched.admissions(2) == ["c"]
+
+    def test_remove_while_queued_vs_after_admission_pop(self):
+        """remove() edge cases: a queued sequence is droppable exactly
+        once; a sequence already popped by admissions() (mid-admission
+        group, no longer the scheduler's to drop) returns False — the
+        engine relies on that to distinguish 'never claims a slot' from
+        'already being prefilled' in cancel/deadline paths."""
+        sched = FIFOScheduler()
+        sched.submit("a"); sched.submit("b"); sched.submit("c")
+        assert sched.remove("b") is True       # queued: dropped
+        assert sched.remove("b") is False      # idempotent
+        popped = sched.admissions(2)
+        assert popped == ["a", "c"]
+        assert sched.remove("a") is False      # mid-admission: not ours
+        assert sched.num_queued == 0
+        sched.submit("d")
+        assert sched.remove("d") is True and sched.num_queued == 0
+
+    def test_hit_aware_admission_orders_by_suffix_keeps_fifo_set(self):
+        """With a hit_len_fn the admitted SET is still the FIFO head
+        (fairness), ordered by ascending uncovered suffix so same-bucket
+        prefills group; ties keep FIFO order (stable sort)."""
+        class S:
+            def __init__(self, name, plen):
+                self.name, self.prompt_len = name, plen
+                self.prefix_hit_tokens = 0
+        a, b, c, d = S("a", 40), S("b", 48), S("c", 40), S("d", 8)
+        sched = FIFOScheduler()
+        for s in (a, b, c, d):
+            sched.submit(s)
+        hits = {"a": 0, "b": 32, "c": 0}
+        out = sched.admissions(3, hit_len_fn=lambda s: hits[s.name])
+        # d never jumps the line despite its tiny prompt
+        assert [s.name for s in out] == ["b", "a", "c"]  # suffixes 16,40,40
+        assert out[0].prefix_hit_tokens == 32
+        assert [s.name for s in sched.admissions(2)] == ["d"]
 
     def test_chunk_fusion_policy(self):
         class S:  # stub sequence

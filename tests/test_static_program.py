@@ -163,8 +163,11 @@ class TestInferenceModelSaveLoad:
         exe = static.Executor()
         prefix = str(tmp_path / "infer")
         static.save_inference_model(prefix, [x], [y], exe, program=main)
+        # feed/fetch metadata rides in .pdmeta — NOT .pdiparams, whose
+        # real-paddle format is serialized parameters (weights are baked
+        # into the StableHLO .pdmodel here)
         assert sorted(p.name for p in tmp_path.iterdir()) == [
-            "infer.pdiparams", "infer.pdmodel"]
+            "infer.pdmeta", "infer.pdmodel"]
         prog, feeds, fetches = static.load_inference_model(prefix, exe)
         assert feeds == ["x"]
         for b in (5, 9):
@@ -193,6 +196,23 @@ class TestInferenceModelSaveLoad:
         ref = exe.run(main, feed={"x": xs, "label": np.zeros((3, 1),
                                                             np.float32)},
                       fetch_list=[y])[0]
+        out = exe.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_legacy_pdiparams_sidecar_still_loads(self, tmp_path):
+        """Back-compat: artifacts from before the .pdmeta rename kept
+        their metadata in a .pdiparams-named sidecar; load falls back
+        to it when no .pdmeta exists."""
+        import os
+        main, x, y = self._build()
+        exe = static.Executor()
+        prefix = str(tmp_path / "legacy")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        os.rename(prefix + ".pdmeta", prefix + ".pdiparams")
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        assert feeds == ["x"]
+        xs = np.ones((2, 4), np.float32)
+        ref = exe.run(main, feed={"x": xs}, fetch_list=[y])[0]
         out = exe.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
         np.testing.assert_allclose(out, ref, atol=1e-6)
 
