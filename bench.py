@@ -468,6 +468,15 @@ def watchdog():
     pf = _parse_result(rc, out)
     cb_extra["prefix_cache"] = pf if pf is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Paged-attention leg: dense-vs-paged engine on the shared-system-
+    # prompt trace (scripts/bench_paged.py) — copy dispatches eliminated
+    # + peak pool blocks. Same hang-proof contract: deterministic
+    # counters, CPU-forced, banked before the tunnel can wedge anything.
+    rc, out, err = _run([me, "--paged-attn"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    pg = _parse_result(rc, out)
+    cb_extra["paged_attn"] = pg if pg is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -608,6 +617,13 @@ if __name__ == "__main__":
         from bench_prefix import measure_prefix_cache
         print(json.dumps({"name": "prefix_cache", "ok": True,
                           **measure_prefix_cache(quick=True)}))
+        sys.exit(0)
+    if "--paged-attn" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_paged import measure_paged_attn
+        print(json.dumps({"name": "paged_attn", "ok": True,
+                          **measure_paged_attn(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -526,6 +526,31 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
 def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
     oh, ow = _pair(output_size)
     H, W = x.shape[-2], x.shape[-1]
+    if return_mask:
+        # mask = flattened H*W input index of each region max (paddle
+        # semantics, same convention as max_pool2d's mask). Region
+        # bounds are the standard adaptive [floor(i*H/oh), ceil((i+1)*
+        # H/oh)) windows; a python loop over the output grid keeps every
+        # argmax exact for non-divisible sizes too (output grids are
+        # small by construction).
+        rows_v, rows_i = [], []
+        for i in range(oh):
+            hs, he = (i * H) // oh, -(-(i + 1) * H // oh)
+            cols_v, cols_i = [], []
+            for j in range(ow):
+                ws, we = (j * W) // ow, -(-(j + 1) * W // ow)
+                seg = x[..., hs:he, ws:we]
+                kw = we - ws
+                flat = seg.reshape(seg.shape[:-2] + ((he - hs) * kw,))
+                am = jnp.argmax(flat, axis=-1)
+                idx = (hs + am // kw) * W + (ws + am % kw)
+                cols_v.append(jnp.max(flat, axis=-1)[..., None, None])
+                cols_i.append(idx[..., None, None])
+            rows_v.append(jnp.concatenate(cols_v, -1))
+            rows_i.append(jnp.concatenate(cols_i, -1))
+        out = jnp.concatenate(rows_v, -2)
+        mask = jnp.concatenate(rows_i, -2).astype(dtype_mod.long_dtype())
+        return out, mask
     if H % oh == 0 and W % ow == 0:
         xr = jnp.reshape(x, x.shape[:-2] + (oh, H // oh, ow, W // ow))
         return jnp.max(xr, axis=(-3, -1))
@@ -1199,9 +1224,14 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False):
-    if return_mask:
-        raise NotImplementedError("adaptive_max_pool1d return_mask")
     from ..ops import squeeze, unsqueeze
+    if return_mask:
+        # W=1, so the flat H*W index IS the length index (same trick as
+        # max_pool1d's mask delegation)
+        out, mask = adaptive_max_pool2d(unsqueeze(x, -1),
+                                        (int(output_size), 1),
+                                        return_mask=True)
+        return squeeze(out, -1), squeeze(mask, -1)
     out = adaptive_max_pool2d(unsqueeze(x, -1), (int(output_size), 1))
     return squeeze(out, -1)
 
@@ -1884,11 +1914,46 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
 
 def adaptive_max_pool3d(x, output_size, return_mask=False,
                         data_format="NCDHW", name=None):
-    if return_mask:  # same precedent as max_pool3d above
-        raise NotImplementedError("adaptive_max_pool3d return_mask")
-    return _adaptive_max_pool3d_impl(
-        x, tuple(output_size) if isinstance(output_size, (list, tuple))
-        else (output_size,) * 3)
+    out_size = (tuple(output_size)
+                if isinstance(output_size, (list, tuple))
+                else (output_size,) * 3)
+    if return_mask:
+        return _adaptive_max_pool3d_mask_impl(x, out_size)
+    return _adaptive_max_pool3d_impl(x, out_size)
+
+
+@tensor_op
+def _adaptive_max_pool3d_mask_impl(x, out_size):
+    # mask = flattened D*H*W input index of each region max, the same
+    # convention as the 2d mask (and torch's return_indices oracle)
+    od, oh, ow = out_size
+    D, H, W = x.shape[-3], x.shape[-2], x.shape[-1]
+    planes_v, planes_i = [], []
+    for a in range(od):
+        ds, de = (a * D) // od, -(-(a + 1) * D // od)
+        rows_v, rows_i = [], []
+        for i in range(oh):
+            hs, he = (i * H) // oh, -(-(i + 1) * H // oh)
+            cols_v, cols_i = [], []
+            for j in range(ow):
+                ws, we = (j * W) // ow, -(-(j + 1) * W // ow)
+                seg = x[..., ds:de, hs:he, ws:we]
+                kh, kw = he - hs, we - ws
+                flat = seg.reshape(
+                    seg.shape[:-3] + ((de - ds) * kh * kw,))
+                am = jnp.argmax(flat, axis=-1)
+                ld, lh, lw = am // (kh * kw), (am // kw) % kh, am % kw
+                idx = ((ds + ld) * H + (hs + lh)) * W + (ws + lw)
+                cols_v.append(
+                    jnp.max(flat, axis=-1)[..., None, None, None])
+                cols_i.append(idx[..., None, None, None])
+            rows_v.append(jnp.concatenate(cols_v, -1))
+            rows_i.append(jnp.concatenate(cols_i, -1))
+        planes_v.append(jnp.concatenate(rows_v, -2))
+        planes_i.append(jnp.concatenate(rows_i, -2))
+    out = jnp.concatenate(planes_v, -3)
+    mask = jnp.concatenate(planes_i, -3).astype(dtype_mod.long_dtype())
+    return out, mask
 
 
 @tensor_op

@@ -79,9 +79,11 @@ class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     return_mask=self.return_mask)
 
 
 class AdaptiveAvgPool1D(Layer):
@@ -140,9 +142,11 @@ class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size)
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     return_mask=self.return_mask)
 
 
 class MaxUnPool2D(Layer):

@@ -14,7 +14,12 @@ Public surface:
   state (per-request deadlines via ``timeout_s``; ``finish_reason`` ∈
   :data:`FINISH_REASONS` = stop|length|cancelled|timeout)
 - :class:`GenerationResult` — array-like generate() output + finish_reason
-- :class:`SlotKVCache` — the paged per-slot KV cache manager
+- :class:`SlotKVCache` — the dense per-slot KV cache manager
+- :class:`PagedKVCache` — true block-table paged attention: the
+  :class:`BlockManager` pool IS the cache, slots address it through
+  per-slot block tables, prefix hits are zero-copy references and
+  retirement donates blocks to the trie (``paged_attn=True`` on the
+  engine; README "Paged attention")
 - :class:`FIFOScheduler` — admission + fused-chunk step policy
 - :class:`ContinuousBatchingEngine` — the step-function serving API
   (``cancel()``, deadline sweeps, ``on_token``/``on_finish`` streaming
@@ -29,7 +34,7 @@ The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
 """
 from .block_manager import BlockManager
 from .engine import ContinuousBatchingEngine
-from .kv_cache import SlotKVCache
+from .kv_cache import PagedKVCache, SlotKVCache
 from .prefix_cache import PrefixCache
 from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
                       Sequence)
@@ -37,6 +42,6 @@ from .scheduler import FIFOScheduler
 
 __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
-    "Sequence", "SlotKVCache", "FIFOScheduler", "FINISH_REASONS",
-    "BlockManager", "PrefixCache",
+    "Sequence", "SlotKVCache", "PagedKVCache", "FIFOScheduler",
+    "FINISH_REASONS", "BlockManager", "PrefixCache",
 ]

@@ -10,12 +10,23 @@ per-block reference count.
 Division of labor: this class owns *physical* blocks (allocation,
 refcounts, storage); :class:`~.prefix_cache.PrefixCache` owns *logical*
 identity (the hash-trie from token content to block id, LRU eviction
-order, hit/miss accounting). Blocks move between them only through the
-compile-once copy programs in ``kv_cache.py`` — a published block is
-written exactly once (at publish) and only ever read afterwards, so
-sharing a block between concurrent sequences can never alias their
-divergent continuations (each hit COPIES the block into the private
-slot; see the COW note in ``prefix_cache.py``).
+order, hit/miss accounting). On the dense engine blocks move between
+the pool and the slot cache through the compile-once copy programs in
+``kv_cache.py``; on the paged engine (:class:`~.kv_cache.PagedKVCache`)
+the pool IS the KV cache — live sequences reference blocks through
+per-slot block tables, published blocks are shared zero-copy (one
+block, N refs), and divergence is safe because writes only ever land
+in blocks the writing sequence privately owns (the COW fork: a table
+is shared-prefix + private-tail, and the tail is allocated fresh, never
+forked in place).
+
+Ownership discipline for table-referenced blocks: every block in a
+live table holds >= 1 ref — shared prefix blocks are pinned via
+:meth:`PrefixCache.acquire`, private tail blocks carry the owning
+sequence's pin from :meth:`alloc` + :meth:`ref`. :meth:`drop` releases
+one pin and returns the block to the free heap exactly when the count
+hits zero, so a mid-decode cancel frees the private tail while the
+shared prefix (still pinned by the trie's other readers) survives.
 """
 from __future__ import annotations
 
@@ -43,6 +54,7 @@ class BlockManager:
         self._free_heap = list(range(self.num_blocks))
         self._free_set = set(self._free_heap)
         self._ref = np.zeros(self.num_blocks, np.int32)
+        self._peak_used = 0
 
     # ---------------------------------------------------------- allocator
     @property
@@ -55,6 +67,18 @@ class BlockManager:
         gauge on ``/metrics``."""
         return self.num_blocks - self.num_free
 
+    @property
+    def peak_used(self) -> int:
+        """High-water mark of :attr:`num_used` — the paged-vs-dense
+        bench's HBM-footprint metric (scripts/bench_paged.py)."""
+        return self._peak_used
+
+    @property
+    def num_shared(self) -> int:
+        """Blocks with refcount >= 2 (physically shared by concurrent
+        readers) — the ``kv_blocks_shared`` gauge on ``/metrics``."""
+        return int((self._ref >= 2).sum())
+
     def alloc(self):
         """Claim a free block (lowest id first, deterministic); None when
         the pool is exhausted (the caller evicts or skips publishing)."""
@@ -62,6 +86,7 @@ class BlockManager:
             return None
         block = heapq.heappop(self._free_heap)
         self._free_set.discard(block)
+        self._peak_used = max(self._peak_used, self.num_used)
         return block
 
     def free(self, block: int):
@@ -87,3 +112,15 @@ class BlockManager:
 
     def refcount(self, block: int) -> int:
         return int(self._ref[block])
+
+    def drop(self, block: int) -> bool:
+        """Release one pin and return the block to the free heap iff the
+        count hit zero. The paged cache's private-tail release: the heap
+        gets the block back EXACTLY once (a second drop raises through
+        :meth:`unref`'s below-zero guard), and a block still pinned by
+        other readers — a donated prefix block with live hits — merely
+        loses this reader. Returns whether the block was freed."""
+        if self.unref(block) == 0:
+            self.free(block)
+            return True
+        return False

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from ..kernels.flash_attention import attention as _attention
 from ..kernels.pallas_decode import (decode_attention_pallas,
                                      decode_attention_reference)
+from ..kernels.pallas_paged_decode import (paged_decode_attention_pallas,
+                                           paged_decode_attention_reference)
 from ..models.llama import _apply_rope, _qkv_bshd, _rms, _rope_tables, \
     _swiglu_raw
 
@@ -256,6 +258,110 @@ def build_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied, donate=None):
         donate_argnums=(1, 2) if donate else ())
 
 
+# ----------------------------------------------------- paged suffix prefill
+def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
+                               ids, suffix_lens, keys, temps, top_ks, *,
+                               nh, nkv, hd, eps, theta, tied):
+    """Suffix prefill through per-row block tables: the paged twin of
+    ``_suffix_prefill_impl``, reading/writing the BlockManager pool
+    instead of per-slot dense caches.
+
+    tables: [G, max_blocks] int32 physical block ids (sentinel
+    ``num_blocks`` marks unmapped entries and padding rows). Suffix
+    token K/V at column i lands at logical position
+    ``prefix_lens[g] + i`` -> physical ``(tables[g, pos//bs], pos%bs)``
+    — always a block the row privately owns, because the covered prefix
+    is block-aligned and everything past it was freshly allocated. The
+    shared prefix blocks are READ through the same table but never
+    written: that is the zero-copy COW discipline in one line.
+
+    Shapes depend only on (G_pad, S_pad, pool geometry, max_blocks);
+    tables/lengths/knobs are runtime arrays, so the compile set stays
+    the same pow2 (group, bucket) grid as the dense suffix path.
+
+    Returns (pool_k', pool_v', tok0, keys').
+    """
+    G, S = ids.shape
+    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    mb = tables.shape[1]
+    s_tot = mb * bs
+    sin, cos = _rope_tables(s_tot, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    pos = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    sin_p = jnp.take(sin, pos, axis=0, mode="clip")   # [G, S, D]
+    cos_p = jnp.take(cos, pos, axis=0, mode="clip")
+    rows = jnp.arange(s_tot, dtype=jnp.int32)
+    # causal-over-ragged mask: query at global pos p sees rows r <= p
+    mask = rows[None, None, :] <= pos[:, :, None]        # [G, S, s_tot]
+    # rows ever valid for this row's attention; later rows may hold
+    # clip-gathered garbage from sentinel entries — zeroed out of PV
+    row_valid = rows[None, :] < (prefix_lens + S)[:, None]  # [G, s_tot]
+    grp = nh // nkv
+    scale = 1.0 / (hd ** 0.5)
+    # pool write coordinates. Unlike the dense path (which scatters all
+    # S columns into the slot and relies on lengths-masking), padding
+    # columns here MUST drop — a junk write into the pool could land in
+    # a block another sequence owns only via a bug, but dropping keeps
+    # the invariant airtight: only (col < suffix_len) positions write.
+    bi = jnp.minimum(pos // bs, mb - 1)
+    phys = jnp.take_along_axis(tables, bi, axis=1)        # [G, S]
+    cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+    phys = jnp.where(cols < suffix_lens[:, None], phys, nb)
+    prow = pos % bs
+
+    def layer(h, lp):
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope_grid(q, sin_p, cos_p)
+        k = _apply_rope_grid(k, sin_p, cos_p)
+        # write the suffix K/V through the table, then gather each row's
+        # logical cache (shared prefix + own suffix) for attention; the
+        # causal mask keeps columns from seeing rows past their position
+        pk_l = pk_l.at[phys, prow].set(k, mode="drop")
+        pv_l = pv_l.at[phys, prow].set(v, mode="drop")
+        ck = jnp.take(pk_l, tables, axis=0,
+                      mode="clip").reshape(G, s_tot, nkv, hd)
+        cv = jnp.take(pv_l, tables, axis=0,
+                      mode="clip").reshape(G, s_tot, nkv, hd)
+        kf = jnp.repeat(ck, grp, axis=2) if grp > 1 else ck
+        vf = jnp.repeat(cv, grp, axis=2) if grp > 1 else cv
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(mask[:, None], probs, 0.0)
+        vf = jnp.where(row_valid[:, :, None, None], vf, 0.0)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vf)
+        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(G, S, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (pk_l, pv_l)
+
+    x = jnp.take(params["embed"], ids, axis=0)
+    x, (npk, npv) = jax.lax.scan(layer, x, stack + (pool_k, pool_v))
+    last = jnp.take_along_axis(
+        x, (suffix_lens - 1)[:, None, None], axis=1)[:, 0]  # [G, H]
+    last_h = _rms(last, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    both = jax.vmap(jax.random.split)(keys)  # [G, 2, 2]
+    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
+    return npk, npv, tok0, both[:, 0]
+
+
+def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
+                                  donate=None):
+    """One jitted paged suffix prefill; retraces per (group, bucket)
+    shape — same bounded pow2 grid as the dense suffix path."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(_paged_suffix_prefill_impl, nh=nh, nkv=nkv, hd=hd,
+                          eps=eps, theta=theta, tied=tied),
+        donate_argnums=(1, 2) if donate else ())
+
+
 # -------------------------------------------------------------- decode step
 def _decode_steps_impl(params, cache_k, cache_v, tokens, lengths, keys,
                        temps, top_ks, *, n_steps, nh, nkv, hd, eps, theta,
@@ -319,4 +425,92 @@ def build_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _decode_steps_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
             eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+        donate_argnums=(1, 2) if donate else ())
+
+
+# ------------------------------------------------------- paged decode step
+def _paged_decode_steps_impl(params, pool_k, pool_v, tables, tokens,
+                             lengths, keys, temps, top_ks, *, n_steps, nh,
+                             nkv, hd, eps, theta, tied, decode_attn):
+    """``n_steps`` fused single-token ticks over all slots, KV living in
+    the BlockManager pool and addressed through per-slot block tables.
+
+    tables:  [B, max_blocks] int32 — physical block ids per slot
+             (sentinel ``num_blocks`` on dead slots / unmapped tails,
+             so their appends DROP instead of corrupting a shared pool
+             block — the one hazard the dense path never had)
+    tokens/lengths/keys/temps/top_ks: as in ``_decode_steps_impl``.
+
+    The engine pre-grows every active slot's table to cover
+    ``lengths + n_steps`` rows, so a fused chunk can cross block
+    boundaries without host intervention. Shapes depend only on
+    (num_slots, max_blocks, pool geometry): one compilation per
+    ``n_steps`` serves every request/table mix — the compile-once
+    contract is unchanged from the dense engine.
+
+    Returns (toks [n_steps, B], pool_k', pool_v', keys').
+    """
+    B = tokens.shape[0]
+    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    mb = tables.shape[1]
+    s_tot = mb * bs
+    sin, cos = _rope_tables(s_tot, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    def one_step(carry, _):
+        tok, pk_all, pv_all, lens, kys = carry
+        x = jnp.take(params["embed"], tok[:, None], axis=0)  # [B,1,H]
+        sin_p = jnp.take(sin, lens, axis=0, mode="clip")
+        cos_p = jnp.take(cos, lens, axis=0, mode="clip")
+        # append coordinates: each row writes at its own logical length;
+        # rows past the logical capacity (can't happen while budgets are
+        # validated — belt-and-braces) and dead slots (sentinel tables)
+        # both DROP rather than clamp into someone else's block
+        bi = jnp.minimum(lens // bs, mb - 1)
+        phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
+        phys = jnp.where(lens < s_tot, phys, nb)
+        prow = lens % bs
+
+        def layer(h, xs):
+            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
+            hn = _rms(h, lin, eps)
+            q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+            q = _apply_rope_rows(q, sin_p, cos_p)
+            k = _apply_rope_rows(k, sin_p, cos_p)
+            # ragged append through the table (dead slots drop)
+            pk_l = pk_l.at[phys, prow].set(k[:, 0], mode="drop")
+            pv_l = pv_l.at[phys, prow].set(v[:, 0], mode="drop")
+            if decode_attn == "pallas":
+                attn = paged_decode_attention_pallas(
+                    q[:, 0], pk_l, pv_l, tables, lens + 1)
+            else:
+                attn = paged_decode_attention_reference(
+                    q[:, 0], pk_l, pv_l, tables, lens + 1)
+            h = h + jnp.einsum("bsd,dh->bsh",
+                               attn.reshape(B, 1, nh * hd), lwo)
+            h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+            return h, (pk_l, pv_l)
+
+        x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
+        last = _rms(x[:, 0], params["final_norm"], eps)
+        logits = jnp.einsum("bh,hv->bv", last, head)
+        both = jax.vmap(jax.random.split)(kys)  # [B, 2, 2]
+        nxt = sample_rows(logits, both[:, 1], temps, top_ks)
+        return (nxt, npk, npv, lens + 1, both[:, 0]), nxt
+
+    carry0 = (tokens, pool_k, pool_v, lengths, keys)
+    (_, pk, pv, _, kf), toks = jax.lax.scan(one_step, carry0, None,
+                                            length=n_steps)
+    return toks, pk, pv, kf
+
+
+def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
+                                decode_attn, donate=None):
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(
+            _paged_decode_steps_impl, n_steps=n_steps, nh=nh, nkv=nkv,
+            hd=hd, eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
         donate_argnums=(1, 2) if donate else ())

@@ -33,9 +33,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import build_decode_steps_fn, build_prefill_fn, \
+from .decode import build_decode_steps_fn, build_paged_decode_steps_fn, \
+    build_paged_suffix_prefill_fn, build_prefill_fn, \
     build_suffix_prefill_fn, llama_decode_params
-from .kv_cache import SlotKVCache
+from .kv_cache import PagedKVCache, SlotKVCache
 from .request import GenerationRequest, GenerationResult, Sequence
 from .scheduler import FIFOScheduler
 
@@ -56,12 +57,27 @@ class ContinuousBatchingEngine:
     engine's layers/heads/dtype. ``prefix_blocks``/``prefix_block_size``
     size the pool the engine builds itself (default: enough blocks to
     cache ``num_slots`` full-length prompts at 32-token granularity).
+
+    ``paged_attn=True`` replaces the dense per-slot KV cache with true
+    block-table paged attention (:class:`~.kv_cache.PagedKVCache`,
+    README "Paged attention"): the :class:`~.block_manager.BlockManager`
+    pool IS the cache, every live slot addresses it through a per-slot
+    block table (a runtime argument — ``decode_compilations()`` stays at
+    1), prefix-cache hits install by *referencing* published block ids
+    (zero copy dispatches; N holders physically share one block), decode
+    growth appends blocks lazily, and retirement *donates* full prompt
+    blocks to the trie instead of copying them out. Token streams are
+    byte-identical to the dense engine. ``prefix_block_size`` doubles as
+    the KV block size; the pool is sized
+    ``num_slots * ceil(max_seq_len/block_size)`` live blocks plus the
+    ``prefix_blocks`` trie budget (trie-only blocks are reclaimed on
+    demand when live growth needs them).
     """
 
     def __init__(self, model, num_slots=8, max_seq_len=None, decode_chunk=8,
                  prefill_bucketing="pow2", jit_cache=None,
                  prefix_cache=False, prefix_blocks=None,
-                 prefix_block_size=32):
+                 prefix_block_size=32, paged_attn=False):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -77,40 +93,93 @@ class ContinuousBatchingEngine:
         self.max_seq_len = int(max_seq_len or c.max_position_embeddings)
         self._bucketing = prefill_bucketing
         self._params, self._tied = llama_decode_params(model)
-        self.cache = SlotKVCache(
-            c.num_hidden_layers, self.num_slots, self.max_seq_len,
-            c.num_key_value_heads, c.head_dim,
-            dtype=self._params["embed"].dtype)
+        self._paged = bool(paged_attn)
+        dtype = self._params["embed"].dtype
+        from .block_manager import BlockManager
+        from .prefix_cache import PrefixCache
         self.prefix_cache = None
-        if prefix_cache:
-            from .block_manager import BlockManager
-            from .prefix_cache import PrefixCache
+        if self._paged:
+            bs = int(prefix_block_size)
+            if bs < 1:
+                raise ValueError(
+                    f"prefix_block_size must be >= 1, got {bs}")
+            max_blocks = -(-self.max_seq_len // bs)
+            live = self.num_slots * max_blocks
             if isinstance(prefix_cache, PrefixCache):
-                # fail fast on a geometry mismatch: copies between the
-                # pool and this cache would otherwise die mid-serving
-                # with an opaque XLA shape/dtype error on the first hit
                 pool = prefix_cache.pool
-                want = (self.cache.k.shape[0],) + self.cache.k.shape[3:]
+                want = (c.num_hidden_layers, c.num_key_value_heads,
+                        c.head_dim)
                 have = (pool.k.shape[0],) + pool.k.shape[3:]
-                if have != want or pool.k.dtype != self.cache.k.dtype:
+                if have != want or pool.k.dtype != dtype \
+                        or pool.block_size != bs:
                     raise ValueError(
                         f"shared PrefixCache pool geometry "
-                        f"{have}/{pool.k.dtype} does not match this "
-                        f"engine's cache {want}/{self.cache.k.dtype}")
-                self.prefix_cache = prefix_cache
-            else:
-                bs = int(prefix_block_size)
-                if bs < 1:
+                        f"{have}/bs={pool.block_size}/{pool.k.dtype} does "
+                        f"not match this paged engine "
+                        f"{want}/bs={bs}/{dtype}")
+                if pool.num_blocks <= live:
                     raise ValueError(
-                        f"prefix_block_size must be >= 1, got {bs}")
+                        f"shared pool of {pool.num_blocks} blocks cannot "
+                        f"back {live} live blocks plus a prefix trie on "
+                        f"the paged engine")
+                if prefix_cache.max_blocks is None:
+                    # a dense-idiom cache (pool IS the budget) adopted by
+                    # a paged engine: bound trie residency to the pool's
+                    # headroom over the live grid, else donations grow
+                    # until every decode-growth alloc pays an eviction
+                    prefix_cache.max_blocks = pool.num_blocks - live
+                self.prefix_cache = prefix_cache
+            elif prefix_cache:
                 if prefix_blocks is None:
-                    nb = self.num_slots * max(self.max_seq_len // bs, 1)
+                    budget = self.num_slots * max(self.max_seq_len // bs, 1)
                 else:
-                    nb = int(prefix_blocks)  # 0/negative: BlockManager
-                    # raises rather than silently falling back to default
-                self.prefix_cache = PrefixCache(BlockManager(
-                    c.num_hidden_layers, nb, bs, c.num_key_value_heads,
-                    c.head_dim, dtype=self._params["embed"].dtype))
+                    budget = int(prefix_blocks)
+                    if budget < 1:
+                        raise ValueError(
+                            f"prefix_blocks must be >= 1, got {budget}")
+                pool = BlockManager(
+                    c.num_hidden_layers, live + budget, bs,
+                    c.num_key_value_heads, c.head_dim, dtype=dtype)
+                self.prefix_cache = PrefixCache(pool, max_blocks=budget)
+            else:
+                pool = BlockManager(
+                    c.num_hidden_layers, live, bs, c.num_key_value_heads,
+                    c.head_dim, dtype=dtype)
+            self.cache = PagedKVCache(
+                c.num_hidden_layers, self.num_slots, self.max_seq_len,
+                c.num_key_value_heads, c.head_dim, dtype=dtype,
+                block_size=bs, pool=pool, prefix_cache=self.prefix_cache)
+        else:
+            self.cache = SlotKVCache(
+                c.num_hidden_layers, self.num_slots, self.max_seq_len,
+                c.num_key_value_heads, c.head_dim, dtype=dtype)
+            if prefix_cache:
+                if isinstance(prefix_cache, PrefixCache):
+                    # fail fast on a geometry mismatch: copies between the
+                    # pool and this cache would otherwise die mid-serving
+                    # with an opaque XLA shape/dtype error on the first hit
+                    pool = prefix_cache.pool
+                    want = (self.cache.k.shape[0],) + self.cache.k.shape[3:]
+                    have = (pool.k.shape[0],) + pool.k.shape[3:]
+                    if have != want or pool.k.dtype != self.cache.k.dtype:
+                        raise ValueError(
+                            f"shared PrefixCache pool geometry "
+                            f"{have}/{pool.k.dtype} does not match this "
+                            f"engine's cache {want}/{self.cache.k.dtype}")
+                    self.prefix_cache = prefix_cache
+                else:
+                    bs = int(prefix_block_size)
+                    if bs < 1:
+                        raise ValueError(
+                            f"prefix_block_size must be >= 1, got {bs}")
+                    if prefix_blocks is None:
+                        nb = self.num_slots * max(self.max_seq_len // bs, 1)
+                    else:
+                        nb = int(prefix_blocks)  # 0/negative: BlockManager
+                        # raises rather than silently falling back to default
+                    self.prefix_cache = PrefixCache(BlockManager(
+                        c.num_hidden_layers, nb, bs, c.num_key_value_heads,
+                        c.head_dim, dtype=dtype))
         self.scheduler = FIFOScheduler(decode_chunk)
         self._slots = [None] * self.num_slots
         self._last_tok = np.zeros(self.num_slots, np.int32)
@@ -125,6 +194,7 @@ class ContinuousBatchingEngine:
                       "slot_steps": 0, "active_slot_steps": 0,
                       "prefills": 0, "prefill_tokens": 0,
                       "prefill_tokens_saved": 0,
+                      "prefill_copy_dispatches": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
@@ -149,33 +219,45 @@ class ContinuousBatchingEngine:
         return self._jit[key]
 
     def _suffix_fn(self):
-        key = ("suffix",)
+        # paged and dense suffix programs are distinct (table-indirect
+        # vs slot-indexed) and may share one jit_cache dict, so they key
+        # apart; the cold prefill is IDENTICAL either way and is shared
+        key = ("psuffix",) if self._paged else ("suffix",)
         if key not in self._jit:
-            self._jit[key] = build_suffix_prefill_fn(**self._fn_consts())
+            build = (build_paged_suffix_prefill_fn if self._paged
+                     else build_suffix_prefill_fn)
+            self._jit[key] = build(**self._fn_consts())
         return self._jit[key]
 
     def _decode_fn(self, n_steps):
-        key = ("decode", int(n_steps), self.config.decode_attention)
+        kind = "pdecode" if self._paged else "decode"
+        key = (kind, int(n_steps), self.config.decode_attention)
         if key not in self._jit:
-            self._jit[key] = build_decode_steps_fn(
+            build = (build_paged_decode_steps_fn if self._paged
+                     else build_decode_steps_fn)
+            self._jit[key] = build(
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
                 **self._fn_consts())
         return self._jit[key]
 
     def decode_compilations(self) -> int:
-        """Total decode-program traces (the compiles-once assertion hook):
-        stays at one per ``(num_slots, max_seq_len, n_steps)`` no matter
-        how request sampling params / token budgets vary."""
+        """Total decode-program traces OF THIS ENGINE'S KIND (the
+        compiles-once assertion hook): stays at one per ``(num_slots,
+        max_seq_len, n_steps)`` no matter how request sampling params /
+        token budgets / block tables vary. Dense and paged engines
+        sharing one jit_cache count only their own programs."""
+        kind = "pdecode" if self._paged else "decode"
         return sum(fn._cache_size() for key, fn in self._jit.items()
-                   if key[0] == "decode")
+                   if key[0] == kind)
 
     def prefill_compilations(self) -> int:
         """Prefill-side traces, cold + suffix: bounded by the pow2
         (group, bucket) grid — independent of the hit/miss/eviction mix
         (the bounded-compile half of the prefix-cache contract)."""
+        sfx = "psuffix" if self._paged else "suffix"
         return sum(fn._cache_size() for key, fn in self._jit.items()
-                   if key[0] in ("prefill", "suffix"))
+                   if key[0] in ("prefill", sfx))
 
     # ------------------------------------------------------------- intake
     def _key_for(self, request):
@@ -310,12 +392,22 @@ class ContinuousBatchingEngine:
                                   seq.prompt_len, finished)
 
     def _admit_hits(self, hits, finished):
-        """Admit prefix-cache hits: install each sequence's matched
-        chain into its slot (compile-once block copies), then ONE
-        suffix-prefill device call per suffix-length bucket covering
-        only the uncovered prompt tails. Group padding rows carry slot
-        index ``num_slots`` and prefix ``max_seq_len`` so every one of
-        their cache writes drops inside the jitted program."""
+        """Admit prefix-cache hits, then ONE suffix-prefill device call
+        per suffix-length bucket covering only the uncovered prompt
+        tails.
+
+        Dense: install each sequence's matched chain into its slot with
+        compile-once block copies (one ``copy_block_in`` dispatch per
+        block — counted in ``prefill_copy_dispatches``). Group padding
+        rows carry slot index ``num_slots`` and prefix ``max_seq_len``
+        so every one of their cache writes drops inside the program.
+
+        Paged: ZERO-COPY install — the slot's block table simply
+        references the matched chain's block ids (no device dispatch;
+        N concurrent holders share the physical blocks), private tail
+        blocks are appended to cover the prompt, and the suffix prefill
+        writes through the table. Padding rows carry all-sentinel
+        tables so their writes drop."""
         pc = self.prefix_cache
         bs = pc.block_size
         by_bucket = {}
@@ -326,8 +418,14 @@ class ContinuousBatchingEngine:
         for s_pad, group in sorted(by_bucket.items()):
             G = len(group)
             Gp = 1 << (G - 1).bit_length()
-            slots = np.full(Gp, self.num_slots, np.int32)   # writes drop
-            prefix_lens = np.full(Gp, self.max_seq_len, np.int32)
+            if self._paged:
+                mb = self.cache.max_blocks
+                s_tot = mb * self.cache.block_size
+                tables = np.full((Gp, mb), self.cache.sentinel, np.int32)
+                prefix_lens = np.full(Gp, s_tot, np.int32)
+            else:
+                slots = np.full(Gp, self.num_slots, np.int32)  # writes drop
+                prefix_lens = np.full(Gp, self.max_seq_len, np.int32)
             ids = np.zeros((Gp, s_pad), np.int32)
             suf_lens = np.ones(Gp, np.int32)
             temps = np.zeros(Gp, np.float32)
@@ -339,22 +437,36 @@ class ContinuousBatchingEngine:
                 covered = len(matched) * bs
                 slot = self.cache.alloc()
                 seq.slot = slot
-                for j, node in enumerate(matched):
-                    self.cache.copy_block_in(slot, j * bs, pc.pool,
-                                             node.block_id)
+                if self._paged:
+                    self.cache.install_prefix(
+                        slot, [node.block_id for node in matched])
+                    self.cache.ensure_capacity(slot, seq.prompt_len)
+                    tables[i] = self.cache.tables[slot]
+                else:
+                    for j, node in enumerate(matched):
+                        self.cache.copy_block_in(slot, j * bs, pc.pool,
+                                                 node.block_id)
+                        self.stats["prefill_copy_dispatches"] += 1
+                    slots[i] = slot
                 suffix = seq.prompt[covered:]
                 ids[i, :len(suffix)] = suffix
                 suf_lens[i] = len(suffix)
                 prefix_lens[i] = covered
-                slots[i] = slot
                 temps[i] = float(seq.request.temperature)
                 topks[i] = int(seq.request.top_k)
                 keys[i] = np.asarray(seq.key)
-            nk, nv, tok0s, keys2 = self._suffix_fn()(
-                self._params, self.cache.k, self.cache.v,
-                jnp.asarray(slots), jnp.asarray(prefix_lens),
-                jnp.asarray(ids), jnp.asarray(suf_lens),
-                jnp.asarray(keys), temps, topks)
+            if self._paged:
+                nk, nv, tok0s, keys2 = self._suffix_fn()(
+                    self._params, self.cache.pool.k, self.cache.pool.v,
+                    jnp.asarray(tables), jnp.asarray(prefix_lens),
+                    jnp.asarray(ids), jnp.asarray(suf_lens),
+                    jnp.asarray(keys), temps, topks)
+            else:
+                nk, nv, tok0s, keys2 = self._suffix_fn()(
+                    self._params, self.cache.k, self.cache.v,
+                    jnp.asarray(slots), jnp.asarray(prefix_lens),
+                    jnp.asarray(ids), jnp.asarray(suf_lens),
+                    jnp.asarray(keys), temps, topks)
             self.cache.update(nk, nv)
             tok0s = np.asarray(tok0s)
             for i, (seq, matched) in enumerate(group):
@@ -407,13 +519,22 @@ class ContinuousBatchingEngine:
             self._temps[slot] = 0.0
             self._topks[slot] = 0
             self._last_tok[slot] = 0
-            if self.prefix_cache is not None:
-                # publish BEFORE freeing: the slot's prompt rows are
-                # intact (decode only ever appended past them) and the
-                # sequence's own pins still shield its matched chain
-                # from eviction during the publish walk
+            # publish BEFORE freeing: the slot's prompt rows/blocks are
+            # intact (decode only ever appended past them) and the
+            # sequence's own pins still shield its matched chain from
+            # eviction during the publish walk
+            if self.prefix_cache is not None and self._paged:
+                # paged publish DONATES the slot's full prompt blocks to
+                # the trie (ownership handoff, zero copies); free() then
+                # drops only the undonated private tail
+                donated = self.prefix_cache.publish_donate(
+                    seq.prompt, self.cache.slot_block_ids(slot))
+                self.cache.free(slot, keep=donated)
+            elif self.prefix_cache is not None:
                 self.prefix_cache.publish(seq.prompt, slot, self.cache)
-            self.cache.free(slot)
+                self.cache.free(slot)
+            else:
+                self.cache.free(slot)
         if self.prefix_cache is not None and seq.prefix_nodes:
             self.prefix_cache.release(seq.prefix_nodes)
             seq.prefix_nodes = []
@@ -462,11 +583,28 @@ class ContinuousBatchingEngine:
         active = [s for s in self._slots if s is not None]
         if active:
             n = self.scheduler.choose_num_steps(active)
-            toks, nk, nv, keys = self._decode_fn(n)(
-                self._params, self.cache.k, self.cache.v,
-                jnp.asarray(self._last_tok), jnp.asarray(self.cache.lengths),
-                self._keys, jnp.asarray(self._temps),
-                jnp.asarray(self._topks))
+            if self._paged:
+                # append-block on decode growth: a fused chunk of n
+                # ticks writes rows [len, len+n) per slot, so the table
+                # must cover them BEFORE the device call (block ids are
+                # runtime data — growing them costs no retrace)
+                for slot, s in enumerate(self._slots):
+                    if s is not None:
+                        self.cache.ensure_capacity(
+                            slot, int(self.cache.lengths[slot]) + n)
+                toks, nk, nv, keys = self._decode_fn(n)(
+                    self._params, self.cache.pool.k, self.cache.pool.v,
+                    jnp.asarray(self.cache.tables),
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(self.cache.lengths), self._keys,
+                    jnp.asarray(self._temps), jnp.asarray(self._topks))
+            else:
+                toks, nk, nv, keys = self._decode_fn(n)(
+                    self._params, self.cache.k, self.cache.v,
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(self.cache.lengths),
+                    self._keys, jnp.asarray(self._temps),
+                    jnp.asarray(self._topks))
             self.cache.update(nk, nv)
             self._keys = keys
             toks_np = np.asarray(toks)  # [n, num_slots]

@@ -20,6 +20,20 @@ Block copy programs (the prefix-cache transport, ``serving/prefix_cache``):
 compile-once jitted programs — shapes depend only on the cache/pool
 geometry; the slot / row / block indices are runtime scalars — so cache
 hits, evictions, and publishes never add traces.
+
+:class:`PagedKVCache` is the zero-copy successor ("Ragged Paged
+Attention", PAPERS.md): the :class:`~.block_manager.BlockManager` pool
+IS the cache — there is no per-slot dense array at all. Each live slot
+owns a row of a host block table ``[num_slots, max_blocks]`` naming the
+physical pool blocks that spell its logical cache; prefix-cache hits
+install by *referencing* published block ids (no ``copy_block_in``
+dispatch, no private copy — N holders share one block), decode growth
+appends fresh private blocks lazily, and retirement *donates* full
+prompt blocks to the trie instead of copying them out. The same
+alloc/free/``write_prefill`` surface as :class:`SlotKVCache` keeps the
+engine's cold path identical; the decode/suffix programs read the pool
+through runtime table arguments (``serving/decode.py``), so the
+compile-once contract survives unchanged.
 """
 from __future__ import annotations
 
@@ -64,12 +78,36 @@ def _copy_block_out(pool_k, pool_v, cache_k, cache_v, slot, row0, block_id):
     return pk, pv
 
 
+def _paged_write_prefill(pool_k, pool_v, pk, pv, table_row, prompt_len):
+    # pk/pv: [L, S_pad, Hkv, D] -> scatter rows [0, prompt_len) through
+    # the slot's block table into the pool. Rows past prompt_len (bucket
+    # padding) map to the sentinel and DROP — they must not land in the
+    # pool, where the trailing private block is real but any row beyond
+    # it would clip-alias another sequence's block.
+    S = pk.shape[1]
+    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    bi = jnp.minimum(pos // bs, table_row.shape[0] - 1)
+    phys = jnp.where(pos < prompt_len, jnp.take(table_row, bi), nb)
+    row = pos % bs
+    pool_k = pool_k.at[:, phys, row].set(pk, mode="drop")
+    pool_v = pool_v.at[:, phys, row].set(pv, mode="drop")
+    return pool_k, pool_v
+
+
 @functools.lru_cache(maxsize=None)
 def _writer(donate):
     # module-level so every cache instance (one per engine, one engine
     # per model.generate call) shares the jitted program instead of
     # re-tracing it
     return jax.jit(_write_prefill, donate_argnums=(0, 1) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_writer(donate):
+    # donate the POOL arrays (the pool is the cache being updated)
+    return jax.jit(_paged_write_prefill,
+                   donate_argnums=(0, 1) if donate else ())
 
 
 @functools.lru_cache(maxsize=None)
@@ -170,3 +208,179 @@ class SlotKVCache:
         pool.k, pool.v = _block_out(self._donate)(
             pool.k, pool.v, self.k, self.v, np.int32(slot),
             np.int32(row0), np.int32(block_id))
+
+
+class PagedKVCache:
+    """Block-table KV cache: slot allocator + host tables over a shared
+    :class:`~.block_manager.BlockManager` pool — the zero-copy decode
+    cache (module docstring). Surface-compatible with
+    :class:`SlotKVCache` where the engine's cold path needs it
+    (``alloc``/``free``/``num_free``/``lengths``/``write_prefill``/
+    ``update``); the paged-only surface is table bookkeeping:
+
+    - ``install_prefix(slot, block_ids)`` — a prefix-cache hit:
+      reference the published blocks in the slot's table. No copy; the
+      blocks' read pins are the caller's (``PrefixCache.acquire``).
+    - ``ensure_capacity(slot, rows)`` — append-block on growth: allocate
+      private blocks (each carrying the slot's ownership ref) until the
+      table covers ``rows`` logical rows, evicting unpinned trie blocks
+      on demand when the pool runs dry.
+    - ``free(slot, keep=...)`` — release the table: donated blocks
+      (ownership moved to the trie at publish) are unref'd but stay
+      allocated; the rest of the private tail is dropped back to the
+      heap; shared prefix entries are merely forgotten (their pins are
+      released by the engine through ``PrefixCache.release``).
+
+    The pool's device arrays are the single source of KV truth; the
+    decode / suffix-prefill programs update them functionally and the
+    engine adopts the result via :meth:`update`.
+    """
+
+    def __init__(self, num_layers, num_slots, max_seq_len, num_kv_heads,
+                 head_dim, dtype=jnp.float32, block_size=32, pool=None,
+                 prefix_cache=None, donate=None):
+        from .block_manager import BlockManager
+        bs = int(block_size)
+        if bs < 1:
+            raise ValueError(f"block_size must be >= 1, got {bs}")
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.block_size = bs
+        self.max_blocks = -(-self.max_seq_len // bs)
+        if pool is None:
+            pool = BlockManager(num_layers, self.num_slots * self.max_blocks,
+                                bs, num_kv_heads, head_dim, dtype=dtype)
+        if pool.block_size != bs:
+            raise ValueError(
+                f"pool block_size {pool.block_size} != cache block_size "
+                f"{bs}")
+        if pool.num_blocks < self.num_slots * self.max_blocks:
+            raise ValueError(
+                f"pool of {pool.num_blocks} blocks cannot back "
+                f"{self.num_slots} slots x {self.max_blocks} blocks of "
+                f"live KV (worst case needs "
+                f"{self.num_slots * self.max_blocks})")
+        self.pool = pool
+        self.prefix_cache = prefix_cache  # evict-on-demand hook (may be None)
+        self.sentinel = pool.num_blocks   # out-of-pool id: writes drop
+        self.lengths = np.zeros(self.num_slots, np.int32)
+        self.tables = np.full((self.num_slots, self.max_blocks),
+                              self.sentinel, np.int32)
+        self._n_blocks = np.zeros(self.num_slots, np.int32)  # populated
+        self._n_shared = np.zeros(self.num_slots, np.int32)  # leading shared
+        self._free_heap = list(range(self.num_slots))
+        self._free_set = set(self._free_heap)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+
+    # ------------------------------------------------------------- slots
+    @property
+    def num_free(self) -> int:
+        return len(self._free_set)
+
+    def alloc(self):
+        """Claim a free slot (lowest index first, deterministic)."""
+        if not self._free_set:
+            return None
+        slot = heapq.heappop(self._free_heap)
+        self._free_set.discard(slot)
+        return slot
+
+    def free(self, slot: int, keep=()):
+        """Release a slot's table. ``keep`` is the set of block ids whose
+        ownership moved to the prefix trie at publish (donated): they
+        lose this slot's pin but stay allocated; every other private
+        block drops back to the heap. Shared prefix entries (pinned via
+        the trie, not owned here) are forgotten — the engine releases
+        those pins separately."""
+        if slot in self._free_set:
+            raise ValueError(f"slot {slot} double-freed")
+        for j in range(int(self._n_shared[slot]), int(self._n_blocks[slot])):
+            b = int(self.tables[slot, j])
+            if b in keep:
+                self.pool.unref(b)   # trie adopted it; give up ownership
+            else:
+                self.pool.drop(b)    # unref -> 0 -> back to the heap
+        self.tables[slot, :] = self.sentinel
+        self._n_blocks[slot] = 0
+        self._n_shared[slot] = 0
+        self.lengths[slot] = 0
+        heapq.heappush(self._free_heap, slot)
+        self._free_set.add(slot)
+
+    # ------------------------------------------------------------ tables
+    def install_prefix(self, slot, block_ids):
+        """Zero-copy prefix-hit install: the slot's leading table
+        entries REFERENCE the published blocks. The caller holds the
+        read pins (``PrefixCache.acquire`` at lookup); nothing is
+        dispatched and nothing is copied — this is the whole point."""
+        n = len(block_ids)
+        if n > self.max_blocks:
+            raise ValueError(
+                f"prefix of {n} blocks exceeds the {self.max_blocks}-entry "
+                f"table")
+        for j, b in enumerate(block_ids):
+            self.tables[slot, j] = int(b)
+        self._n_blocks[slot] = n
+        self._n_shared[slot] = n
+
+    def _alloc_block(self):
+        b = self.pool.alloc()
+        while b is None and self.prefix_cache is not None \
+                and self.prefix_cache._evict_one():
+            b = self.pool.alloc()
+        if b is None:
+            # unreachable when the pool is sized num_slots*max_blocks +
+            # trie budget (live demand is bounded by the table grid and
+            # everything else is an evictable unpinned trie block) —
+            # kept as a hard stop for mis-sized shared pools
+            raise RuntimeError(
+                "KV block pool exhausted: live sequences + pinned prefix "
+                "blocks exceed the pool; size the pool to at least "
+                "num_slots * max_blocks + prefix budget")
+        self.pool.ref(b)             # the slot's ownership pin
+        return b
+
+    def ensure_capacity(self, slot, rows: int):
+        """Append private blocks until the slot's table covers ``rows``
+        logical rows (decode growth / prefill install). Lazy on purpose:
+        unwritten tail blocks stay in the pool for the prefix trie until
+        a decode chunk actually needs them."""
+        need = min(-(-int(rows) // self.block_size), self.max_blocks)
+        n = int(self._n_blocks[slot])
+        while n < need:
+            self.tables[slot, n] = self._alloc_block()
+            n += 1
+        self._n_blocks[slot] = n
+
+    def slot_block_ids(self, slot):
+        """Physical block ids populating the slot's table, in logical
+        order — the donation candidates at retirement."""
+        return [int(b) for b in self.tables[slot, :int(self._n_blocks[slot])]]
+
+    def table_fill(self) -> float:
+        """Fraction of the [num_slots, max_blocks] table grid populated —
+        the ``kv_block_table_fill`` gauge."""
+        return float(self._n_blocks.sum()) / float(
+            self.num_slots * self.max_blocks)
+
+    # ------------------------------------------------------------ writes
+    def write_prefill(self, slot, pk, pv, prompt_len):
+        """Install a prefilled prompt's K/V into ``slot`` — through the
+        block table, into private pool blocks (one compile-once scatter
+        per prefill bucket; the table row and length are runtime
+        arguments)."""
+        if pk.shape[1] > self.max_seq_len:
+            raise ValueError(
+                f"prefill length {pk.shape[1]} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        self.ensure_capacity(slot, int(prompt_len))
+        self.pool.k, self.pool.v = _paged_writer(self._donate)(
+            self.pool.k, self.pool.v, pk, pv,
+            jnp.asarray(self.tables[slot]), np.int32(prompt_len))
+        self.lengths[slot] = int(prompt_len)
+
+    def update(self, new_k, new_v):
+        """Adopt the decode/suffix step's functionally-updated pool."""
+        self.pool.k, self.pool.v = new_k, new_v

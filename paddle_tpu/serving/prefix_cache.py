@@ -22,13 +22,23 @@ work for every prompt block some earlier request already computed:
   first; if the pool is exhausted by pinned blocks the remaining
   publishes are skipped, never failed — the cache degrades to fewer
   hits, not errors.
-- **Copy-on-install (the COW discipline)**: a hit COPIES its matched
-  blocks into the sequence's private slot (``copy_block_in``), so pool
-  blocks are write-once/read-many and two sequences sharing a prefix
-  can diverge freely — their decode appends land in their own slots.
-  True zero-copy sharing needs block-table paged attention (ROADMAP
-  open item); at slot granularity install-copy is the aliasing-safe
-  form of COW.
+- **Copy-on-install (the dense COW discipline)**: on the dense engine a
+  hit COPIES its matched blocks into the sequence's private slot
+  (``copy_block_in``), so pool blocks are write-once/read-many and two
+  sequences sharing a prefix can diverge freely — their decode appends
+  land in their own slots. At slot granularity install-copy is the
+  aliasing-safe form of COW.
+- **Zero-copy install + donation (the paged engine)**: with block-table
+  paged attention (:class:`~.kv_cache.PagedKVCache`) a hit installs by
+  *referencing* the matched block ids in the sequence's table — no
+  device dispatch at all — and N concurrent holders physically share
+  one block (refcount = N readers). Divergent continuations are still
+  safe: every write lands at a logical row >= the covered prefix, which
+  maps to a privately-owned tail block, never a shared one. Retirement
+  publishes by :meth:`publish_donate` — full prompt blocks already
+  sitting in the sequence's private tail are ADOPTED by the trie
+  in place (ownership handoff, no ``copy_block_out``), so the paged
+  path runs the whole hit/publish lifecycle with zero copy dispatches.
 
 Compile discipline: lookups/inserts/evictions are pure host work; the
 only device programs are the two block-copy programs (compile-once, see
@@ -65,16 +75,22 @@ class PrefixCache:
     contract), so no locks.
     """
 
-    def __init__(self, pool):
+    def __init__(self, pool, max_blocks=None):
         self.pool = pool
         self.block_size = pool.block_size
+        # trie residency budget. On the dense engine the pool IS the
+        # budget (publish allocates from it, exhaustion evicts). On the
+        # paged engine the pool also backs live KV, so donation enforces
+        # this explicit cap instead: adopt first, then evict LRU down to
+        # budget. None = bounded by the pool alone.
+        self.max_blocks = None if max_blocks is None else int(max_blocks)
         self._root = {}              # token tuple -> _Node
         self._nodes = 0              # live trie nodes (== pool.num_used)
         self._tick = itertools.count(1)
         self.stats = {"lookups": 0, "hits": 0, "misses": 0,
                       "hit_blocks": 0, "hit_tokens": 0,
                       "published_blocks": 0, "evictions": 0,
-                      "skipped_publishes": 0}
+                      "skipped_publishes": 0, "donated_blocks": 0}
 
     # ------------------------------------------------------------- lookup
     def _blocks_of(self, prompt, max_tokens):
@@ -163,6 +179,57 @@ class PrefixCache:
             for node in walked:
                 self.pool.unref(node.block_id)
 
+    def publish_donate(self, prompt, block_ids):
+        """Paged-path publish: insert every full prompt block by
+        ADOPTING the retiring sequence's own pool block — an ownership
+        handoff, zero copy dispatches. ``block_ids`` is the sequence's
+        table in logical order (``PagedKVCache.slot_block_ids``);
+        ``block_ids[i]`` already holds exactly prompt rows
+        [i*bs, (i+1)*bs) because prefill/decode wrote through the table.
+
+        Returns the set of adopted block ids — the caller must hand
+        their ownership pins to the trie (unref-without-free) instead of
+        dropping them. Blocks whose token content is already cached are
+        NOT adopted (the existing node wins; the duplicate stays in the
+        caller's tail and is freed with it). Needs no allocation, so it
+        can never evict, skip, or fail — the paged publish degrades to
+        "nothing new to donate", never to lost work."""
+        prompt = np.asarray(prompt).reshape(-1)
+        children, parent = self._root, None
+        tick = next(self._tick)
+        walked = []   # transient pins: later links can't outlive earlier
+        donated = set()
+        try:
+            for i, key in enumerate(self._blocks_of(prompt, len(prompt))):
+                if i >= len(block_ids):
+                    break  # table shorter than the prompt (cancelled
+                    # pre-prefill); donate what exists
+                node = children.get(key)
+                if node is None:
+                    node = _Node(key, parent, int(block_ids[i]))
+                    children[key] = node
+                    self._nodes += 1
+                    donated.add(int(block_ids[i]))
+                    self.stats["published_blocks"] += 1
+                    self.stats["donated_blocks"] += 1
+                node.tick = tick
+                self.pool.ref(node.block_id)
+                walked.append(node)
+                children, parent = node.children, node
+        finally:
+            for node in walked:
+                self.pool.unref(node.block_id)
+        # enforce the trie budget AFTER the walk's pins release: adopt
+        # first (the freshest chain carries the newest tick, so LRU
+        # reaps older cold chains, not the donation), then trim. Pinned
+        # chains (live readers) are never evictable, so residency may
+        # transiently exceed the budget under heavy concurrency — it
+        # drains back as pins release.
+        if self.max_blocks is not None:
+            while self._nodes > self.max_blocks and self._evict_one():
+                pass
+        return donated
+
     # ----------------------------------------------------------- eviction
     def _iter_nodes(self):
         stack = list(self._root.values())
@@ -178,8 +245,12 @@ class PrefixCache:
         its still-resident descendants); the refcount invariant
         ref(parent) >= ref(child) guarantees a zero-ref leaf exists
         whenever any zero-ref node does. One O(trie) min pass per
-        eviction — the trie is bounded by the pool size, and evictions
-        only fire on publish-under-pressure, never on the decode path.
+        eviction — the trie is bounded by the pool (and, on the paged
+        engine, the ``max_blocks`` budget). Evictions fire on
+        publish-under-pressure (dense), on the post-donation budget trim
+        (paged), and on paged decode-growth when live allocation finds
+        the pool dry (``PagedKVCache._alloc_block`` — rare while the
+        budget holds trie residency under the pool's live headroom).
         """
         node = None
         for n in self._iter_nodes():

@@ -232,6 +232,24 @@ class ServingGateway:
                 "Decode-program traces (compile-once contract: stays at "
                 "one per (num_slots, max_seq_len, n_steps)).").set_fn(
             self.engine.decode_compilations)
+        r.counter("serving_prefill_copy_dispatches_total",
+                  "Block copy-in dispatches spent installing prefix "
+                  "hits (dense engine only; the paged path pins this "
+                  "at 0 — hits install by reference).").set_fn(
+            lambda: self.engine.stats["prefill_copy_dispatches"])
+        cache = getattr(self.engine, "cache", None)
+        if getattr(self.engine, "_paged", False) and cache is not None:
+            # paged-attention surface: physical sharing + table pressure
+            # (scrape-time reads of host bookkeeping; driver is the only
+            # writer, a scrape reads ints under the GIL)
+            r.gauge("kv_blocks_shared",
+                    "Pool blocks physically shared by concurrent "
+                    "readers (refcount >= 2) — the zero-copy win."
+                    ).set_fn(lambda: cache.pool.num_shared)
+            r.gauge("kv_block_table_fill",
+                    "Fraction of the [num_slots, max_blocks] block "
+                    "table grid populated by live sequences."
+                    ).set_fn(cache.table_fill)
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:
             # scrape-time counters backed by the cache's own monotonic

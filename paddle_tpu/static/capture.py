@@ -68,15 +68,22 @@ class StaticProgram:
                         # a placeholder it will be FROZEN at build-time
                         # values — warn loudly (layers legitimately build
                         # constant tensors in __init__, so this cannot be
-                        # a hard error).
-                        import warnings
-                        warnings.warn(
+                        # a hard error by default; FLAGS_static_strict
+                        # promotes it to one for capture-audit runs).
+                        msg = (
                             f"static capture: input of op '{name}' was "
                             f"created inside program_guard without going "
                             f"through the op dispatch; it is captured as a "
                             f"BUILD-TIME CONSTANT. If it derives from a "
                             f"data() placeholder, the program will ignore "
                             f"that feed.")
+                        from ..utils.flags import get_flag
+                        if get_flag("FLAGS_static_strict", False):
+                            raise RuntimeError(
+                                msg + " (FLAGS_static_strict promotes "
+                                "this warning to an error)")
+                        import warnings
+                        warnings.warn(msg)
                     # a tensor from OUTSIDE the program (weights, eager
                     # constants): captured by value, like the reference's
                     # persistable vars

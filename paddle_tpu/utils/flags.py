@@ -63,3 +63,7 @@ define_flag("FLAGS_allocator_strategy", "xla",
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 1.0, "XLA-managed")
 define_flag("FLAGS_use_pallas_kernels", True,
             "use Pallas fused kernels (flash attention etc.) when on TPU")
+define_flag("FLAGS_static_strict", False,
+            "promote the static-capture constant-hazard warning (a tensor "
+            "created inside program_guard without going through the op "
+            "dispatch is frozen as a build-time constant) to an error")

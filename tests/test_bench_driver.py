@@ -74,6 +74,7 @@ class TestBenchDriverFlow:
         assert art["decode_cb"]["ok"] is False
         assert art["serve_http"]["ok"] is False
         assert art["prefix_cache"]["ok"] is False
+        assert art["paged_attn"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -103,6 +104,14 @@ class TestBenchDriverFlow:
                 return 0, json.dumps({"name": "prefix_cache", "ok": True,
                                       "prefill_work_reduction": 2.0,
                                       "hit_rate": 0.67,
+                                      "tokens_equal": True}), ""
+            if leg == "--paged-attn":
+                # paged-attention leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "paged_attn", "ok": True,
+                                      "copy_dispatches_eliminated": 24,
+                                      "paged_copy_dispatches": 0,
+                                      "hbm_reduction": 2.27,
                                       "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
@@ -138,12 +147,14 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:3] == ["--decode-cb", "--serve-http",
-                             "--prefix-cache"]
+        assert order[:4] == ["--decode-cb", "--serve-http",
+                             "--prefix-cache", "--paged-attn"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
         assert art["prefix_cache"]["prefill_work_reduction"] == 2.0
+        assert art["paged_attn"]["paged_copy_dispatches"] == 0
+        assert art["paged_attn"]["copy_dispatches_eliminated"] == 24
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

@@ -48,6 +48,44 @@ class TestPool3D:
             torch.tensor(x), 4).numpy()
         np.testing.assert_allclose(ours, ref, atol=1e-6)
 
+    @pytest.mark.parametrize("L,out", [(12, 4), (10, 3)])  # even + ragged
+    def test_adaptive_max_pool1d_return_mask(self, L, out):
+        x = np.random.RandomState(5).randn(2, 3, L).astype(np.float32)
+        got, mask = F.adaptive_max_pool1d(_t(x), out, return_mask=True)
+        want, widx = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x), out, return_indices=True)
+        np.testing.assert_allclose(_np(got), want.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(_np(mask), widx.numpy())
+
+    @pytest.mark.parametrize("shape,out", [((8, 8), (2, 2)),
+                                           ((7, 9), (3, 4))])
+    def test_adaptive_max_pool2d_return_mask(self, shape, out):
+        x = np.random.RandomState(6).randn(2, 2, *shape).astype(np.float32)
+        got, mask = F.adaptive_max_pool2d(_t(x), out, return_mask=True)
+        want, widx = torch.nn.functional.adaptive_max_pool2d(
+            torch.tensor(x), out, return_indices=True)
+        np.testing.assert_allclose(_np(got), want.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(_np(mask), widx.numpy())
+
+    @pytest.mark.parametrize("shape,out", [((6, 8, 4), (3, 4, 2)),
+                                           ((6, 8, 4), (4, 3, 3))])
+    def test_adaptive_max_pool3d_return_mask(self, shape, out):
+        x = np.random.RandomState(7).randn(1, 2, *shape).astype(np.float32)
+        got, mask = F.adaptive_max_pool3d(_t(x), list(out),
+                                          return_mask=True)
+        want, widx = torch.nn.functional.adaptive_max_pool3d(
+            torch.tensor(x), out, return_indices=True)
+        np.testing.assert_allclose(_np(got), want.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(_np(mask), widx.numpy())
+
+    def test_adaptive_max_pool_layers_return_mask(self):
+        x = np.random.RandomState(8).randn(1, 2, 9).astype(np.float32)
+        out, mask = nn.AdaptiveMaxPool1D(3, return_mask=True)(_t(x))
+        want, widx = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x), 3, return_indices=True)
+        np.testing.assert_allclose(_np(out), want.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(_np(mask), widx.numpy())
+
     def test_max_unpool2d_roundtrip(self):
         x = np.random.RandomState(4).randn(1, 2, 8, 8).astype(np.float32)
         pooled, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)

@@ -1,0 +1,159 @@
+"""Pallas ragged-paged decode kernel parity ("Ragged Paged Attention",
+PAPERS.md): single-query attention walking a per-sequence block table
+over a shared KV pool, interpret-mode oracle suite mirroring
+test_pallas_decode.py. The extra paged properties pinned here: the
+table indirection is exact (scrambled physical placement changes
+nothing), sharing a physical block between rows is exact (the zero-copy
+prefix-hit story), and sentinel/dead-slot tables stay finite."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.pallas_decode import decode_attention_reference
+from paddle_tpu.kernels.pallas_paged_decode import (
+    paged_decode_attention_pallas, paged_decode_attention_reference)
+
+
+def _mk_paged(B, H, Hkv, D, mb, bs, seed=0, dtype=jnp.float32,
+              nan_free_pool=True, share=None):
+    """Build a pool + scrambled tables so logical row order != physical
+    order. ``share``: list of (row_a, row_b, n_blocks) aliasing the
+    leading n blocks of two rows onto the same physical blocks."""
+    r = np.random.RandomState(seed)
+    q = jnp.asarray(r.randn(B, H, D), dtype)
+    num_blocks = B * mb + 2
+    pool_k = r.randn(num_blocks, bs, Hkv, D).astype(np.float32)
+    pool_v = r.randn(num_blocks, bs, Hkv, D).astype(np.float32)
+    perm = r.permutation(B * mb)
+    tables = np.asarray(perm.reshape(B, mb), np.int32)
+    for a, b, n in (share or []):
+        tables[b, :n] = tables[a, :n]
+    lengths = np.asarray(r.randint(1, mb * bs + 1, B), np.int32)
+    return (q, jnp.asarray(pool_k, dtype), jnp.asarray(pool_v, dtype),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+
+def _dense_view(pool_k, pool_v, tables):
+    """Gathered dense [B, mb*bs, Hkv, D] caches — the oracle's oracle."""
+    pk = np.asarray(pool_k)[np.asarray(tables)]
+    pv = np.asarray(pool_v)[np.asarray(tables)]
+    B, mb, bs, Hkv, D = pk.shape
+    return (jnp.asarray(pk.reshape(B, mb * bs, Hkv, D)),
+            jnp.asarray(pv.reshape(B, mb * bs, Hkv, D)))
+
+
+class TestPagedDecodeKernelParity:
+    @pytest.mark.parametrize("B,H,Hkv,D,mb,bs", [
+        # plain MHA is -m slow: the ragged/sentinel/indirection tests
+        # below already cover MHA shapes (suite-budget discipline)
+        pytest.param(2, 4, 4, 64, 4, 32, marks=pytest.mark.slow),  # MHA
+        (2, 8, 2, 64, 4, 32),     # GQA group 4
+        (3, 8, 1, 64, 3, 16),     # MQA, small blocks
+        (1, 16, 16, 128, 2, 8),   # minimal sublane block
+    ])
+    def test_matches_paged_reference(self, B, H, Hkv, D, mb, bs):
+        q, pk, pv, tbl, lens = _mk_paged(B, H, Hkv, D, mb, bs, seed=B + H)
+        got = paged_decode_attention_pallas(q, pk, pv, tbl, lens)
+        want = paged_decode_attention_reference(q, pk, pv, tbl, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_table_indirection_equals_dense_gather(self):
+        """The load-bearing paged property: attention through a
+        SCRAMBLED table equals dense ragged attention over the gathered
+        view — physical placement is invisible."""
+        q, pk, pv, tbl, lens = _mk_paged(3, 8, 4, 64, 4, 16, seed=5)
+        dk, dv = _dense_view(pk, pv, tbl)
+        want = decode_attention_reference(q, dk, dv, lens)
+        got = paged_decode_attention_pallas(q, pk, pv, tbl, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        got_ref = paged_decode_attention_reference(q, pk, pv, tbl, lens)
+        np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_shared_physical_blocks_exact(self):
+        """Two rows whose tables alias the same leading physical blocks
+        (a zero-copy prefix hit) each compute exactly what a private
+        copy would — reads don't care about sharing."""
+        q, pk, pv, tbl, lens = _mk_paged(
+            2, 4, 4, 64, 4, 16, seed=9, share=[(0, 1, 2)])
+        assert np.asarray(tbl)[0, 0] == np.asarray(tbl)[1, 0]
+        dk, dv = _dense_view(pk, pv, tbl)
+        want = decode_attention_reference(q, dk, dv, lens)
+        got = paged_decode_attention_pallas(q, pk, pv, tbl, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_sentinel_tail_and_dead_slot_stay_finite(self):
+        """Unmapped table entries carry the sentinel (>= num_blocks) and
+        dead slots are all-sentinel with length 0 — both must clamp to
+        harmless reads, never index out of the pool or emit NaN."""
+        q, pk, pv, tbl, lens = _mk_paged(3, 8, 4, 64, 4, 16, seed=13)
+        tbl = np.asarray(tbl).copy()
+        lens = np.asarray(lens).copy()
+        num_blocks = pk.shape[0]
+        lens[1] = 16                      # one block valid
+        tbl[1, 1:] = num_blocks           # unmapped tail -> sentinel
+        tbl[2, :] = num_blocks            # dead slot
+        lens[2] = 0
+        got = np.asarray(paged_decode_attention_pallas(
+            q, pk, pv, jnp.asarray(tbl), jnp.asarray(lens)))
+        assert np.isfinite(got).all()
+        want = np.asarray(paged_decode_attention_reference(
+            q, pk, pv, jnp.asarray(tbl), jnp.asarray(lens)))
+        # live rows match the oracle exactly; the dead row's output is
+        # garbage-by-contract (engine never reads it) but stays finite
+        np.testing.assert_allclose(got[:2], want[:2], rtol=2e-5, atol=2e-5)
+
+    def test_ragged_len_one_row(self):
+        """A length-1 row attends over exactly its first pool row."""
+        B, H, Hkv, D, mb, bs = 2, 4, 4, 64, 4, 16
+        q, pk, pv, tbl, lens = _mk_paged(B, H, Hkv, D, mb, bs, seed=3)
+        lens = np.asarray(lens).copy()
+        lens[0] = 1
+        got = np.asarray(paged_decode_attention_pallas(
+            q, pk, pv, tbl, jnp.asarray(lens)))
+        first_block = int(np.asarray(tbl)[0, 0])
+        np.testing.assert_allclose(got[0], np.asarray(pv)[first_block, 0],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16_io(self):
+        q, pk, pv, tbl, lens = _mk_paged(2, 8, 8, 128, 2, 32, seed=11,
+                                         dtype=jnp.bfloat16)
+        got = paged_decode_attention_pallas(q, pk, pv, tbl, lens)
+        want = paged_decode_attention_reference(q, pk, pv, tbl, lens)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+    def test_jit_and_scan_composable(self):
+        """Must trace under jit inside a lax.scan over layers — the
+        exact shape of the paged decode loop (per-layer pool slices,
+        one shared table)."""
+        B, H, Hkv, D, mb, bs, L = 2, 4, 2, 64, 4, 16, 3
+        r = np.random.RandomState(5)
+        q = jnp.asarray(r.randn(L, B, H, D), jnp.float32)
+        num_blocks = B * mb
+        pk = jnp.asarray(r.randn(L, num_blocks, bs, Hkv, D), jnp.float32)
+        pv = jnp.asarray(r.randn(L, num_blocks, bs, Hkv, D), jnp.float32)
+        tbl = jnp.asarray(
+            r.permutation(num_blocks).reshape(B, mb), jnp.int32)
+        lens = jnp.asarray([40, 64], jnp.int32)
+
+        @jax.jit
+        def run(q, pk, pv):
+            def body(carry, xs):
+                ql, kl, vl = xs
+                return carry + 1, paged_decode_attention_pallas(
+                    ql, kl, vl, tbl, lens)
+            _, outs = jax.lax.scan(body, 0, (q, pk, pv))
+            return outs
+
+        outs = np.asarray(run(q, pk, pv))
+        for l in range(L):
+            want = np.asarray(paged_decode_attention_reference(
+                q[l], pk[l], pv[l], tbl, lens))
+            np.testing.assert_allclose(outs[l], want, rtol=2e-5, atol=2e-5)

@@ -142,6 +142,43 @@ class TestStaticProgram:
                 paddle.add(frozen, x)
             assert any("BUILD-TIME CONSTANT" in str(wi.message) for wi in w)
 
+    def test_static_strict_promotes_hazard_to_error(self):
+        """FLAGS_static_strict: a data()-derived tensor flowing around
+        the dispatch (here: rebuilt from the placeholder's host value —
+        the classic silent-freeze bug) is CAUGHT as an error instead of
+        a warning; the same capture keeps working with the flag off."""
+        from paddle_tpu.core.tensor import Tensor as RawTensor
+        paddle.set_flags({"FLAGS_static_strict": True})
+        try:
+            prog = static.StaticProgram()
+            with static.program_guard(prog):
+                x = static.data("x", shape=[2])
+                # derives from the placeholder but bypasses dispatch:
+                # the feed would be silently ignored at replay
+                leaked = RawTensor(np.asarray(x.numpy() + 1.0))
+                with pytest.raises(RuntimeError,
+                                   match="BUILD-TIME CONSTANT"):
+                    paddle.add(leaked, x)
+        finally:
+            paddle.set_flags({"FLAGS_static_strict": False})
+        # flag off: same construction degrades to the warning, and the
+        # frozen value really is a build-time constant at replay
+        import warnings
+        prog = static.StaticProgram()
+        with static.program_guard(prog):
+            x = static.data("x", shape=[2])
+            leaked = RawTensor(np.asarray(x.numpy() + 1.0))
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                out = paddle.add(leaked, x)
+            assert any("BUILD-TIME CONSTANT" in str(wi.message)
+                       for wi in w)
+        r, = static.Executor().run(
+            prog, feed={"x": np.asarray([5.0, 5.0], np.float32)},
+            fetch_list=[out])
+        # leaked froze at build-time values (zeros + 1), ignoring the feed
+        np.testing.assert_allclose(r, [6.0, 6.0])
+
 
 class TestInferenceModelSaveLoad:
     """static.save_inference_model / load_inference_model (reference
