@@ -477,6 +477,15 @@ def watchdog():
     pg = _parse_result(rc, out)
     cb_extra["paged_attn"] = pg if pg is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Chunked-prefill leg: short-request p95 TTFT with a long cold
+    # prompt amid decode traffic, chunked vs unchunked
+    # (scripts/bench_chunked.py) — calibrated deterministic replay,
+    # CPU-forced, banked up front like the other scheduling legs.
+    rc, out, err = _run([me, "--chunked-prefill"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    cp = _parse_result(rc, out)
+    cb_extra["chunked_prefill"] = cp if cp is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -624,6 +633,13 @@ if __name__ == "__main__":
         from bench_paged import measure_paged_attn
         print(json.dumps({"name": "paged_attn", "ok": True,
                           **measure_paged_attn(quick=True)}))
+        sys.exit(0)
+    if "--chunked-prefill" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_chunked import measure_chunked_prefill
+        print(json.dumps({"name": "chunked_prefill", "ok": True,
+                          **measure_chunked_prefill(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

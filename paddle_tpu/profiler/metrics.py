@@ -308,9 +308,23 @@ class Gauge(_Metric):
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                    5.0, 10.0, 30.0, 60.0, 120.0)
 
+# time-to-first-token ladder (``serving_ttft_seconds``): TTFT is the
+# latency chunked prefill exists to bound, so its low end needs sub-ms
+# resolution (a CPU tiny-model decode tick is ~1 ms; a healthy TTFT on
+# real chips is tens of ms) while the tail still distinguishes a
+# 1 s stall from a 10 s one.
+TTFT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
 
 class Histogram(_Metric):
-    """Cumulative-bucket histogram (latency distributions)."""
+    """Cumulative-bucket histogram (latency distributions).
+
+    :meth:`quantile` estimates order statistics from the bucket counts
+    (the ``histogram_quantile``-style interpolation) — good enough for
+    p95 acceptance gates (scripts/bench_chunked.py) without recording
+    raw observations.
+    """
 
     kind = "histogram"
 
@@ -334,6 +348,34 @@ class Histogram(_Metric):
                     state["counts"][i] += 1
             state["sum"] += value
             state["count"] += 1
+
+    def quantile(self, q, **labels):
+        """Estimate the ``q``-quantile (0 < q <= 1) from the bucket
+        counts, Prometheus ``histogram_quantile`` style: find the
+        bucket the target rank lands in and interpolate linearly inside
+        it (lower edge = previous bucket bound, 0 below the first).
+        Observations above the last finite bucket clamp to that bound —
+        same behavior as PromQL, and the reason the ladder's top bucket
+        should sit above any latency you care to distinguish. Returns
+        0.0 for an empty series."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile q must be in (0, 1], got {q}")
+        with self._lock:
+            state = self._series.get(self._key(labels))
+            if state is None or not state["count"]:
+                return 0.0
+            counts = list(state["counts"])
+            total = state["count"]
+        target = q * total
+        prev_count, lower = 0, 0.0
+        for ub, c in zip(self.buckets, counts):
+            if c >= target:
+                if c == prev_count:   # empty bucket can't be hit; guard
+                    return ub
+                frac = (target - prev_count) / (c - prev_count)
+                return lower + (ub - lower) * frac
+            prev_count, lower = c, ub
+        return self.buckets[-1]       # rank beyond the last finite bound
 
     def _sample_lines(self, labels, state):
         lines = []

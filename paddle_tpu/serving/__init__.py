@@ -14,16 +14,20 @@ Public surface:
   state (per-request deadlines via ``timeout_s``; ``finish_reason`` ∈
   :data:`FINISH_REASONS` = stop|length|cancelled|timeout)
 - :class:`GenerationResult` — array-like generate() output + finish_reason
-- :class:`SlotKVCache` — the dense per-slot KV cache manager
-- :class:`PagedKVCache` — true block-table paged attention: the
-  :class:`BlockManager` pool IS the cache, slots address it through
-  per-slot block tables, prefix hits are zero-copy references and
-  retirement donates blocks to the trie (``paged_attn=True`` on the
-  engine; README "Paged attention")
-- :class:`FIFOScheduler` — admission + fused-chunk step policy
+- :class:`SlotKVCache` — the dense per-slot KV cache (legacy
+  compatibility shim, ``paged_attn=False``)
+- :class:`PagedKVCache` — true block-table paged attention, THE
+  default: the :class:`BlockManager` pool IS the cache, slots address
+  it through per-slot block tables, prefix hits are zero-copy
+  references and retirement donates prompt AND generated blocks to the
+  trie (README "Paged attention")
+- :class:`FIFOScheduler` — admission + fused-chunk step policy +
+  chunked-prefill token budgeting
 - :class:`ContinuousBatchingEngine` — the step-function serving API
   (``cancel()``, deadline sweeps, ``on_token``/``on_finish`` streaming
-  hooks; ``prefix_cache=True`` turns on automatic prefix caching)
+  hooks; ``prefix_cache=True`` turns on automatic prefix caching;
+  ``prefill_chunk`` interleaves long cold-prompt prefills with decode
+  steps to bound TTFT — README "Chunked prefill")
 - :class:`BlockManager` / :class:`PrefixCache` — the block-granular
   prefix-cache subsystem: ref-counted KV block pool + hash-trie over
   prompt token blocks with LRU eviction (README "Automatic prefix

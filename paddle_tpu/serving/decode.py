@@ -275,6 +275,17 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     shared prefix blocks are READ through the same table but never
     written: that is the zero-copy COW discipline in one line.
 
+    This program is ALSO the chunked-prefill program (engine
+    ``prefill_chunk``, README "Chunked prefill"): a long cold prompt's
+    chunk c is just a "suffix" whose ``prefix_lens`` is the host resume
+    offset of the rows chunks 0..c-1 already wrote through the table —
+    the offset machinery is row-exact, so nothing new is needed at this
+    layer. The engine buckets chunk lengths on ``prefill_chunk`` (full
+    chunks share ONE bucket; only final remainders ride the pow2 grid)
+    and discards tok0/keys' for every non-final chunk, so the PRNG
+    advances exactly once per prompt — token streams stay byte-identical
+    to a one-shot prefill.
+
     Shapes depend only on (G_pad, S_pad, pool geometry, max_blocks);
     tables/lengths/knobs are runtime arrays, so the compile set stays
     the same pow2 (group, bucket) grid as the dense suffix path.
@@ -352,8 +363,9 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
 
 def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
                                   donate=None):
-    """One jitted paged suffix prefill; retraces per (group, bucket)
-    shape — same bounded pow2 grid as the dense suffix path."""
+    """One jitted paged suffix prefill — doubling as THE chunked-prefill
+    program (see ``_paged_suffix_prefill_impl``); retraces per (group,
+    bucket) shape — same bounded pow2 grid as the dense suffix path."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
     return jax.jit(

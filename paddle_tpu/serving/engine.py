@@ -58,26 +58,43 @@ class ContinuousBatchingEngine:
     size the pool the engine builds itself (default: enough blocks to
     cache ``num_slots`` full-length prompts at 32-token granularity).
 
-    ``paged_attn=True`` replaces the dense per-slot KV cache with true
-    block-table paged attention (:class:`~.kv_cache.PagedKVCache`,
-    README "Paged attention"): the :class:`~.block_manager.BlockManager`
-    pool IS the cache, every live slot addresses it through a per-slot
-    block table (a runtime argument — ``decode_compilations()`` stays at
-    1), prefix-cache hits install by *referencing* published block ids
+    ``paged_attn=True`` (the default) serves from true block-table
+    paged attention (:class:`~.kv_cache.PagedKVCache`, README "Paged
+    attention"): the :class:`~.block_manager.BlockManager` pool IS the
+    cache, every live slot addresses it through a per-slot block table
+    (a runtime argument — ``decode_compilations()`` stays at 1),
+    prefix-cache hits install by *referencing* published block ids
     (zero copy dispatches; N holders physically share one block), decode
     growth appends blocks lazily, and retirement *donates* full prompt
-    blocks to the trie instead of copying them out. Token streams are
-    byte-identical to the dense engine. ``prefix_block_size`` doubles as
-    the KV block size; the pool is sized
-    ``num_slots * ceil(max_seq_len/block_size)`` live blocks plus the
-    ``prefix_blocks`` trie budget (trie-only blocks are reclaimed on
+    AND generated blocks to the trie instead of copying them out (so a
+    multi-turn resubmission of an assistant turn hits that turn's own
+    blocks). Token streams are byte-identical to the dense engine
+    (``paged_attn=False``, the legacy :class:`~.kv_cache.SlotKVCache`
+    path — still selectable, same test matrix).
+    ``prefix_block_size`` doubles as the KV block size; the pool is
+    sized ``num_slots * ceil(max_seq_len/block_size)`` live blocks plus
+    the ``prefix_blocks`` trie budget (trie-only blocks are reclaimed on
     demand when live growth needs them).
+
+    ``prefill_chunk`` bounds TTFT under mixed traffic (README "Chunked
+    prefill"): a cold prompt whose uncovered tail exceeds it is
+    prefilled ``prefill_chunk`` tokens per engine step — through the
+    paged suffix-prefill program at a host-side resume offset, KV
+    landing in the slot's own pool blocks — interleaved with the fused
+    decode tick for every live slot, so a long prompt never monopolizes
+    a step while decode slots idle. Chunk boundaries are block-aligned
+    (the value is rounded up to a block multiple); installed prefix-
+    cache hits count toward the resume offset; cancellation or deadline
+    expiry mid-chunk frees (or donates) the partial block chain.
+    ``prefill_chunk=None``/``0`` disables chunking; the dense engine
+    ignores it (one-shot prefill — chunking rides the block tables).
     """
 
     def __init__(self, model, num_slots=8, max_seq_len=None, decode_chunk=8,
                  prefill_bucketing="pow2", jit_cache=None,
                  prefix_cache=False, prefix_blocks=None,
-                 prefix_block_size=32, paged_attn=False):
+                 prefix_block_size=32, paged_attn=True,
+                 prefill_chunk=512):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -180,6 +197,22 @@ class ContinuousBatchingEngine:
                     self.prefix_cache = PrefixCache(BlockManager(
                         c.num_hidden_layers, nb, bs, c.num_key_value_heads,
                         c.head_dim, dtype=dtype))
+        # chunked prefill (paged only — the dense per-slot cache has no
+        # block tables to resume through; its prefill stays one-shot).
+        # The chunk is rounded UP to a block multiple so every non-final
+        # chunk boundary is block-aligned: a partially prefilled prompt
+        # is exactly a prefix of whole pool blocks + a host resume
+        # offset, which keeps mid-prefill cancellation/donation trivial.
+        self._chunk = None
+        if prefill_chunk and int(prefill_chunk) < 1:
+            # validated on BOTH engines: an A/B toggle of paged_attn
+            # must not turn a hard error into a silent no-op
+            raise ValueError(
+                f"prefill_chunk must be >= 1 (or None/0 to disable), "
+                f"got {int(prefill_chunk)}")
+        if self._paged and prefill_chunk:
+            bs = self.cache.block_size
+            self._chunk = -(-int(prefill_chunk) // bs) * bs
         self.scheduler = FIFOScheduler(decode_chunk)
         self._slots = [None] * self.num_slots
         self._last_tok = np.zeros(self.num_slots, np.int32)
@@ -195,6 +228,7 @@ class ContinuousBatchingEngine:
                       "prefills": 0, "prefill_tokens": 0,
                       "prefill_tokens_saved": 0,
                       "prefill_copy_dispatches": 0,
+                      "prefill_chunks": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
@@ -240,6 +274,16 @@ class ContinuousBatchingEngine:
                 decode_attn=self.config.decode_attention,
                 **self._fn_consts())
         return self._jit[key]
+
+    @property
+    def prefill_chunk(self) -> int:
+        """The EFFECTIVE chunked-prefill budget this engine runs: the
+        configured value rounded up to a KV-block multiple, or 0 when
+        chunking is disabled (or ignored — the dense engine has no
+        block tables to resume through). The public surface for
+        banners/metrics; ``_chunk`` stays the internal None-able
+        form."""
+        return self._chunk or 0
 
     def decode_compilations(self) -> int:
         """Total decode-program traces OF THIS ENGINE'S KIND (the
@@ -305,10 +349,12 @@ class ContinuousBatchingEngine:
 
     def cancel(self, seq: Sequence) -> bool:
         """Retire a sequence with ``finish_reason="cancelled"`` — queued
-        (dropped before ever touching a slot) or running (KV slot freed
-        mid-decode; the ragged kernel skips the dead slot from the next
-        step on). Must be called from the thread driving :meth:`step`.
-        Returns False if the sequence already finished."""
+        (dropped before ever touching a slot), mid-chunked-prefill (the
+        partial block chain is freed, or donated when a trie is on), or
+        running (KV slot freed mid-decode; the ragged kernel skips the
+        dead slot from the next step on). Must be called from the
+        thread driving :meth:`step`. Returns False if the sequence
+        already finished."""
         if seq.done:
             return False
         if seq.status == "queued":
@@ -343,7 +389,13 @@ class ContinuousBatchingEngine:
         hits install their cached blocks and take the suffix path (ONE
         suffix prefill per suffix-length bucket). Both pad the group dim
         to a power of two, so compile count stays bounded at
-        O(log(num_slots) × buckets) regardless of the hit mix."""
+        O(log(num_slots) × buckets) regardless of the hit mix.
+
+        With chunked prefill on, a sequence whose UNCOVERED prompt
+        exceeds ``prefill_chunk`` skips both one-shot paths: it claims
+        its slot (and zero-copy-installs any matched chain) now, enters
+        the PREFILLING state, and the step loop feeds it to the suffix
+        program one budgeted chunk at a time."""
         cold, hits = [], []
         for seq in seqs:
             # the lookup already ran (and pinned) in _admission_hit_len
@@ -353,7 +405,10 @@ class ContinuousBatchingEngine:
             # pool pressure that publish evicts; an unpinned matched
             # chain could be reaped and its block re-used before
             # _admit_hits copies from it
-            if seq.prefix_nodes:
+            covered = seq.prefix_hit_tokens   # set at scheduler pop time
+            if self._chunk and seq.prompt_len - covered > self._chunk:
+                self._enter_chunked_prefill(seq, covered)
+            elif seq.prefix_nodes:
                 hits.append((seq, seq.prefix_nodes))
             else:
                 cold.append(seq)
@@ -361,6 +416,22 @@ class ContinuousBatchingEngine:
             self._admit_cold(cold, finished)
         if hits:
             self._admit_hits(hits, finished)
+
+    def _enter_chunked_prefill(self, seq, covered):
+        """Claim a slot for a long prompt without prefilling it yet: an
+        installed prefix-cache hit counts toward the resume offset
+        (zero-copy table references, exactly as on the one-shot hit
+        path); everything past it arrives chunk by chunk."""
+        slot = self.cache.alloc()
+        seq.slot = slot
+        if seq.prefix_nodes:
+            self.cache.install_prefix(
+                slot, [node.block_id for node in seq.prefix_nodes])
+        seq.prefilled = covered
+        self.cache.lengths[slot] = covered
+        seq.status = "prefilling"
+        self._slots[slot] = seq
+        self.scheduler.enter_prefill(seq)
 
     def _admit_cold(self, seqs, finished):
         by_bucket = {}
@@ -416,22 +487,8 @@ class ContinuousBatchingEngine:
             by_bucket.setdefault(self._bucket(suffix_len),
                                  []).append((seq, matched))
         for s_pad, group in sorted(by_bucket.items()):
-            G = len(group)
-            Gp = 1 << (G - 1).bit_length()
-            if self._paged:
-                mb = self.cache.max_blocks
-                s_tot = mb * self.cache.block_size
-                tables = np.full((Gp, mb), self.cache.sentinel, np.int32)
-                prefix_lens = np.full(Gp, s_tot, np.int32)
-            else:
-                slots = np.full(Gp, self.num_slots, np.int32)  # writes drop
-                prefix_lens = np.full(Gp, self.max_seq_len, np.int32)
-            ids = np.zeros((Gp, s_pad), np.int32)
-            suf_lens = np.ones(Gp, np.int32)
-            temps = np.zeros(Gp, np.float32)
-            topks = np.zeros(Gp, np.int32)
-            keys = np.zeros((Gp, 2), np.uint32)
-            for i, (seq, matched) in enumerate(group):
+            rows = []
+            for seq, matched in group:
                 # chain already pinned + prefix_hit_tokens already set
                 # by _admission_hit_len at scheduler pop time
                 covered = len(matched) * bs
@@ -441,34 +498,13 @@ class ContinuousBatchingEngine:
                     self.cache.install_prefix(
                         slot, [node.block_id for node in matched])
                     self.cache.ensure_capacity(slot, seq.prompt_len)
-                    tables[i] = self.cache.tables[slot]
                 else:
                     for j, node in enumerate(matched):
                         self.cache.copy_block_in(slot, j * bs, pc.pool,
                                                  node.block_id)
                         self.stats["prefill_copy_dispatches"] += 1
-                    slots[i] = slot
-                suffix = seq.prompt[covered:]
-                ids[i, :len(suffix)] = suffix
-                suf_lens[i] = len(suffix)
-                prefix_lens[i] = covered
-                temps[i] = float(seq.request.temperature)
-                topks[i] = int(seq.request.top_k)
-                keys[i] = np.asarray(seq.key)
-            if self._paged:
-                nk, nv, tok0s, keys2 = self._suffix_fn()(
-                    self._params, self.cache.pool.k, self.cache.pool.v,
-                    jnp.asarray(tables), jnp.asarray(prefix_lens),
-                    jnp.asarray(ids), jnp.asarray(suf_lens),
-                    jnp.asarray(keys), temps, topks)
-            else:
-                nk, nv, tok0s, keys2 = self._suffix_fn()(
-                    self._params, self.cache.k, self.cache.v,
-                    jnp.asarray(slots), jnp.asarray(prefix_lens),
-                    jnp.asarray(ids), jnp.asarray(suf_lens),
-                    jnp.asarray(keys), temps, topks)
-            self.cache.update(nk, nv)
-            tok0s = np.asarray(tok0s)
+                rows.append((seq, covered, seq.prompt_len - covered, True))
+            tok0s, keys2 = self._suffix_call(s_pad, rows)
             for i, (seq, matched) in enumerate(group):
                 slot = seq.slot
                 self.cache.lengths[slot] = seq.prompt_len
@@ -476,6 +512,92 @@ class ContinuousBatchingEngine:
                 self._install_seq(seq, slot, tok0s[i], keys2[i],
                                   seq.prompt_len - seq.prefix_hit_tokens,
                                   finished)
+
+    def _suffix_call(self, s_pad, rows):
+        """ONE suffix-prefill device call for an ``s_pad``-bucket group
+        — THE shared assembly behind the one-shot hit path (dense and
+        paged) and the chunked-prefill path, so their calling
+        conventions can never drift apart. ``rows`` is
+        ``[(seq, offset, n, live)]``: prefill ``prompt[offset:offset+n]``
+        into the sequence's already-claimed slot, whose storage must
+        already cover the span (paged: table blocks installed/appended;
+        dense: matched blocks copied in). Sampling runs only where
+        ``live`` — non-final chunk rows run greedy-off and their output
+        is discarded untouched. Group padding rows carry sentinel
+        tables (paged) / slot ``num_slots`` (dense) and an all-covered
+        prefix, so every one of their writes drops in-program. Returns
+        host ``tok0s`` + device ``keys2``; only live rows' entries are
+        meaningful."""
+        Gp = 1 << (len(rows) - 1).bit_length()
+        if self._paged:
+            mb = self.cache.max_blocks
+            addr = np.full((Gp, mb), self.cache.sentinel, np.int32)
+            prefix_lens = np.full(Gp, mb * self.cache.block_size, np.int32)
+        else:
+            addr = np.full(Gp, self.num_slots, np.int32)   # writes drop
+            prefix_lens = np.full(Gp, self.max_seq_len, np.int32)
+        ids = np.zeros((Gp, s_pad), np.int32)
+        suf_lens = np.ones(Gp, np.int32)
+        temps = np.zeros(Gp, np.float32)
+        topks = np.zeros(Gp, np.int32)
+        keys = np.zeros((Gp, 2), np.uint32)
+        for i, (seq, off, n, live) in enumerate(rows):
+            addr[i] = self.cache.tables[seq.slot] if self._paged \
+                else seq.slot
+            ids[i, :n] = seq.prompt[off:off + n]
+            suf_lens[i] = n
+            prefix_lens[i] = off
+            keys[i] = np.asarray(seq.key)
+            if live:
+                temps[i] = float(seq.request.temperature)
+                topks[i] = int(seq.request.top_k)
+        kv = ((self.cache.pool.k, self.cache.pool.v) if self._paged
+              else (self.cache.k, self.cache.v))
+        nk, nv, tok0s, keys2 = self._suffix_fn()(
+            self._params, *kv, jnp.asarray(addr),
+            jnp.asarray(prefix_lens), jnp.asarray(ids),
+            jnp.asarray(suf_lens), jnp.asarray(keys), temps, topks)
+        self.cache.update(nk, nv)
+        return np.asarray(tok0s), keys2
+
+    def _run_prefill_chunks(self, plan, finished):
+        """Run this step's budgeted slice of the chunked-prefill
+        backlog: ONE paged suffix-prefill device call per chunk-length
+        bucket (normally exactly one — full chunks share the
+        ``prefill_chunk`` bucket, so the compile set stays closed over
+        the pow2 (group, bucket) grid no matter how prompt lengths
+        vary). Each chunk writes K/V through the sequence's block table
+        at its host resume offset — the same program, offset machinery,
+        and zero-copy discipline as the prefix-hit suffix path.
+
+        Only a FINAL chunk (one that completes the prompt) samples:
+        its logits produce token 0 and its split key is adopted, so the
+        PRNG walk — and therefore the token stream — is byte-identical
+        to a one-shot prefill. Non-final chunks run greedy-off rows and
+        their sampled output is discarded untouched."""
+        by_bucket = {}
+        for seq, n in plan:
+            by_bucket.setdefault(self._bucket(n), []).append((seq, n))
+        for s_pad, group in sorted(by_bucket.items()):
+            rows = []
+            for seq, n in group:
+                off = seq.prefilled
+                self.cache.ensure_capacity(seq.slot, off + n)
+                # final chunk (completes the prompt): sampling is live
+                rows.append((seq, off, n, off + n == seq.prompt_len))
+            tok0s, keys2 = self._suffix_call(s_pad, rows)
+            for i, (seq, n) in enumerate(group):
+                slot, end = seq.slot, seq.prefilled + n
+                self.stats["prefill_chunks"] += 1
+                self.cache.lengths[slot] = end
+                seq.prefilled = end
+                if end == seq.prompt_len:       # prompt complete
+                    self.scheduler.leave_prefill(seq)
+                    self.stats["prefill_tokens_saved"] += \
+                        seq.prefix_hit_tokens
+                    self._install_seq(
+                        seq, slot, tok0s[i], keys2[i],
+                        seq.prompt_len - seq.prefix_hit_tokens, finished)
 
     def _install_seq(self, seq, slot, tok0, key2, prefilled_tokens,
                      finished):
@@ -508,6 +630,11 @@ class ContinuousBatchingEngine:
             self._finish(seq, "length", finished)
 
     def _finish(self, seq, reason, finished):
+        if seq.status == "prefilling":
+            # cancellation / deadline expiry mid-chunk: out of the
+            # chunk pipeline before the slot teardown below frees (or
+            # donates) the partially installed block chain
+            self.scheduler.leave_prefill(seq)
         seq.status = "finished"
         seq.finish_reason = reason
         slot = seq.slot
@@ -524,11 +651,21 @@ class ContinuousBatchingEngine:
             # sequence's own pins still shield its matched chain from
             # eviction during the publish walk
             if self.prefix_cache is not None and self._paged:
-                # paged publish DONATES the slot's full prompt blocks to
-                # the trie (ownership handoff, zero copies); free() then
-                # drops only the undonated private tail
+                # paged publish DONATES the slot's full blocks to the
+                # trie (ownership handoff, zero copies); free() then
+                # drops only the undonated private tail. The donation
+                # range is every row actually written — prompt AND
+                # generated tokens (a multi-turn resubmission of this
+                # sequence's assistant text hits these blocks), capped
+                # at the written row count: the last sampled token's KV
+                # is never in the cache (it would be appended by the
+                # decode tick that never ran), and a mid-prefill cancel
+                # has only ``prefilled`` valid rows
+                written = int(self.cache.lengths[slot])
+                content = seq.prompt if not seq.tokens else np.concatenate(
+                    [seq.prompt, np.asarray(seq.tokens, np.int32)])
                 donated = self.prefix_cache.publish_donate(
-                    seq.prompt, self.cache.slot_block_ids(slot))
+                    content[:written], self.cache.slot_block_ids(slot))
                 self.cache.free(slot, keep=donated)
             elif self.prefix_cache is not None:
                 self.prefix_cache.publish(seq.prompt, slot, self.cache)
@@ -562,11 +699,12 @@ class ContinuousBatchingEngine:
             self.on_token(seq, token)
 
     def step(self):
-        """Admit + one fused decode call + retire. Returns every
-        sequence this step finished (possibly empty), deadline expiries
-        included — queue-side timeouts come back with ``slot=None`` and
-        no tokens. Only :meth:`cancel` retires outside a step; those
-        surface through ``on_finish`` / the Sequence handle alone."""
+        """Admit + at most one budgeted chunk of pending prefill + one
+        fused decode call + retire. Returns every sequence this step
+        finished (possibly empty), deadline expiries included —
+        queue-side timeouts come back with ``slot=None`` and no tokens.
+        Only :meth:`cancel` retires outside a step; those surface
+        through ``on_finish`` / the Sequence handle alone."""
         finished = []
         # deadline sweep BEFORE admission: an expired queued request
         # must never claim a slot (and a running one stops paying for
@@ -580,7 +718,13 @@ class ContinuousBatchingEngine:
             if self.prefix_cache is not None else None)
         if admitted:
             self._admit_group(admitted, finished)
-        active = [s for s in self._slots if s is not None]
+        if self._chunk and self.scheduler.num_prefilling:
+            plan = self.scheduler.prefill_plan(self._chunk,
+                                               self.cache.block_size)
+            if plan:
+                self._run_prefill_chunks(plan, finished)
+        active = [s for s in self._slots
+                  if s is not None and s.status == "running"]
         if active:
             n = self.scheduler.choose_num_steps(active)
             if self._paged:
@@ -588,15 +732,34 @@ class ContinuousBatchingEngine:
                 # ticks writes rows [len, len+n) per slot, so the table
                 # must cover them BEFORE the device call (block ids are
                 # runtime data — growing them costs no retrace)
+                lens = self.cache.lengths
                 for slot, s in enumerate(self._slots):
-                    if s is not None:
+                    if s is not None and s.status == "running":
                         self.cache.ensure_capacity(
-                            slot, int(self.cache.lengths[slot]) + n)
+                            slot, int(lens[slot]) + n)
+                    elif s is not None:
+                        # mid-prefill slot: its table is REAL (prefix +
+                        # installed chunks), so the decode program's
+                        # append must DROP, not land in the block the
+                        # next chunk will write — feed it a length past
+                        # the logical capacity (the program's dead-slot
+                        # clamp) instead of its resume offset. Known
+                        # cost: the slot's discarded attention row runs
+                        # at that full length for the duration of the
+                        # prefill (one array drives both the append
+                        # clamp and the compute gate; skipping the
+                        # compute needs a per-slot active mask in the
+                        # program signature — ROADMAP, rides the
+                        # decode-batch-aware chunk sizing follow-on)
+                        if lens is self.cache.lengths:
+                            lens = lens.copy()
+                        lens[slot] = self.cache.max_blocks * \
+                            self.cache.block_size
                 toks, nk, nv, keys = self._decode_fn(n)(
                     self._params, self.cache.pool.k, self.cache.pool.v,
                     jnp.asarray(self.cache.tables),
                     jnp.asarray(self._last_tok),
-                    jnp.asarray(self.cache.lengths), self._keys,
+                    jnp.asarray(lens), self._keys,
                     jnp.asarray(self._temps), jnp.asarray(self._topks))
             else:
                 toks, nk, nv, keys = self._decode_fn(n)(
@@ -614,8 +777,9 @@ class ContinuousBatchingEngine:
             for i in range(n):
                 for slot in range(self.num_slots):
                     seq = self._slots[slot]
-                    if seq is None:
-                        continue  # freed slot (or finished mid-chunk)
+                    if seq is None or seq.status != "running":
+                        continue  # freed/mid-prefill slot (or finished
+                        # mid-chunk); its sampled garbage never surfaces
                     t = int(toks_np[i, slot])
                     seq.tokens.append(t)
                     self.cache.lengths[slot] += 1

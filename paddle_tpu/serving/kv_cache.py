@@ -132,7 +132,16 @@ def copy_compilations() -> int:
 
 
 class SlotKVCache:
-    """KV-cache manager: device arrays + slot allocator + lengths mirror.
+    """Dense per-slot KV cache — the LEGACY compatibility path.
+
+    :class:`PagedKVCache` is the engine default (``paged_attn=True``):
+    it subsumes this layout's whole job with zero-copy prefix sharing
+    and block-granular HBM, and the chunked-prefill scheduler exists
+    only on it. SlotKVCache stays as the ``paged_attn=False`` shim —
+    token-identical, one dense ``[L, num_slots, max_seq_len, Hkv, D]``
+    array pair, one-shot prefill only — for A/B pinning in tests and
+    for backends where the table-gather pattern is hostile. No new
+    features land here.
 
     The free-slot pool is a min-heap plus a membership set: ``alloc`` is
     O(log n) and still deterministic (lowest index first), ``free``'s

@@ -39,6 +39,10 @@ work for every prompt block some earlier request already computed:
   sitting in the sequence's private tail are ADOPTED by the trie
   in place (ownership handoff, no ``copy_block_out``), so the paged
   path runs the whole hit/publish lifecycle with zero copy dispatches.
+  Donation covers *generated* full blocks too, not just prompt blocks —
+  the decode loop wrote them through the same table into the same
+  private tail, so adopting them is equally free, and a multi-turn
+  resubmission of an assistant turn hits that turn's own blocks.
 
 Compile discipline: lookups/inserts/evictions are pure host work; the
 only device programs are the two block-copy programs (compile-once, see
@@ -179,13 +183,19 @@ class PrefixCache:
             for node in walked:
                 self.pool.unref(node.block_id)
 
-    def publish_donate(self, prompt, block_ids):
-        """Paged-path publish: insert every full prompt block by
+    def publish_donate(self, tokens, block_ids):
+        """Paged-path publish: insert every full token block by
         ADOPTING the retiring sequence's own pool block — an ownership
-        handoff, zero copy dispatches. ``block_ids`` is the sequence's
-        table in logical order (``PagedKVCache.slot_block_ids``);
-        ``block_ids[i]`` already holds exactly prompt rows
-        [i*bs, (i+1)*bs) because prefill/decode wrote through the table.
+        handoff, zero copy dispatches. ``tokens`` is the sequence's
+        WRITTEN row content — the prompt plus every generated token
+        whose KV actually landed in the cache (the engine caps it at
+        the slot's written length), so retirement donates generated
+        full blocks too: a multi-turn conversation resubmitting turn
+        N's assistant text as part of turn N+1's prompt hits turn N's
+        own blocks. ``block_ids`` is the sequence's table in logical
+        order (``PagedKVCache.slot_block_ids``); ``block_ids[i]``
+        already holds exactly rows [i*bs, (i+1)*bs) because
+        prefill/decode wrote through the table.
 
         Returns the set of adopted block ids — the caller must hand
         their ownership pins to the trie (unref-without-free) instead of
@@ -194,16 +204,16 @@ class PrefixCache:
         caller's tail and is freed with it). Needs no allocation, so it
         can never evict, skip, or fail — the paged publish degrades to
         "nothing new to donate", never to lost work."""
-        prompt = np.asarray(prompt).reshape(-1)
+        tokens = np.asarray(tokens).reshape(-1)
         children, parent = self._root, None
         tick = next(self._tick)
         walked = []   # transient pins: later links can't outlive earlier
         donated = set()
         try:
-            for i, key in enumerate(self._blocks_of(prompt, len(prompt))):
+            for i, key in enumerate(self._blocks_of(tokens, len(tokens))):
                 if i >= len(block_ids):
-                    break  # table shorter than the prompt (cancelled
-                    # pre-prefill); donate what exists
+                    break  # table shorter than the content (cancelled
+                    # mid-chunked-prefill); donate what exists
                 node = children.get(key)
                 if node is None:
                     node = _Node(key, parent, int(block_ids[i]))

@@ -55,7 +55,12 @@ class Sequence:
 
     ``tokens`` holds ONLY generated ids (the first entry is the token
     sampled from the prefill logits). ``status`` walks
-    queued -> running -> finished; ``finish_reason`` is one of
+    queued -> [prefilling ->] running -> finished; ``prefilling`` is the
+    chunked-prefill state (README "Chunked prefill"): the sequence holds
+    a KV slot and ``prefilled`` prompt rows are installed, but no token
+    has been sampled yet — the engine advances it one chunk per step
+    until the final chunk's logits produce token 0. Short prompts skip
+    the state entirely. ``finish_reason`` is one of
     :data:`FINISH_REASONS`. ``deadline`` is the absolute
     ``time.monotonic()`` instant derived from the request's
     ``timeout_s`` at submit time (``None`` = no deadline).
@@ -63,7 +68,7 @@ class Sequence:
 
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
                  "finish_reason", "slot", "key", "submit_step", "deadline",
-                 "prefix_nodes", "prefix_hit_tokens")
+                 "prefix_nodes", "prefix_hit_tokens", "prefilled")
 
     def __init__(self, request: GenerationRequest, key, submit_step=0,
                  deadline=None):
@@ -82,6 +87,10 @@ class Sequence:
         # prompt tokens they covered (0 = cold prefill)
         self.prefix_nodes = []
         self.prefix_hit_tokens = 0
+        # chunked-prefill resume offset: prompt rows whose KV is already
+        # installed (cache-hit prefix + completed chunks). Block-aligned
+        # by construction while status == "prefilling".
+        self.prefilled = 0
 
     @property
     def done(self) -> bool:

@@ -6,13 +6,23 @@ two steps. The FIFO policy here does two jobs:
 
 - **Admission**: pop queued sequences into free cache slots, oldest
   first, at the top of every engine step.
+- **Prefill budgeting** (chunked prefill, README "Chunked prefill"):
+  sequences whose uncovered prompt exceeds the engine's
+  ``prefill_chunk`` enter a PREFILLING pipeline instead of running one
+  monopolizing device call; :meth:`FIFOScheduler.prefill_plan` hands
+  the engine at most ``budget`` prompt tokens of that backlog per step,
+  oldest sequence first, with non-final chunk boundaries aligned to the
+  KV block size — so every step still runs the fused decode tick for
+  all live slots and no decode batch ever waits behind an entire long
+  prompt.
 - **Chunk fusion**: when nothing schedulable can change for a while
-  (queue empty), tell the engine to run several decode steps in one
-  fused device call (a ``lax.scan`` inside the jitted step) — the
-  largest power of two fitting both ``decode_chunk`` and every active
-  sequence's remaining budget. This amortizes per-step host dispatch
-  (the tunneled-TPU round trip is the expensive part) without ever
-  delaying an admission: any queued request forces single-stepping.
+  (queue empty, no prefill backlog), tell the engine to run several
+  decode steps in one fused device call (a ``lax.scan`` inside the
+  jitted step) — the largest power of two fitting both ``decode_chunk``
+  and every active sequence's remaining budget. This amortizes per-step
+  host dispatch (the tunneled-TPU round trip is the expensive part)
+  without ever delaying an admission or a pending prefill chunk: any
+  queued request or in-flight prefill forces single-stepping.
   The compiled step-size set is bounded at
   ``{1, 2, 4, …, decode_chunk}`` — log2(chunk)+1 programs.
 
@@ -32,6 +42,7 @@ class FIFOScheduler:
     def __init__(self, decode_chunk: int = 8):
         self.decode_chunk = max(int(decode_chunk), 1)
         self.queue = deque()
+        self.prefilling = deque()   # admitted, mid-chunked-prefill (FIFO)
 
     def submit(self, seq):
         self.queue.append(seq)
@@ -39,6 +50,51 @@ class FIFOScheduler:
     @property
     def num_queued(self) -> int:
         return len(self.queue)
+
+    @property
+    def num_prefilling(self) -> int:
+        return len(self.prefilling)
+
+    # ------------------------------------------------- chunked prefill
+    def enter_prefill(self, seq):
+        """Admission handed ``seq`` a slot but its uncovered prompt is
+        too long for one call: queue it for per-step chunking."""
+        self.prefilling.append(seq)
+
+    def leave_prefill(self, seq) -> bool:
+        """Drop a sequence from the prefill pipeline (final chunk done,
+        cancellation, or deadline expiry). Returns whether it was
+        there."""
+        try:
+            self.prefilling.remove(seq)
+            return True
+        except ValueError:
+            return False
+
+    def prefill_plan(self, budget: int, align: int = 1):
+        """This step's chunk assignments: ``[(seq, n_tokens), ...]``,
+        oldest PREFILLING sequence first, spending at most ``budget``
+        prompt tokens total. A sequence's chunk is capped at its
+        remaining uncovered prompt; a NON-final chunk end is rounded
+        down to an ``align`` (KV block size) boundary so a partially
+        prefilled prompt is always a whole-block prefix plus a host
+        resume offset — leftover budget smaller than one block stops
+        the plan rather than splitting a block. Sequences stay queued
+        until :meth:`leave_prefill`; FIFO order is never reshuffled, so
+        a long prompt cannot be starved by later arrivals."""
+        plan = []
+        for seq in self.prefilling:
+            if budget <= 0:
+                break
+            remaining = seq.prompt_len - seq.prefilled
+            n = min(budget, remaining)
+            if n < remaining:           # non-final: block-align the cut
+                n -= (seq.prefilled + n) % align
+                if n <= 0:
+                    break
+            plan.append((seq, n))
+            budget -= n
+        return plan
 
     def admissions(self, num_free: int, hit_len_fn=None):
         """Sequences to admit this step (pops up to ``num_free``).
@@ -82,8 +138,13 @@ class FIFOScheduler:
         compiled step-size set bounded (⊆ {1, 2, 4, …, decode_chunk})
         while letting a near-finished batch still fuse most of its tail
         instead of falling back to single-stepping. EOS-enabled
-        sequences may finish early inside a chunk (tail discarded)."""
-        if self.decode_chunk == 1 or self.queue or not active_seqs:
+        sequences may finish early inside a chunk (tail discarded).
+        In-flight chunked prefills also force single-stepping: fusing n
+        decode ticks would delay the next prompt chunk by n-1 ticks,
+        exactly the TTFT head-of-line blocking chunking exists to
+        remove."""
+        if self.decode_chunk == 1 or self.queue or self.prefilling \
+                or not active_seqs:
             return 1
         m = min(s.remaining for s in active_seqs)
         n = 1

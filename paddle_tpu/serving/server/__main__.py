@@ -60,10 +60,17 @@ def main(argv=None):
                          "num_slots * max_seq_len / block_size)")
     ap.add_argument("--prefix-block-size", type=int, default=32,
                     help="tokens per cached KV block")
-    ap.add_argument("--paged-attn", action="store_true",
-                    help="block-table paged attention: the block pool IS "
-                         "the KV cache, prefix hits install zero-copy and "
-                         "concurrent holders share physical blocks")
+    ap.add_argument("--paged-attn", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="block-table paged attention (DEFAULT: the block "
+                         "pool IS the KV cache, prefix hits install "
+                         "zero-copy and concurrent holders share physical "
+                         "blocks); --no-paged-attn selects the legacy "
+                         "dense per-slot cache")
+    ap.add_argument("--prefill-chunk", type=int, default=512,
+                    help="chunked prefill: max prompt tokens prefilled "
+                         "per engine step (paged engine only; bounds TTFT "
+                         "under mixed traffic; 0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-request access logs")
@@ -77,13 +84,18 @@ def main(argv=None):
         max_queue=args.max_queue, model_name=f"llama-{args.preset}",
         prefix_cache=args.prefix_cache, prefix_blocks=args.prefix_blocks,
         prefix_block_size=args.prefix_block_size,
-        paged_attn=args.paged_attn,
+        paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
         log_fn=None if args.quiet else
         (lambda m: print(m, file=sys.stderr)))
     print(json.dumps({"listening": server.url, "preset": args.preset,
                       "num_slots": args.num_slots,
                       "prefix_cache": bool(args.prefix_cache),
                       "paged_attn": bool(args.paged_attn),
+                      # report what actually runs: the engine's
+                      # block-rounded chunk, 0 when chunking is off or
+                      # the dense engine ignores it
+                      "prefill_chunk":
+                      server.gateway.engine.prefill_chunk,
                       "endpoints": ["/v1/completions", "/healthz",
                                     "/metrics"]}), flush=True)
 

@@ -42,7 +42,7 @@ import weakref
 
 import numpy as np
 
-from ...profiler.metrics import MetricsRegistry
+from ...profiler.metrics import TTFT_BUCKETS, MetricsRegistry
 
 
 class QueueFullError(RuntimeError):
@@ -212,7 +212,8 @@ class ServingGateway:
         self._m_tokens = r.counter(
             "serving_generated_tokens_total", "Generated tokens.")
         self._m_ttft = r.histogram(
-            "serving_ttft_seconds", "Submit-to-first-token latency.")
+            "serving_ttft_seconds", "Submit-to-first-token latency.",
+            buckets=TTFT_BUCKETS)
         self._m_latency = r.histogram(
             "serving_request_latency_seconds",
             "Submit-to-finish latency per request.")
@@ -237,6 +238,12 @@ class ServingGateway:
                   "hits (dense engine only; the paged path pins this "
                   "at 0 — hits install by reference).").set_fn(
             lambda: self.engine.stats["prefill_copy_dispatches"])
+        r.counter("serving_prefill_chunks_total",
+                  "Chunked-prefill device chunks run (one per sequence "
+                  "per step while a long cold prompt is interleaved "
+                  "with decode; 0 with chunking off or on the dense "
+                  "engine).").set_fn(
+            lambda: self.engine.stats["prefill_chunks"])
         cache = getattr(self.engine, "cache", None)
         if getattr(self.engine, "_paged", False) and cache is not None:
             # paged-attention surface: physical sharing + table pressure

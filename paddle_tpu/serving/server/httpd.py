@@ -276,7 +276,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           max_seq_len=None, decode_chunk=1, max_queue=64,
           model_name=None, registry=None, log_fn=None, start=True,
           prefix_cache=False, prefix_blocks=None, prefix_block_size=32,
-          paged_attn=False):
+          paged_attn=True, prefill_chunk=512):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -285,17 +285,24 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     step-size set at exactly one program). ``prefix_cache=True`` turns
     on automatic prefix caching (README "Automatic prefix caching");
     its hit/miss/eviction counters and the ``kv_prefix_blocks`` gauge
-    land on ``GET /metrics``. ``paged_attn=True`` serves from the
-    block-table paged KV cache (README "Paged attention") — prefix hits
-    install zero-copy and ``/metrics`` grows the ``kv_blocks_shared``
-    and ``kv_block_table_fill`` gauges.
+    land on ``GET /metrics``. ``paged_attn=True`` (the default) serves
+    from the block-table paged KV cache (README "Paged attention") —
+    prefix hits install zero-copy and ``/metrics`` grows the
+    ``kv_blocks_shared`` and ``kv_block_table_fill`` gauges; pass
+    ``paged_attn=False`` for the legacy dense per-slot cache.
+    ``prefill_chunk`` (default 512 tokens, paged only; ``0``/``None``
+    disables) interleaves long cold-prompt prefills with decode steps
+    so one long prompt can't stall every streaming client — the
+    ``serving_ttft_seconds`` histogram and
+    ``serving_prefill_chunks_total`` counter on ``/metrics`` watch it
+    (README "Chunked prefill").
     """
     from ..engine import ContinuousBatchingEngine
     engine = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=max_seq_len,
         decode_chunk=decode_chunk, prefix_cache=prefix_cache,
         prefix_blocks=prefix_blocks, prefix_block_size=prefix_block_size,
-        paged_attn=paged_attn,
+        paged_attn=paged_attn, prefill_chunk=prefill_chunk,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     gateway = ServingGateway(engine, max_queue=max_queue, registry=registry)
     server = ServingHTTPServer(

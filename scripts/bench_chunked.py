@@ -1,0 +1,357 @@
+"""Chunked-prefill benchmark: bounded TTFT under mixed traffic.
+
+Question answered: when one long cold prompt lands amid steady
+short-request decoding traffic, what does splitting its prefill into
+``prefill_chunk``-token chunks interleaved with decode steps
+(``serving/engine.py``, README "Chunked prefill") buy the SHORT
+requests' time-to-first-token — and are the token streams still
+byte-identical?
+
+Both legs run the SAME paged engine, model, kernel, scheduling
+(``decode_chunk=1``) and the same arrival trace — the only difference
+is ``prefill_chunk``:
+
+- **unchunked** — a long cold prompt monopolizes an entire engine step:
+  every short request that arrives while its prefill runs (or sits
+  queued behind it) eats the whole prefill latency before its own first
+  token;
+- **chunked** — the long prefill advances at most ``prefill_chunk``
+  tokens per step, so decode slots keep emitting and a newly arrived
+  short prompt prefills within ~one chunk's latency.
+
+Methodology: a calibrated discrete-event replay (same ethos as
+bench_paged.py — deterministic composition, measured scalars). The
+four device-call costs a step can be built from (plain fused decode
+tick, short cold prefill, long cold prefill, one chunk call) are each
+measured warm on the real engine, best-of-N; the replay then drives
+the actual engine over a fixed virtual-time arrival schedule, charging
+every step the sum of its measured parts (the engine is instrumented
+to count which calls each step ran). A request's TTFT is the step-END
+clock of its first token minus its arrival instant — a token is only
+visible when the step that computed it returns, so a monopolizing
+prefill step is charged to everyone who waited behind it. Given the
+calibration table, both legs are fully DETERMINISTIC: a shared-CPU
+box's scheduling jitter moves the four calibrated scalars slightly,
+never the traffic pattern (time-based replays drift their operating
+point with machine load — measured failure mode of the first cut of
+this bench). The headline p95 (and the acceptance gate) is the EXACT
+order statistic over the raw TTFT samples; the same samples also run
+through a ``profiler.metrics.Histogram`` over the TTFT bucket ladder
+and its ``quantile(0.95)`` is banked alongside
+(``hist_p95_ttft_short_s``) as a scrape-parity column — the
+``serving_ttft_seconds`` path reports through buckets, so the pair
+shows the estimator's granularity without letting bucket-edge
+interpolation move the gate.
+
+Headline metric: ``p95_ttft_ratio`` = short-request p95 TTFT unchunked
+/ chunked. The acceptance bar (ISSUE 5) is >= 2x; ``accepted`` in the
+banked JSON records the gate.
+
+Usage:
+  python scripts/bench_chunked.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BLOCK_SIZE = 16
+CHUNK = 32          # quick-leg chunk: 24 chunks for the long prompt
+LONG_LEN = 768      # long cold prompt (vs 1024 max_position_embeddings)
+SHORT_LEN = 12
+SHORT_NEW = 12
+ACCEPT_RATIO = 2.0  # ISSUE 5 acceptance bar: >= 2x lower p95 TTFT
+
+
+def _model(quick=True):
+    """Bench model sized so the mixed-traffic asymmetry is REAL on the
+    quick (CPU) leg: a 768-token cold prefill costs ~19 warm decode
+    steps (measured; the other serving legs' 384-wide model has a
+    flatter ratio on CPU, which would understate the very stall this
+    bench exists to show), while a single chunk step stays ~2 decode
+    steps. The full-size leg reuses the 350M bench config."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    kw = (dict(vocab_size=2048, hidden_size=128, intermediate_size=352,
+               num_hidden_layers=4, num_attention_heads=8,
+               num_key_value_heads=4, max_position_embeddings=1024)
+          if quick else
+          dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+               num_hidden_layers=24, num_attention_heads=16,
+               num_key_value_heads=16, max_position_embeddings=2048,
+               dtype="bfloat16"))
+    paddle.seed(7)
+    return LlamaForCausalLM(LlamaConfig(decode_attention="jnp", **kw))
+
+
+def _trace(short_every_s, n_short=30, long_at=(6, 16, 26)):
+    """Mixed traffic: ``n_short`` short decode requests arriving on a
+    steady virtual-time clock (``short_every_s`` is calibrated to the
+    measured decode-step time so the short traffic alone is
+    SUSTAINABLE — otherwise every TTFT is queue-bound and the prefill
+    policy is invisible), plus long cold prompts arriving with short
+    traffic already decoding (after the ``long_at``-th shorts).
+    Returns [(arrival_s, kind, GenerationRequest)] sorted by arrival."""
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(17)
+    sched = []
+    for i in range(n_short):
+        t = i * short_every_s
+        if i in long_at:
+            sched.append((t + short_every_s / 2, "long", GenerationRequest(
+                prompt=rng.randint(0, 2048, (LONG_LEN,)).astype(np.int32),
+                max_new_tokens=8)))
+        kw = {}
+        if i % 5 == 4:  # a few seeded-sampled rows keep the pin strong
+            kw = dict(temperature=0.8, top_k=5, seed=100 + i)
+        sched.append((t, "short", GenerationRequest(
+            prompt=rng.randint(0, 2048, (SHORT_LEN,)).astype(np.int32),
+            max_new_tokens=SHORT_NEW, **kw)))
+    sched.sort(key=lambda x: x[0])
+    return sched
+
+
+def _clone(r):
+    from paddle_tpu.serving import GenerationRequest
+    return GenerationRequest(prompt=r.prompt,
+                             max_new_tokens=r.max_new_tokens,
+                             temperature=r.temperature, top_k=r.top_k,
+                             seed=r.seed)
+
+
+def _mk_engine(model, num_slots, s_max, prefill_chunk):
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        prefix_block_size=BLOCK_SIZE, prefill_chunk=prefill_chunk,
+        jit_cache=model.__dict__.setdefault("_serving_jit", {}))
+
+
+def _instrument(eng):
+    """Count the device calls each step runs (one cold-prefill call per
+    prompt bucket, one chunk call per chunk bucket, decode via stats),
+    so the replay can charge the step the sum of its measured parts."""
+    calls = {"short": 0, "long": 0, "chunk": 0}
+    orig_cold = eng._admit_cold
+
+    def cold(seqs, finished):
+        for b in {eng._bucket(s.prompt_len) for s in seqs}:
+            calls["long" if b > 4 * CHUNK else "short"] += 1
+        return orig_cold(seqs, finished)
+
+    orig_chunks = eng._run_prefill_chunks
+
+    def chunks(plan, finished):
+        calls["chunk"] += len({eng._bucket(n) for _, n in plan})
+        return orig_chunks(plan, finished)
+
+    eng._admit_cold = cold
+    eng._run_prefill_chunks = chunks
+    return calls
+
+
+def _replay(model, sched, num_slots, s_max, prefill_chunk, costs):
+    """Drive one engine through the arrival schedule on the calibrated
+    virtual clock; returns (per-kind TTFT lists, streams keyed by
+    submit order, engine)."""
+    eng = _mk_engine(model, num_slots, s_max, prefill_chunk)
+    calls = _instrument(eng)
+    clock = 0.0
+    ttft = {"short": [], "long": []}
+    seen = set()
+    newly_first = []       # first tokens surfaced by the current step
+    arrivals = {}          # request_id -> (arrival_s, kind)
+
+    def on_token(seq, tok):
+        # a token becomes VISIBLE when the step that produced it
+        # returns, so its timestamp is the step-END clock — charging
+        # the whole monopolizing step (the thing this bench measures)
+        # to every request that waited behind it
+        if seq.request_id not in seen:
+            seen.add(seq.request_id)
+            newly_first.append(seq.request_id)
+
+    eng.on_token = on_token
+    pending = list(sched)
+    seqs = []
+    while pending or eng.has_work():
+        while pending and pending[0][0] <= clock:
+            t0, kind, req = pending.pop(0)
+            seq = eng.submit(_clone(req))
+            arrivals[seq.request_id] = (t0, kind)
+            seqs.append(seq)
+        if not eng.has_work():
+            clock = pending[0][0]  # idle-skip to the next arrival
+            continue
+        before = dict(calls)
+        dec0 = eng.stats["decode_calls"]
+        eng.step()
+        clock += sum((calls[k] - before[k]) * costs[k] for k in calls) \
+            + (eng.stats["decode_calls"] - dec0) * costs["decode"]
+        for rid in newly_first:
+            t0, kind = arrivals[rid]
+            ttft[kind].append(clock - t0)
+        newly_first.clear()
+    streams = [s.tokens for s in seqs]
+    return ttft, streams, eng
+
+
+def _p95(values):
+    """Exact p95 order statistic — the headline and the acceptance
+    gate (bucket-edge interpolation must never move a pass/fail)."""
+    return float(np.percentile(values, 95))
+
+
+def _hist_p95(values):
+    """The same samples through the Histogram bucket-quantile
+    estimator — the path a serving_ttft_seconds scrape uses; banked
+    next to the exact column as a granularity/parity check."""
+    from paddle_tpu.profiler.metrics import Histogram, TTFT_BUCKETS
+    h = Histogram("ttft", buckets=TTFT_BUCKETS)
+    for v in values:
+        h.observe(v)
+    return h.quantile(0.95)
+
+
+def _calibrate_costs(model, num_slots, s_max):
+    """Measure the four warm per-call costs the replay's clock is built
+    from, each best-of-N so scheduler jitter only ever inflates a
+    sample it then discards:
+
+    - ``decode``: one fused decode tick over all slots;
+    - ``short`` / ``long``: one cold-prefill call of the short / long
+      prompt bucket (a max_new_tokens=1 request retires at install, so
+      its admission step runs no decode — the step IS the call);
+    - ``chunk``: one ``CHUNK``-token suffix call (a mid-prefill step
+      runs nothing else).
+    """
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(3)
+
+    def _req(n, new=4):
+        return GenerationRequest(
+            prompt=rng.randint(0, 2048, (n,)).astype(np.int32),
+            max_new_tokens=new)
+
+    eng = _mk_engine(model, num_slots, s_max, None)
+    for _ in range(num_slots):
+        eng.submit(_req(SHORT_LEN, new=40))
+    eng.step()
+    eng.step()
+    t_dec = min(_timed(eng.step) for _ in range(8))
+    for s in list(eng._slots):
+        if s is not None:
+            eng.cancel(s)
+
+    def admit_cost(plen):
+        best = None
+        for _ in range(5):
+            eng.submit(_req(plen, new=1))  # retires at install: slot back
+            t = _timed(eng.step)
+            best = t if best is None else min(best, t)
+        return best
+
+    t_short = admit_cost(SHORT_LEN)
+    t_long = admit_cost(LONG_LEN)
+
+    eng = _mk_engine(model, num_slots, s_max, CHUNK)
+    ts = []
+    for _ in range(2):
+        seq = eng.submit(_req(LONG_LEN))
+        while seq.status != "running":
+            ts.append(_timed(eng.step))  # chunk-only steps (no decode)
+        eng.cancel(seq)
+    ts.sort()
+    return {"decode": t_dec, "short": t_short, "long": t_long,
+            "chunk": ts[0]}
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def measure_chunked_prefill(quick=True, num_slots=4):
+    s_max = 1024 if quick else 2048
+    model = _model(quick)
+    # warm every program both legs touch (cold prefill buckets for the
+    # short and long prompts, chunk suffix buckets, group-size pow2
+    # pads, paged decode) before any timed calibration: a saturated
+    # mini-schedule hits the full (group, bucket) grid cheaply
+    zero = {"decode": 0.0, "short": 0.0, "long": 0.0, "chunk": 0.0}
+    warm = _trace(0.0, n_short=8, long_at=(2,))
+    _replay(model, warm, num_slots, s_max, None, zero)
+    _replay(model, warm, num_slots, s_max, CHUNK, zero)
+    costs = _calibrate_costs(model, num_slots, s_max)
+    # arrival clock: ~30% slot utilization from the shorts alone
+    # (SHORT_NEW decode steps held per short / (interval * num_slots)),
+    # so the only congestion events in the trace are the long prefills
+    # — and the chunk is kept small enough that a chunk-carrying step
+    # stays within ~2x a plain decode step (a chunk that dwarfs the
+    # decode batch would stretch every slot's residency and just move
+    # the stall, the measured failure mode of chunk=128 on this config)
+    sched = _trace(short_every_s=costs["decode"] * 10.0)
+    legs = {}
+    for name, chunk in (("unchunked", None), ("chunked", CHUNK)):
+        ttft, streams, eng = _replay(model, sched, num_slots, s_max,
+                                     chunk, costs)
+        legs[name] = {"p95_ttft_short_s": _p95(ttft["short"]),
+                      "hist_p95_ttft_short_s": _hist_p95(ttft["short"]),
+                      "mean_ttft_short_s": float(np.mean(ttft["short"])),
+                      "max_ttft_short_s": float(np.max(ttft["short"])),
+                      "ttft_long_s": float(np.mean(ttft["long"])),
+                      "prefill_chunks": eng.stats["prefill_chunks"],
+                      "decode_compilations": eng.decode_compilations(),
+                      "streams": streams}
+    # determinism spot-check: a replay depends only on the schedule and
+    # the calibration table, so a re-run must reproduce exactly
+    ttft2, streams2, _ = _replay(model, sched, num_slots, s_max, CHUNK,
+                                 costs)
+    deterministic = streams2 == legs["chunked"]["streams"] and \
+        _p95(ttft2["short"]) == legs["chunked"]["p95_ttft_short_s"]
+    tokens_equal = legs["unchunked"].pop("streams") == \
+        legs["chunked"].pop("streams")
+    un, ch = legs["unchunked"], legs["chunked"]
+    ratio = un["p95_ttft_short_s"] / max(ch["p95_ttft_short_s"], 1e-9)
+    return {
+        "unchunked": un, "chunked": ch,
+        "tokens_equal": tokens_equal,
+        "deterministic": bool(deterministic),
+        "p95_ttft_ratio": ratio,
+        "accept_ratio": ACCEPT_RATIO,
+        "accepted": bool(tokens_equal and ratio >= ACCEPT_RATIO),
+        "prefill_chunk": CHUNK, "block_size": BLOCK_SIZE,
+        "num_slots": num_slots,
+        "call_costs_ms": {k: round(v * 1e3, 2) for k, v in costs.items()},
+        "trace": f"three {LONG_LEN}-token cold prompts amid 30 "
+                 f"{SHORT_LEN}-token/{SHORT_NEW}-new short requests "
+                 f"arriving every 10 decode-steps, calibrated "
+                 f"virtual-clock replay",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "chunked_prefill": measure_chunked_prefill(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0 if res["chunked_prefill"]["accepted"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,10 @@ def measure_decode_paths(quick=True, B=4, prompt=32, max_new=32):
         toks[attn] = out
         res[attn] = {"tok_s": B * max_new / dt, "wall_s": dt}
     res["tokens_equal"] = bool((toks["pallas"] == toks["jnp"]).all())
+    # model.generate rides the engine default (paged since PR 5):
+    # record the operating point so rebanks against the dense-era
+    # DECODE_BENCH.json baseline can't silently mix engine kinds
+    res["paged_attn"] = True
     return res
 
 
@@ -162,6 +166,11 @@ def measure_continuous_batching(quick=True, repeats=5):
         rs = r if rs is None or r["wall_s"] < rs["wall_s"] else rs
     return {"continuous": cb, "restart": rs, "repeats": repeats,
             "speedup": cb["tok_s"] / rs["tok_s"],
+            # both legs share one engine kind (the paged default since
+            # PR 5), so the CB-vs-restart ratio stays like-vs-like;
+            # recorded so absolute tok/s drift vs the dense-era bank
+            # is attributable
+            "paged_attn": True,
             "num_slots": num_slots, "s_max": s_max,
             "trace": "2 waves of 4 (arrive @0/@12), budgets 64/8 alternating"
                      if quick else

@@ -68,6 +68,11 @@ def _run(model, reqs, num_slots, s_max, prefix_cache):
     eng = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_cache=prefix_cache, prefix_block_size=BLOCK_SIZE,
+        # pin the DENSE engine: this leg measures the install-copy
+        # prefill-work reduction the committed PREFIX_BENCH.json
+        # baselined (PR 3), which the paged default would silently
+        # replace with the zero-copy hit path (bench_paged.py owns that)
+        paged_attn=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([_clone(r) for r in reqs])

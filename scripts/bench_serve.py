@@ -114,6 +114,10 @@ def measure_serve_http(quick=True, n_requests=8, max_new=None,
         server.shutdown(drain=False, timeout=30)
     return {
         "direct": direct, "http": http, "repeats": repeats,
+        # direct and HTTP legs share the engine default (paged since
+        # PR 5): overhead_ratio stays like-vs-like; recorded so
+        # absolute numbers vs the dense-era bank are attributable
+        "paged_attn": True,
         "tokens_equal": tokens_equal,
         "overhead_ratio": http["wall_s"] / direct["wall_s"],
         "gateway_overhead_ms_per_token":

@@ -75,6 +75,7 @@ class TestBenchDriverFlow:
         assert art["serve_http"]["ok"] is False
         assert art["prefix_cache"]["ok"] is False
         assert art["paged_attn"]["ok"] is False
+        assert art["chunked_prefill"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -113,6 +114,14 @@ class TestBenchDriverFlow:
                                       "paged_copy_dispatches": 0,
                                       "hbm_reduction": 2.27,
                                       "tokens_equal": True}), ""
+            if leg == "--chunked-prefill":
+                # chunked-prefill TTFT leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "chunked_prefill",
+                                      "ok": True,
+                                      "p95_ttft_ratio": 4.4,
+                                      "accepted": True,
+                                      "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -147,14 +156,17 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:4] == ["--decode-cb", "--serve-http",
-                             "--prefix-cache", "--paged-attn"]
+        assert order[:5] == ["--decode-cb", "--serve-http",
+                             "--prefix-cache", "--paged-attn",
+                             "--chunked-prefill"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
         assert art["prefix_cache"]["prefill_work_reduction"] == 2.0
         assert art["paged_attn"]["paged_copy_dispatches"] == 0
         assert art["paged_attn"]["copy_dispatches_eliminated"] == 24
+        assert art["chunked_prefill"]["accepted"] is True
+        assert art["chunked_prefill"]["p95_ttft_ratio"] == 4.4
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

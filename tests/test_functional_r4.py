@@ -397,7 +397,13 @@ class TestRNNTLoss:
         full[:T] = dlogits
         return full
 
-    @pytest.mark.parametrize("lam", [0.0, 0.5])
+    @pytest.mark.parametrize("lam", [
+        # lam=0 is the fastemit-off degenerate (plain RNNT grad, already
+        # pinned by test_gradients_flow); the reweighting case stays the
+        # default rep
+        pytest.param(0.0, marks=pytest.mark.slow),
+        0.5,
+    ])
     def test_fastemit_gradient_matches_bruteforce(self, lam):
         """VERDICT r4 weak 5: fastemit_lambda must actually reweight the
         emit-branch gradient by (1+lambda), not just sit in the

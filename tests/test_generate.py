@@ -20,8 +20,9 @@ def _greedy_oracle(m, ids, n):
 
 
 class TestLlamaGenerate:
-    @pytest.mark.slow  # the MHA twin below is the default-run rep; GQA
-    # decode parity stays default via test_decode/test_serving
+    @pytest.mark.slow  # the MHA twin below is the default-run rep for
+    # generate-vs-full-forward parity; GQA decode stays pinned by
+    # default via test_decode's prefill+decode-vs-full parity
     def test_greedy_matches_full_forward_gqa(self):
         paddle.seed(11)
         m = LlamaForCausalLM(llama_tiny())  # nkv=2 < nh=4: GQA decode

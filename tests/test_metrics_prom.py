@@ -141,6 +141,41 @@ class TestHistogram:
         assert counts == sorted(counts)  # cumulative ⇒ non-decreasing
         assert counts[-1] == 200
 
+    def test_quantile_interpolates_within_bucket(self):
+        """quantile(): histogram_quantile-style linear interpolation —
+        exact at bucket boundaries, proportional inside, clamped to the
+        last finite bound past it, 0 on an empty series."""
+        h = Histogram("q", buckets=(1.0, 2.0, 4.0))
+        assert h.quantile(0.5) == 0.0            # empty
+        for v in (0.5, 1.5, 1.5, 3.0):           # counts: 1, 3, 4
+            h.observe(v)
+        # rank 2 of 4 lands in (1, 2]: prev_count 1, bucket count 3
+        assert h.quantile(0.5) == pytest.approx(1.0 + (2 - 1) / (3 - 1))
+        # target rank == a bucket's cumulative count -> its upper bound
+        assert h.quantile(0.25) == pytest.approx(1.0)
+        # fractional rank inside the first bucket interpolates from 0
+        assert h.quantile(0.125) == pytest.approx(0.5)
+        assert h.quantile(1.0) == pytest.approx(4.0)
+        h.observe(100.0)                         # beyond the ladder
+        assert h.quantile(0.99) == 4.0           # clamps to last bound
+        with pytest.raises(ValueError, match="quantile"):
+            h.quantile(0.0)
+        with pytest.raises(ValueError, match="quantile"):
+            h.quantile(1.5)
+
+    def test_ttft_ladder_resolves_sub_ms(self):
+        """The serving_ttft_seconds ladder (TTFT_BUCKETS) keeps sub-ms
+        resolution at the low end and spans to 30s — the p95 of a
+        tight sub-ms population must not collapse into one giant
+        default bucket."""
+        from paddle_tpu.profiler.metrics import (DEFAULT_BUCKETS,
+                                                 TTFT_BUCKETS)
+        assert TTFT_BUCKETS[0] < DEFAULT_BUCKETS[0]
+        h = Histogram("ttft", buckets=TTFT_BUCKETS)
+        for _ in range(100):
+            h.observe(0.0008)
+        assert h.quantile(0.95) <= 0.001   # resolved, not smeared to 5ms
+
     def test_empty_buckets_rejected(self):
         with pytest.raises(ValueError, match="at least one bucket"):
             Histogram("x", buckets=())

@@ -249,6 +249,9 @@ class TestMoEGPT:
         losses = [float(step.step((ids, ids), (ids,)).value) for _ in range(6)]
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # model-level EP-mesh step; test_moe_ep's
+    # ep_mesh_parity_vs_meshless stays the default EP-on-mesh rep and
+    # test_moe_training above keeps MoEGPT training default
     def test_moe_ep_mesh(self):
         paddle.seed(31)
         from paddle_tpu.models import MoEGPTForCausalLM, moe_tiny

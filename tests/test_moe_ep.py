@@ -158,6 +158,9 @@ class TestExpertParallel:
 
         np.testing.assert_allclose(run(False), run(True), rtol=2e-5, atol=2e-6)
 
+    @pytest.mark.slow  # ep4 x mp2 composition (suite wall time, 870s
+    # tier-1 cap); ep_mesh_parity_vs_meshless + moe_group_argument
+    # keep the dedicated-'ep'-axis behavior default
     def test_dedicated_ep_axis_independent_of_mp(self):
         """VERDICT r3 item 3: EP degree must not be welded to TP degree.
         On an ep4 x mp2 mesh the experts ride 'ep' (E/ep per device) while

@@ -42,6 +42,10 @@ def _engine(model, prefix_cache=True, **kw):
     kw.setdefault("num_slots", 2)
     kw.setdefault("max_seq_len", 64)
     kw.setdefault("decode_chunk", 1)
+    # this module pins the DENSE prefix-cache semantics (install-copy,
+    # publish-under-pressure skip, pool-as-budget) — the paged default
+    # has its own matrix in test_paged_attention/test_chunked_prefill
+    kw.setdefault("paged_attn", False)
     if prefix_cache:
         kw.setdefault("prefix_block_size", BS)
     return ContinuousBatchingEngine(model, prefix_cache=prefix_cache, **kw)
@@ -225,6 +229,10 @@ class TestEvictionAndBudget:
 
 
 class TestCompileDiscipline:
+    @pytest.mark.slow  # DENSE-shim compile discipline: the paged
+    # default's twins (test_paged_attention mixed-traffic +
+    # test_chunked_prefill's hit/miss/cancel/divergence matrix) stay
+    # the default reps — no new features land on the dense path
     def test_mixed_traffic_keeps_decode_at_one_and_prefill_bounded(
             self, model):
         """The acceptance pin: hits, misses, evictions, and a COW
@@ -322,7 +330,7 @@ class TestConstruction:
         geometry fails fast at __init__, not mid-serving in XLA."""
         donor = _engine(model)
         ok = ContinuousBatchingEngine(  # matching geometry: accepted
-            model, num_slots=2, max_seq_len=64,
+            model, num_slots=2, max_seq_len=64, paged_attn=False,
             prefix_cache=donor.prefix_cache,
             jit_cache=model.__dict__["_serving_jit"])
         assert ok.prefix_cache is donor.prefix_cache
@@ -330,6 +338,7 @@ class TestConstruction:
         other = LlamaForCausalLM(llama_tiny(hidden_size=32))  # head_dim 8
         with pytest.raises(ValueError, match="geometry"):
             ContinuousBatchingEngine(other, num_slots=2, max_seq_len=64,
+                                     paged_attn=False,
                                      prefix_cache=donor.prefix_cache)
 
     def test_prefix_blocks_zero_rejected_not_defaulted(self, model):

@@ -68,6 +68,10 @@ class TestEngineBasics:
 
 
 class TestDecodePathEquivalence:
+    @pytest.mark.slow  # engine-level pallas≡jnp (two engine builds);
+    # the kernel-vs-oracle suites (test_pallas_decode,
+    # test_pallas_paged_decode) and test_decode's program-level parity
+    # stay the default reps of the same chain
     def test_pallas_and_jnp_tokens_identical(self):
         """The ragged Pallas decode kernel and the jnp oracle produce the
         same greedy continuation AND the same sampled continuation under
@@ -183,14 +187,16 @@ class TestCompileOnce:
 
     def test_model_generate_shares_decode_program(self, model):
         """model.generate() rides the same compile-once contract when the
-        cache length is pinned: sampling-knob changes add no traces."""
+        cache length is pinned: sampling-knob changes add no traces.
+        (model.generate inherits the paged engine default, so the
+        programs counted are the "pdecode" kind.)"""
         t = paddle.to_tensor(np.stack([_prompt(17)]))
         m = model
 
         def decode_traces():
             return sum(fn._cache_size()
                        for key, fn in m._serving_jit.items()
-                       if key[0] == "decode")
+                       if key[0] == "pdecode")
 
         before = decode_traces()  # other tests share this model's cache
         m.generate(t, max_new_tokens=6, max_cache_len=32)
