@@ -486,6 +486,15 @@ def watchdog():
     cp = _parse_result(rc, out)
     cb_extra["chunked_prefill"] = cp if cp is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Unified-ragged-step leg: program launches per mixed serving step,
+    # unified vs the two-program pair (scripts/bench_ragged.py) — exact
+    # dispatch counters on the calibrated replay, CPU-forced, banked up
+    # front like the other scheduling legs.
+    rc, out, err = _run([me, "--ragged"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    rg = _parse_result(rc, out)
+    cb_extra["ragged_step"] = rg if rg is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -640,6 +649,13 @@ if __name__ == "__main__":
         from bench_chunked import measure_chunked_prefill
         print(json.dumps({"name": "chunked_prefill", "ok": True,
                           **measure_chunked_prefill(quick=True)}))
+        sys.exit(0)
+    if "--ragged" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_ragged import measure_ragged_step
+        print(json.dumps({"name": "ragged_step", "ok": True,
+                          **measure_ragged_step(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

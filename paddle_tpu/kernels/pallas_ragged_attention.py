@@ -1,0 +1,330 @@
+"""Pallas TPU ragged *prefill+decode* paged attention — one kernel that
+processes a mixed batch of variable-length query spans through the
+serving stack's block tables.
+
+This is the full kernel shape of "Ragged Paged Attention: A
+High-Performance and Flexible LLM Inference Kernel for TPU" (PAPERS.md):
+where ``pallas_paged_decode.py`` handles exactly one query token per
+sequence, this kernel takes a PACKED query buffer ``[T, H, D]`` holding
+every sequence's span back to back — decode rows are spans of length 1,
+chunked-prefill rows are spans of length n — plus per-sequence row
+metadata ``(query_start, query_len, kv_len)`` scalar-prefetched
+alongside the block tables. One invocation computes causal-within-span
+attention for the whole mixed batch, which is what lets the serving
+engine fuse its prefill-chunk and decode programs into a single device
+call (``serving/decode.build_ragged_step_fn``).
+
+Semantics per sequence ``r`` (dead rows carry ``query_len == 0``):
+
+- its queries are packed rows ``query_start[r] .. query_start[r] +
+  query_len[r]`` of ``q``;
+- span token ``i`` sits at logical position
+  ``kv_len[r] - query_len[r] + i`` of the sequence (``kv_len`` counts
+  the KV valid AFTER this step's writes, so a decode row with cache
+  length L passes ``kv_len = L + 1``) and attends causally over
+  positions ``0 .. pos`` through ``tables[r]``;
+- packed rows outside every span produce exact zeros.
+
+Design points, inherited from ``pallas_paged_decode.py`` (same
+Mosaic-conservative lowering, same block-diagonal wide-query GQA
+trick, same table-indirect DMA):
+
+- **Table-indirect DMA + ragged skip**: the KV BlockSpec index map
+  resolves the scalar-prefetched table at DMA-issue time; blocks fully
+  past ``kv_len[r]`` re-reference the last valid block (copy elided on
+  repeat), so HBM traffic scales with the live logical cache. Sentinel
+  entries (``>= num_blocks``) clamp into the pool — a harmless read,
+  masked off.
+- **Span-block gating**: the packed wide-query array is tiled into
+  fixed query blocks; a grid step whose query block does not intersect
+  sequence ``r``'s span is ``pl.when``-gated off entirely (and its KV
+  fetch repeats the resident block, so it costs neither HBM nor MXU).
+  MXU work on the masked remainder of an intersecting block is the
+  same idle-MXU trade the wide-query trick already makes — decode is
+  HBM-bound and KV traffic is unchanged.
+- **2D-tile conservatism**: all blocks are 2D/leading-1 tiles whose
+  last-two dims equal the full array dims; compute is plain 2D
+  ``dot_general``; the per-row online-softmax state lives in VMEM
+  scratch exactly like the decode kernels, so span-1 rows reproduce
+  ``paged_decode_attention_pallas``'s accumulation order bit for bit.
+
+Inference-only (no VJP): the serving step never backpropagates.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_flash import _cparams, _interpret_mode
+
+NEG_INF = -1e30
+
+
+def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc_scr, *, scale, block_k, tq, gh):
+    qi = pl.program_id(0)
+    r = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qstart = qs_ref[r]
+    qlen = ql_ref[r]
+    kvlen = kl_ref[r]
+    row0 = qi * tq                  # first wide row of this query block
+    span_lo = qstart * gh           # span bounds in wide-row coordinates
+    span_hi = (qstart + qlen) * gh
+
+    @pl.when((r == 0) & (ki == 0))
+    def _zero_out():
+        # first visit of this output block: packed rows outside every
+        # span must come back as exact zeros, not stale VMEM
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # compute only when this query block intersects the span AND the KV
+    # block is not fully past the row's valid length (ragged skip)
+    inter = (span_lo < row0 + tq) & (span_hi > row0)
+
+    @pl.when(inter & (ki * block_k < kvlen))
+    def _compute():
+        q = q_ref[:]                        # [tq, KD] block-diag wide
+        k = k_ref[0]                        # [block_k, KD]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        wrow = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # causal-within-span: wide row w belongs to span token
+        # (w - span_lo) // gh, whose logical position is
+        # kvlen - qlen + that token index
+        pos = kvlen - qlen + (wrow - span_lo) // gh
+        valid = (wrow >= span_lo) & (wrow < span_hi) & (cols <= pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # exp hits exact 0 on masked cols, but pool rows past `kvlen`
+        # may hold another block's garbage — zero them out of PV
+        p = jnp.where(valid, p, 0.0)
+        v = jnp.where(
+            ki * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0) < kvlen,
+            v, jnp.zeros_like(v))
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # write back ONLY this row's span: the output block is shared by
+        # every sequence whose span intersects it, so the write must be
+        # a masked read-modify-write (rows not in span keep their value)
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        wrow = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, acc_scr.shape, 0)
+        in_span = (wrow >= span_lo) & (wrow < span_hi)
+        o_ref[:] = jnp.where(in_span,
+                             (acc_scr[:] / l).astype(o_ref.dtype),
+                             o_ref[:])
+
+
+def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
+                 scale, gh, block_q, interpret):
+    """q_wide: [TH_pad, KD] block-diagonal wide rows (gh per token);
+    pool_*: [num_blocks, bs, KD]; tables: [R, max_blocks] int32."""
+    TH, KD = q_wide.shape
+    num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
+    R, nk = tables.shape
+    nq = TH // block_q
+    grid = (nq, R, nk)
+    kernel = functools.partial(_ragged_kernel, scale=scale, block_k=bs,
+                               tq=block_q, gh=gh)
+
+    def _kv_index(qi, r, ki, qs, ql, kl, tbl):
+        # table-indirect fetch with the decode kernel's ragged-skip
+        # clamp: steps past the last valid logical block re-reference it
+        # (copy elided on repeat), and sentinel entries clamp into the
+        # pool — a harmless read, masked by kv_len in the kernel.
+        last = (jnp.maximum(kl[r], 1) - 1) // bs
+        phys = tbl[r, jnp.minimum(ki, last)]
+        return (jnp.clip(phys, 0, num_blocks - 1), 0, 0)
+
+    def _q_index(qi, r, ki, qs, ql, kl, tbl):
+        return (qi, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_q, KD), _q_index),
+                pl.BlockSpec((1, bs, KD), _kv_index),
+                pl.BlockSpec((1, bs, KD), _kv_index),
+            ],
+            out_specs=pl.BlockSpec((block_q, KD), _q_index),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, KD), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((TH, KD), q_wide.dtype),
+        # every grid dim revisits blocks (the output block is shared
+        # across r and accumulated across ki) — no reordering allowed
+        compiler_params=_cparams(("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qstart, qlen, kvlen, tables, q_wide, pool_k, pool_v)
+    return out
+
+
+# Inference-only custom_vjp, same rationale as pallas_paged_decode: the
+# eager dispatch linearizes through every op and scalar-prefetch
+# pallas_calls don't linearize in interpret mode.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _ragged(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen, scale,
+            gh, block_q):
+    return _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen,
+                        kvlen, scale, gh, block_q, _interpret_mode())
+
+
+def _ragged_fwd_rule(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
+                     scale, gh, block_q):
+    return _ragged(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
+                   scale, gh, block_q), None
+
+
+def _ragged_bwd_rule(scale, gh, block_q, res, g):
+    raise NotImplementedError(
+        "ragged_paged_attention_pallas is inference-only (the serving "
+        "step never backpropagates)")
+
+
+_ragged.defvjp(_ragged_fwd_rule, _ragged_bwd_rule)
+
+
+def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
+                                  kvlen, block_q=256):
+    """Mixed prefill+decode attention over packed query spans through
+    per-sequence block tables.
+
+    q:        [T, H, D]              — the packed query buffer
+    pool_k:   [num_blocks, bs, Hkv, D]  — the shared KV block pool
+    pool_v:   [num_blocks, bs, Hkv, D]
+    tables:   [R, max_blocks] int32  — physical block ids per sequence
+                                       (entries >= num_blocks = unmapped)
+    qstart:   [R] int32 — span start (packed row) per sequence
+    qlen:     [R] int32 — span length per sequence (0 = dead row)
+    kvlen:    [R] int32 — valid logical KV rows per sequence AFTER this
+                          step's writes (span token i attends over
+                          positions 0 .. kvlen - qlen + i)
+    returns:  [T, H, D]; packed rows outside every span are exact zeros
+
+    GQA is resolved with the block-diagonal wide-query trick (see
+    ``pallas_decode.py``); KV blocks past a row's ``kvlen`` are never
+    fetched; sentinel table entries clamp harmlessly. A span of length 1
+    reproduces ``paged_decode_attention_pallas`` for that row exactly
+    (same block walk, same online-softmax accumulation order).
+    """
+    T, H, D = q.shape
+    Hkv = pool_k.shape[2]
+    assert H % Hkv == 0, (H, Hkv)
+    G = H // Hkv
+    num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
+    KD = Hkv * D
+    scale = 1.0 / math.sqrt(D)
+    qstart = jnp.asarray(qstart, jnp.int32).reshape(-1)
+    qlen = jnp.asarray(qlen, jnp.int32).reshape(-1)
+    kvlen = jnp.asarray(kvlen, jnp.int32).reshape(-1)
+    tables = jnp.asarray(tables, jnp.int32).reshape(qstart.shape[0], -1)
+    # block-diagonal wide query: head h's D values at its kv group's
+    # lanes, one wide row per (token, head)
+    eye = jnp.eye(Hkv, dtype=q.dtype)
+    q_wide = jnp.einsum("bkgd,kj->bkgjd", q.reshape(T, Hkv, G, D), eye)
+    q_wide = q_wide.reshape(T * H, KD)
+    # pad the wide-row dim to a whole number of query blocks; the query
+    # block is kept a multiple of H so //gh never crosses a pad boundary
+    bq = max(H, min(int(block_q) // H * H, T * H))
+    th_pad = -(-(T * H) // bq) * bq
+    if th_pad != T * H:
+        q_wide = jnp.pad(q_wide, ((0, th_pad - T * H), (0, 0)))
+    out_wide = _ragged(q_wide, pool_k.reshape(num_blocks, bs, KD),
+                       pool_v.reshape(num_blocks, bs, KD), tables,
+                       qstart, qlen, kvlen, scale, H, bq)
+    out_wide = out_wide[:T * H]
+    # extract each head's own kv-group block from the wide accumulator
+    out = jnp.einsum("bkgjd,kj->bkgd",
+                     out_wide.reshape(T, Hkv, G, Hkv, D), eye)
+    return out.reshape(T, H, D)
+
+
+def ragged_attention_reference(q, pool_k, pool_v, tables, qstart, qlen,
+                               kvlen):
+    """jnp oracle with identical semantics — and, deliberately, the
+    exact op sequence of the two programs it unifies: a span-1 row
+    reproduces ``paged_decode_attention_reference`` and a span-n row
+    reproduces ``_paged_suffix_prefill_impl``'s in-program attention
+    (same einsums, same masking, same plain softmax), so the unified
+    serving step can be pinned bitwise against the old pair."""
+    T, H, D = q.shape
+    num_blocks, bs, Hkv, _ = pool_k.shape
+    G = H // Hkv
+    R, mb = jnp.asarray(tables).shape
+    s_tot = mb * bs
+    scale = 1.0 / math.sqrt(D)
+    qstart = jnp.asarray(qstart, jnp.int32).reshape(R)
+    qlen = jnp.asarray(qlen, jnp.int32).reshape(R)
+    kvlen = jnp.asarray(kvlen, jnp.int32).reshape(R)
+    tables = jnp.asarray(tables, jnp.int32)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    # token -> sequence map (spans are disjoint; dead tokens match none)
+    in_r = (t_idx[None, :] >= qstart[:, None]) \
+        & (t_idx[None, :] < (qstart + qlen)[:, None])     # [R, T]
+    live = jnp.any(in_r, axis=0)                          # [T]
+    seg = jnp.argmax(in_r, axis=0).astype(jnp.int32)      # [T]
+    # per-token logical cache, gathered in two stages: pool -> per-ROW
+    # cache through each sequence's table ([R, s_tot], the same gather
+    # the decode reference pays), then a contiguous per-token row pick.
+    # Elementwise identical to the direct [T, mb]-indexed pool gather
+    # (gathers compute nothing, so reassociation is exact) but the
+    # random-access pool traffic scales with R instead of T — on the
+    # CPU/jnp serving path the packed buffer's padding rows would
+    # otherwise multiply the dominant gather cost ~T/R-fold.
+    # (clip keeps sentinel entries harmless — masked by kvlen)
+    k_rows = jnp.take(pool_k, tables, axis=0,
+                      mode="clip").reshape(R, s_tot, Hkv, D)
+    v_rows = jnp.take(pool_v, tables, axis=0,
+                      mode="clip").reshape(R, s_tot, Hkv, D)
+    k = jnp.take(k_rows, seg, axis=0)                     # [T, s_tot, ...]
+    v = jnp.take(v_rows, seg, axis=0)
+    kf = jnp.repeat(k, G, axis=2) if G > 1 else k
+    vf = jnp.repeat(v, G, axis=2) if G > 1 else v
+    pos = (jnp.take(kvlen, seg) - jnp.take(qlen, seg)
+           + (t_idx - jnp.take(qstart, seg)))             # [T]
+    cols = jnp.arange(s_tot, dtype=jnp.int32)
+    mask = (cols[None, :] <= pos[:, None]) & live[:, None]  # [T, s_tot]
+    logits = jnp.einsum("qhd,qkhd->qhk", q, kf,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # exact zeros on masked cols + zeroed garbage rows: stale pool rows
+    # can be anything (0 * NaN = NaN)
+    probs = jnp.where(mask[:, None, :], probs, 0.0)
+    row_valid = cols[None, :] < jnp.take(kvlen, seg)[:, None]
+    vf = jnp.where(row_valid[:, :, None, None], vf, 0.0)
+    out = jnp.einsum("qhk,qkhd->qhd", probs.astype(q.dtype), vf)
+    return jnp.where(live[:, None, None], out, jnp.zeros_like(out))

@@ -316,6 +316,14 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 TTFT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+# engine-step duration ladder (``serving_step_duration_seconds``): one
+# unified serving step is ~sub-ms on real chips and tens of ms on the
+# CPU tiny models; the top distinguishes a chunk-heavy 1 s step from a
+# wedged 10 s one. These observations are the same signal the engine's
+# headroom EWMAs (the adaptive chunk budget) read.
+STEP_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (latency distributions).

@@ -41,6 +41,8 @@ from ..kernels.pallas_decode import (decode_attention_pallas,
                                      decode_attention_reference)
 from ..kernels.pallas_paged_decode import (paged_decode_attention_pallas,
                                            paged_decode_attention_reference)
+from ..kernels.pallas_ragged_attention import (ragged_attention_reference,
+                                               ragged_paged_attention_pallas)
 from ..models.llama import _apply_rope, _qkv_bshd, _rms, _rope_tables, \
     _swiglu_raw
 
@@ -525,4 +527,176 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _paged_decode_steps_impl, n_steps=n_steps, nh=nh, nkv=nkv,
             hd=hd, eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+        donate_argnums=(1, 2) if donate else ())
+
+
+# ------------------------------------------------------ unified ragged step
+def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
+                      qstart, qlen, kvlen, dec_mask, keys, temps, top_ks,
+                      *, n_steps, nh, nkv, hd, eps, theta, tied,
+                      decode_attn):
+    """THE unified serving step: one device call that advances every
+    slot's span — decode rows (span 1) and prefill chunks (span n) —
+    through the same block tables, collapsing the
+    ``_paged_suffix_prefill_impl`` + ``_paged_decode_steps_impl`` pair
+    the engine used to interleave (README "Unified ragged attention").
+
+    Packed layout (host-built, all runtime arrays — shapes depend only
+    on ``(num_slots, token_budget)``):
+
+    ids:     [T] int32 — packed input token ids (decode rows carry the
+             slot's last sampled token; chunk rows carry their prompt
+             slice; dead packed rows carry 0)
+    seg:     [T] int32 — owning slot per packed token (``num_slots`` =
+             dead row: every write drops)
+    pos:     [T] int32 — logical position per packed token
+             (``kvlen[r] - qlen[r] + i`` for span token i)
+    qstart/qlen/kvlen: [R] span metadata (``serving/decode`` twin of the
+             kernel's row metadata; ``qlen == 0`` = idle slot)
+    dec_mask: [R] int32 — 1 where the span is a RUNNING decode row
+             (spans that may keep ticking in the fused tail and whose
+             appends are real), 0 for chunk rows / idle slots (their
+             tail-tick writes are forced to drop)
+    keys/temps/top_ks: [R] per-slot sampling state — chunk rows carry
+             the sequence's resume key, live (sampling) only on their
+             FINAL chunk, exactly like ``_suffix_call`` rows.
+
+    Tick 0 runs the packed buffer through one forward pass — K/V
+    scattered through the tables at per-token positions, attention via
+    the ragged paged kernel (or its jnp oracle) — then samples one
+    token per slot from its span's LAST position. Ticks ``1..n_steps-1``
+    are the fused decode scan of ``_paged_decode_steps_impl``, verbatim
+    (the engine only fuses when no prefill work is pending, so the tail
+    ticks are pure decode; ``dec_mask`` keeps a stray non-decode row's
+    appends out of the pool regardless).
+
+    Returns ``(pool_k', pool_v', toks [n_steps, R], keys_t0, keys')``:
+    ``toks[0]``/``keys_t0`` are tick 0's per-slot sample + advanced key
+    (what a final chunk row adopts as its token 0 — the same split walk
+    as a one-shot prefill, so streams stay byte-identical); ``keys'``
+    is the post-scan key state the engine adopts for decode rows.
+    """
+    T = ids.shape[0]
+    R = tables.shape[0]
+    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    mb = tables.shape[1]
+    s_tot = mb * bs
+    sin, cos = _rope_tables(s_tot, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    # ---------------------------------------------------------- tick 0
+    sin_p = jnp.take(sin, pos, axis=0, mode="clip")[None]   # [1, T, D]
+    cos_p = jnp.take(cos, pos, axis=0, mode="clip")[None]
+    # pool write coordinates: token t appends at its logical position
+    # through its OWN slot's table; dead packed rows (seg == R) and
+    # positions past the logical capacity drop — never clamp into a
+    # block another sequence owns
+    live_tok = seg < R
+    seg_c = jnp.minimum(seg, R - 1)
+    bi = jnp.minimum(pos // bs, mb - 1)
+    phys0 = jnp.take_along_axis(jnp.take(tables, seg_c, axis=0),
+                                bi[:, None], axis=1)[:, 0]
+    phys0 = jnp.where(live_tok & (pos < s_tot), phys0, nb)
+    prow0 = pos % bs
+
+    def layer0(h, lp):
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope_grid(q, sin_p, cos_p)
+        k = _apply_rope_grid(k, sin_p, cos_p)
+        # write the packed K/V through the tables, then attend over each
+        # span causally at its row's kv length
+        pk_l = pk_l.at[phys0, prow0].set(k[0], mode="drop")
+        pv_l = pv_l.at[phys0, prow0].set(v[0], mode="drop")
+        if decode_attn == "pallas":
+            attn = ragged_paged_attention_pallas(
+                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+        else:
+            attn = ragged_attention_reference(
+                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+        h = h + jnp.einsum("bsd,dh->bsh",
+                           attn.reshape(1, T, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (pk_l, pv_l)
+
+    x = jnp.take(params["embed"], ids[None], axis=0)        # [1, T, H]
+    x, (pk, pv) = jax.lax.scan(layer0, x, stack + (pool_k, pool_v))
+    # each slot samples from its span's LAST packed position (decode
+    # rows: the one token; chunk rows: the chunk end — live only when
+    # the chunk completes the prompt)
+    last_idx = jnp.clip(qstart + qlen - 1, 0, T - 1)
+    last = jnp.take(x[0], last_idx, axis=0)                 # [R, H]
+    last_h = _rms(last, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    both = jax.vmap(jax.random.split)(keys)                 # [R, 2, 2]
+    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
+    keys_t0 = both[:, 0]
+
+    # ------------------------------------------- fused tail (pure decode)
+    lens0 = jnp.where(dec_mask > 0, kvlen, 0)
+
+    def one_step(carry, _):
+        tok, pk_all, pv_all, lens, kys = carry
+        x = jnp.take(params["embed"], tok[:, None], axis=0)
+        sin_r = jnp.take(sin, lens, axis=0, mode="clip")
+        cos_r = jnp.take(cos, lens, axis=0, mode="clip")
+        bi = jnp.minimum(lens // bs, mb - 1)
+        phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
+        # non-decode rows (idle slots, a chunk row that just finished)
+        # must not append: their next write belongs to the next step's
+        # program, not this scan
+        phys = jnp.where((dec_mask > 0) & (lens < s_tot), phys, nb)
+        prow = lens % bs
+
+        def layer(h, xs):
+            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
+            hn = _rms(h, lin, eps)
+            q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+            q = _apply_rope_rows(q, sin_r, cos_r)
+            k = _apply_rope_rows(k, sin_r, cos_r)
+            pk_l = pk_l.at[phys, prow].set(k[:, 0], mode="drop")
+            pv_l = pv_l.at[phys, prow].set(v[:, 0], mode="drop")
+            if decode_attn == "pallas":
+                attn = paged_decode_attention_pallas(
+                    q[:, 0], pk_l, pv_l, tables, lens + dec_mask)
+            else:
+                attn = paged_decode_attention_reference(
+                    q[:, 0], pk_l, pv_l, tables, lens + dec_mask)
+            h = h + jnp.einsum("bsd,dh->bsh",
+                               attn.reshape(R, 1, nh * hd), lwo)
+            h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+            return h, (pk_l, pv_l)
+
+        x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
+        lastt = _rms(x[:, 0], params["final_norm"], eps)
+        lgt = jnp.einsum("bh,hv->bv", lastt, head)
+        b2 = jax.vmap(jax.random.split)(kys)
+        nxt = sample_rows(lgt, b2[:, 1], temps, top_ks)
+        return (nxt, npk, npv, lens + dec_mask, b2[:, 0]), nxt
+
+    if n_steps > 1:
+        carry0 = (tok0, pk, pv, lens0, keys_t0)
+        (_, pk, pv, _, keys_fin), toks_rest = jax.lax.scan(
+            one_step, carry0, None, length=n_steps - 1)
+        toks = jnp.concatenate([tok0[None], toks_rest], axis=0)
+    else:
+        toks, keys_fin = tok0[None], keys_t0
+    return pk, pv, toks, keys_t0, keys_fin
+
+
+def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
+                         decode_attn, donate=None):
+    """One jitted unified serving step (``_ragged_step_impl``): shapes
+    depend only on ``(num_slots, token_budget)`` plus the fused
+    ``n_steps`` — one compilation per step size serves every span mix,
+    the same compile-once contract as the decode program it
+    replaces."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(
+            _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
         donate_argnums=(1, 2) if donate else ())

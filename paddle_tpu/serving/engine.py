@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import build_decode_steps_fn, build_paged_decode_steps_fn, \
-    build_paged_suffix_prefill_fn, build_prefill_fn, \
+    build_paged_suffix_prefill_fn, build_prefill_fn, build_ragged_step_fn, \
     build_suffix_prefill_fn, llama_decode_params
 from .kv_cache import PagedKVCache, SlotKVCache
 from .request import GenerationRequest, GenerationResult, Sequence
@@ -88,13 +88,47 @@ class ContinuousBatchingEngine:
     expiry mid-chunk frees (or donates) the partial block chain.
     ``prefill_chunk=None``/``0`` disables chunking; the dense engine
     ignores it (one-shot prefill — chunking rides the block tables).
+
+    ``ragged_step=True`` (the default on the paged engine, README
+    "Unified ragged attention") runs decode rows AND prefill chunks
+    through ONE device program per step — the unified ragged step
+    (``decode.build_ragged_step_fn`` over the ragged paged attention
+    kernel): each slot contributes one variable-length query span
+    (decode = span 1, chunk = span n) to a packed token buffer whose
+    shape depends only on ``(num_slots, token_budget)``, so a mixed
+    prefill+decode step costs one program launch instead of the
+    chunk-call + decode-call pair, and a mid-prefill slot no longer
+    burns a discarded full-length decode row. ``ragged_step=False``
+    keeps the PR-5 two-program interleave as the A/B baseline (token
+    streams are byte-identical either way). With the unified step, the
+    per-step chunk grant is adapted at runtime from a measured
+    tokens-per-second EWMA (the ``headroom`` stat): the engine grants
+    roughly ``headroom_mult`` decode-steps' worth of tokens per step —
+    ``prefill_chunk`` remains the hard cap — so chunk work throttles
+    itself under decode load instead of stretching every resident
+    request's latency. ``headroom_mult=None`` pins the grant at the
+    cap (fixed PR-5 pacing, what the deterministic benches use).
+    ``step_clock`` injects the timebase the EWMA reads (tests/benches
+    pass a virtual clock; default ``time.perf_counter``).
+
+    Substrate note: the unified program's packed buffer is a fixed
+    ``num_slots + prefill_chunk`` tokens, which the TPU Pallas kernel
+    prices at the LIVE spans only (span-block gating + ragged DMA
+    skip) but the CPU ``decode_attention="jnp"`` oracle computes
+    densely — on that correctness substrate a decode-only step pays
+    the padding, so CPU deployments that never chunk should pass
+    ``ragged_step=False`` (or ``prefill_chunk=None``, which sizes the
+    buffer back to ``num_slots``). The serving benches pin the
+    two-program baseline for exactly this reason
+    (``RAGGED_BENCH.json``'s ``cpu_oracle_wall_ms`` records the gap).
     """
 
     def __init__(self, model, num_slots=8, max_seq_len=None, decode_chunk=8,
                  prefill_bucketing="pow2", jit_cache=None,
                  prefix_cache=False, prefix_blocks=None,
                  prefix_block_size=32, paged_attn=True,
-                 prefill_chunk=512):
+                 prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
+                 step_clock=None):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -213,6 +247,29 @@ class ContinuousBatchingEngine:
         if self._paged and prefill_chunk:
             bs = self.cache.block_size
             self._chunk = -(-int(prefill_chunk) // bs) * bs
+        # unified ragged step (paged only): size the packed token buffer
+        # once — num_slots decode rows plus the chunk cap, but only when
+        # a prompt long enough to chunk can exist at all (a chunk cap >=
+        # max_seq_len can never trigger, so the buffer stays num_slots
+        # and a decode-only engine pays nothing for the unification)
+        self._ragged = self._paged and bool(ragged_step)
+        chunkable = self._chunk is not None and self._chunk < self.max_seq_len
+        self._token_budget = self.num_slots + (self._chunk if chunkable
+                                               else 0)
+        if headroom_mult is not None and float(headroom_mult) <= 0:
+            raise ValueError(
+                f"headroom_mult must be > 0 (or None for fixed-cap chunk "
+                f"pacing), got {headroom_mult}")
+        self._headroom_mult = (None if headroom_mult is None
+                               else float(headroom_mult))
+        self._clock = step_clock if step_clock is not None \
+            else time.perf_counter
+        # headroom EWMAs (the adaptive chunk budget's inputs): measured
+        # unified-step tokens/second, and the duration of decode-only
+        # steps (the latency baseline chunk work must not stretch past
+        # ~headroom_mult x)
+        self._tps_ewma = None
+        self._dt_decode_ewma = None
         self.scheduler = FIFOScheduler(decode_chunk)
         self._slots = [None] * self.num_slots
         self._last_tok = np.zeros(self.num_slots, np.int32)
@@ -228,7 +285,10 @@ class ContinuousBatchingEngine:
                       "prefills": 0, "prefill_tokens": 0,
                       "prefill_tokens_saved": 0,
                       "prefill_copy_dispatches": 0,
-                      "prefill_chunks": 0,
+                      "prefill_chunks": 0, "chunk_tokens": 0,
+                      "unified_steps": 0,
+                      "headroom": self._chunk or 0, "headroom_tps": 0.0,
+                      "last_step_duration_s": 0.0, "last_step_tokens": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
@@ -275,6 +335,29 @@ class ContinuousBatchingEngine:
                 **self._fn_consts())
         return self._jit[key]
 
+    def _ragged_fn(self, n_steps):
+        # the full packed-buffer geometry — num_slots AND token budget,
+        # not their sum alone — is part of the key: engines with
+        # different geometry sharing one jit_cache must not pool their
+        # shape-keyed traces under one fn (decode_compilations counts
+        # only THIS engine's geometry, and e.g. slots=8/chunk=64 vs
+        # slots=16/chunk=56 share a token budget of 72)
+        key = ("ragged", self.num_slots, self._token_budget,
+               int(n_steps), self.config.decode_attention)
+        if key not in self._jit:
+            self._jit[key] = build_ragged_step_fn(
+                n_steps=int(n_steps),
+                decode_attn=self.config.decode_attention,
+                **self._fn_consts())
+        return self._jit[key]
+
+    @property
+    def ragged_step(self) -> bool:
+        """Whether this engine runs the unified ragged step (one device
+        program per step for decode rows + prefill chunks) — the public
+        surface for banners/metrics."""
+        return self._ragged
+
     @property
     def prefill_chunk(self) -> int:
         """The EFFECTIVE chunked-prefill budget this engine runs: the
@@ -288,9 +371,16 @@ class ContinuousBatchingEngine:
     def decode_compilations(self) -> int:
         """Total decode-program traces OF THIS ENGINE'S KIND (the
         compiles-once assertion hook): stays at one per ``(num_slots,
-        max_seq_len, n_steps)`` no matter how request sampling params /
-        token budgets / block tables vary. Dense and paged engines
-        sharing one jit_cache count only their own programs."""
+        max_seq_len, n_steps)`` — on the unified engine, one per
+        ``(num_slots, token_budget, n_steps)`` — no matter how request
+        sampling params / token budgets / block tables / span mixes
+        vary. Dense, paged-two-program and unified engines sharing one
+        jit_cache count only their own programs."""
+        if self._ragged:
+            return sum(fn._cache_size() for key, fn in self._jit.items()
+                       if key[0] == "ragged"
+                       and key[1] == self.num_slots
+                       and key[2] == self._token_budget)
         kind = "pdecode" if self._paged else "decode"
         return sum(fn._cache_size() for key, fn in self._jit.items()
                    if key[0] == kind)
@@ -587,17 +677,26 @@ class ContinuousBatchingEngine:
                 rows.append((seq, off, n, off + n == seq.prompt_len))
             tok0s, keys2 = self._suffix_call(s_pad, rows)
             for i, (seq, n) in enumerate(group):
-                slot, end = seq.slot, seq.prefilled + n
-                self.stats["prefill_chunks"] += 1
-                self.cache.lengths[slot] = end
-                seq.prefilled = end
-                if end == seq.prompt_len:       # prompt complete
-                    self.scheduler.leave_prefill(seq)
-                    self.stats["prefill_tokens_saved"] += \
-                        seq.prefix_hit_tokens
-                    self._install_seq(
-                        seq, slot, tok0s[i], keys2[i],
-                        seq.prompt_len - seq.prefix_hit_tokens, finished)
+                self._advance_chunk(seq, n, tok0s[i], keys2[i], finished)
+
+    def _advance_chunk(self, seq, n, tok0, key0, finished):
+        """Per-chunk completion bookkeeping shared by the two-program
+        chunk call and the unified ragged step — the ONE place chunk
+        accounting and the final-chunk install live, so the two step
+        paths cannot silently diverge. ``tok0``/``key0`` are the chunk
+        row's sampled token + advanced key, consumed only when this
+        chunk completes the prompt."""
+        slot, end = seq.slot, seq.prefilled + n
+        self.stats["prefill_chunks"] += 1
+        self.stats["chunk_tokens"] += n
+        self.cache.lengths[slot] = end
+        seq.prefilled = end
+        if end == seq.prompt_len:           # prompt complete
+            self.scheduler.leave_prefill(seq)
+            self.stats["prefill_tokens_saved"] += seq.prefix_hit_tokens
+            self._install_seq(seq, slot, tok0, key0,
+                              seq.prompt_len - seq.prefix_hit_tokens,
+                              finished)
 
     def _install_seq(self, seq, slot, tok0, key2, prefilled_tokens,
                      finished):
@@ -699,12 +798,16 @@ class ContinuousBatchingEngine:
             self.on_token(seq, token)
 
     def step(self):
-        """Admit + at most one budgeted chunk of pending prefill + one
-        fused decode call + retire. Returns every sequence this step
-        finished (possibly empty), deadline expiries included —
-        queue-side timeouts come back with ``slot=None`` and no tokens.
-        Only :meth:`cancel` retires outside a step; those surface
-        through ``on_finish`` / the Sequence handle alone."""
+        """Admit + this step's budgeted prefill-chunk grant + decode +
+        retire. On the unified engine (``ragged_step=True``) the grant
+        and the decode tick are ONE device program; on the two-program
+        baseline they are the PR-5 chunk-call + fused-decode-call pair.
+        Returns every sequence this step finished (possibly empty),
+        deadline expiries included — queue-side timeouts come back with
+        ``slot=None`` and no tokens. Only :meth:`cancel` retires
+        outside a step; those surface through ``on_finish`` / the
+        Sequence handle alone."""
+        t0 = self._clock()
         finished = []
         # deadline sweep BEFORE admission: an expired queued request
         # must never claim a slot (and a running one stops paying for
@@ -718,11 +821,199 @@ class ContinuousBatchingEngine:
             if self.prefix_cache is not None else None)
         if admitted:
             self._admit_group(admitted, finished)
+        if self._ragged:
+            step_tokens, had_chunks = self._unified_step(finished)
+        else:
+            step_tokens, had_chunks = self._two_program_step(finished)
+        self.stats["steps"] += 1
+        self._record_step(self._clock() - t0, step_tokens, had_chunks)
+        return finished
+
+    def _record_step(self, dt, tokens, had_chunks):
+        """Feed the step's measured duration + processed tokens into
+        the stats surface (``serving_step_duration_seconds`` /
+        ``serving_step_tokens`` on /metrics read exactly these) and,
+        on the unified engine, into the headroom EWMAs the adaptive
+        chunk budget derives from."""
+        self.stats["last_step_duration_s"] = float(dt)
+        self.stats["last_step_tokens"] = int(tokens)
+        if not self._ragged or tokens <= 0 or dt <= 0:
+            return
+        a = 0.2
+        if had_chunks:
+            # packed-step throughput: what a chunk-carrying unified
+            # step actually moves per second. Decode-only steps must
+            # NOT feed this — their tokens/s is an autoregressive
+            # rate, ~budget-fold below what the packed buffer absorbs
+            tps = tokens / dt
+            self._tps_ewma = tps if self._tps_ewma is None \
+                else (1 - a) * self._tps_ewma + a * tps
+            self.stats["headroom_tps"] = self._tps_ewma
+        else:
+            self._dt_decode_ewma = dt if self._dt_decode_ewma is None \
+                else (1 - a) * self._dt_decode_ewma + a * dt
+
+    def _prefill_budget(self):
+        """This step's chunk-token grant: the measured-headroom budget
+        (``headroom_tps x headroom_mult x decode-only step time``,
+        minus the decode rows sharing the step), clamped to
+        ``[1, prefill_chunk]`` — i.e. spend at most ~``headroom_mult``
+        decode-steps' worth of measured time on the packed buffer, so
+        chunk work throttles itself exactly when chunk-carrying steps
+        run slower than the decode baseline. Before both EWMAs have a
+        measurement — or with ``headroom_mult=None`` — the grant is
+        the fixed cap, i.e. PR-5 pacing; under a SUSTAINED all-chunk
+        regime the decode baseline is the last chunk-free step
+        measured (decode-only steps are its only feed), so a backlog
+        that never leaves the engine a chunk-free step keeps PR-5
+        pacing rather than inventing a baseline. Sub-block grants are
+        not wasted: the scheduler carries them to the next plan
+        (``FIFOScheduler.prefill_plan``)."""
+        cap = self._chunk
+        if self._headroom_mult is None or self._tps_ewma is None \
+                or self._dt_decode_ewma is None:
+            self.stats["headroom"] = cap
+            return cap
+        n_dec = sum(1 for s in self._slots
+                    if s is not None and s.status == "running")
+        afford = int(self._tps_ewma * self._headroom_mult
+                     * self._dt_decode_ewma) - n_dec
+        budget = max(1, min(cap, afford))
+        self.stats["headroom"] = budget
+        return budget
+
+    def _unified_step(self, finished):
+        """ONE device call for everything this step advances: every
+        running slot contributes a span-1 decode row and every planned
+        prefill chunk a span-n row to the packed token buffer of the
+        unified ragged program (``decode.build_ragged_step_fn``). This
+        is the whole point of the unification — a mixed step launches
+        one program where the two-program engine launched a chunk call
+        plus a decode call, and a mid-prefill slot costs its chunk span
+        instead of a discarded full-length decode row. Pure-decode
+        steps still fuse ``choose_num_steps`` ticks (the scan tail of
+        the same program). Returns ``(tokens_processed, had_chunks)``
+        for the headroom EWMAs."""
+        plan = []
+        if self._chunk and self.scheduler.num_prefilling:
+            plan = self.scheduler.prefill_plan(self._prefill_budget(),
+                                               self.cache.block_size,
+                                               cap=self._chunk)
+        active = [s for s in self._slots
+                  if s is not None and s.status == "running"]
+        if not active and not plan:
+            return 0, False
+        n = self.scheduler.choose_num_steps(active) if active else 1
+        R, T = self.num_slots, self._token_budget
+        lens = self.cache.lengths
+        ids = np.zeros(T, np.int32)
+        seg = np.full(T, R, np.int32)       # sentinel: dead packed rows
+        pos = np.zeros(T, np.int32)
+        qstart = np.zeros(R, np.int32)
+        qlen = np.zeros(R, np.int32)
+        kvlen = np.zeros(R, np.int32)
+        dec_mask = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        topks = np.zeros(R, np.int32)
+        keys = np.asarray(self._keys, np.uint32).copy()
+        cursor = 0
+        for slot, s in enumerate(self._slots):
+            if s is None or s.status != "running":
+                continue
+            # append-block on decode growth: the fused ticks write rows
+            # [len, len+n) — the table must cover them pre-call
+            self.cache.ensure_capacity(slot, int(lens[slot]) + n)
+            qstart[slot] = cursor
+            qlen[slot] = 1
+            kvlen[slot] = int(lens[slot]) + 1
+            dec_mask[slot] = 1
+            ids[cursor] = self._last_tok[slot]
+            seg[cursor] = slot
+            pos[cursor] = int(lens[slot])
+            temps[slot] = self._temps[slot]
+            topks[slot] = self._topks[slot]
+            cursor += 1
+        chunk_rows = []                     # (slot, seq, n_tokens, final)
+        for seq, ntok in plan:
+            slot, off = seq.slot, seq.prefilled
+            self.cache.ensure_capacity(slot, off + ntok)
+            final = off + ntok == seq.prompt_len
+            qstart[slot] = cursor
+            qlen[slot] = ntok
+            kvlen[slot] = off + ntok
+            ids[cursor:cursor + ntok] = seq.prompt[off:off + ntok]
+            seg[cursor:cursor + ntok] = slot
+            pos[cursor:cursor + ntok] = np.arange(off, off + ntok,
+                                                  dtype=np.int32)
+            # chunk rows sample (and advance the PRNG) only on their
+            # FINAL chunk — the same rule as the two-program path, so
+            # streams stay byte-identical to a one-shot prefill
+            keys[slot] = np.asarray(seq.key)
+            if final:
+                temps[slot] = float(seq.request.temperature)
+                topks[slot] = int(seq.request.top_k)
+            chunk_rows.append((slot, seq, ntok, final))
+            cursor += ntok
+        npk, npv, toks, keys_t0, keys_fin = self._ragged_fn(n)(
+            self._params, self.cache.pool.k, self.cache.pool.v,
+            jnp.asarray(self.cache.tables), jnp.asarray(ids),
+            jnp.asarray(seg), jnp.asarray(pos), jnp.asarray(qstart),
+            jnp.asarray(qlen), jnp.asarray(kvlen),
+            jnp.asarray(dec_mask), jnp.asarray(keys), jnp.asarray(temps),
+            jnp.asarray(topks))
+        self.cache.update(npk, npv)
+        toks_np = np.asarray(toks)          # [n, R]
+        keys_t0_np = np.asarray(keys_t0)
+        self.stats["unified_steps"] += 1
+        if active:
+            # decode rows adopt the post-scan key walk; chunk/idle rows
+            # keep their host-side key state (a final chunk adopts its
+            # tick-0 key inside _install_seq below)
+            self._keys = jnp.where(
+                jnp.asarray(dec_mask[:, None].astype(bool)),
+                keys_fin, self._keys)
+        # chunk bookkeeping first — mirrors the two-program order where
+        # the chunk call ran before the decode ticks surfaced tokens
+        for slot, seq, ntok, final in chunk_rows:
+            self._advance_chunk(seq, ntok, toks_np[0, slot],
+                                keys_t0_np[slot], finished)
+        if active:
+            self.stats["decode_calls"] += 1
+            self.stats["decode_steps"] += n
+            self.stats["slot_steps"] += n * self.num_slots
+            for i in range(n):
+                for slot in range(self.num_slots):
+                    seq = self._slots[slot]
+                    if seq is None or seq.status != "running" \
+                            or not dec_mask[slot]:
+                        continue  # freed/mid-prefill slot, finished
+                        # mid-chunk, or a span this call did not decode
+                        # (a chunk row installed above starts decoding
+                        # NEXT step); its sampled garbage never surfaces
+                    t = int(toks_np[i, slot])
+                    seq.tokens.append(t)
+                    self.cache.lengths[slot] += 1
+                    self._last_tok[slot] = t
+                    self.stats["active_slot_steps"] += 1
+                    self.stats["tokens_generated"] += 1
+                    self._emit(seq, t)
+                    self._maybe_finish(seq, finished)
+        return cursor + (n - 1) * len(active), bool(chunk_rows)
+
+    def _two_program_step(self, finished):
+        """The PR-5 two-program interleave (``ragged_step=False`` and
+        the dense engine): at most one budgeted chunk call, then one
+        fused decode call. Kept intact as the A/B baseline the unified
+        step is pinned byte-identical against."""
+        plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._chunk,
-                                               self.cache.block_size)
+                                               self.cache.block_size,
+                                               cap=self._chunk)
             if plan:
                 self._run_prefill_chunks(plan, finished)
+        chunk_tokens = sum(c for _, c in plan)
+        n = 0
         active = [s for s in self._slots
                   if s is not None and s.status == "running"]
         if active:
@@ -788,8 +1079,7 @@ class ContinuousBatchingEngine:
                     self.stats["tokens_generated"] += 1
                     self._emit(seq, t)
                     self._maybe_finish(seq, finished)
-        self.stats["steps"] += 1
-        return finished
+        return chunk_tokens + n * len(active), bool(plan)
 
     def has_work(self) -> bool:
         return bool(self.scheduler.num_queued

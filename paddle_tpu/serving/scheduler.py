@@ -43,6 +43,7 @@ class FIFOScheduler:
         self.decode_chunk = max(int(decode_chunk), 1)
         self.queue = deque()
         self.prefilling = deque()   # admitted, mid-chunked-prefill (FIFO)
+        self._plan_carry = 0        # sub-block budget owed to the plan head
 
     def submit(self, seq):
         self.queue.append(seq)
@@ -64,14 +65,20 @@ class FIFOScheduler:
     def leave_prefill(self, seq) -> bool:
         """Drop a sequence from the prefill pipeline (final chunk done,
         cancellation, or deadline expiry). Returns whether it was
-        there."""
+        there. An emptied pipeline clears the plan carry eagerly: the
+        engine stops calling :meth:`prefill_plan` while nothing is
+        prefilling, so without this a sub-block grant banked against a
+        cancelled prompt would leak into a LATER unrelated prompt's
+        first chunk grant."""
         try:
             self.prefilling.remove(seq)
+            if not self.prefilling:
+                self._plan_carry = 0
             return True
         except ValueError:
             return False
 
-    def prefill_plan(self, budget: int, align: int = 1):
+    def prefill_plan(self, budget: int, align: int = 1, cap=None):
         """This step's chunk assignments: ``[(seq, n_tokens), ...]``,
         oldest PREFILLING sequence first, spending at most ``budget``
         prompt tokens total. A sequence's chunk is capped at its
@@ -79,9 +86,23 @@ class FIFOScheduler:
         down to an ``align`` (KV block size) boundary so a partially
         prefilled prompt is always a whole-block prefix plus a host
         resume offset — leftover budget smaller than one block stops
-        the plan rather than splitting a block. Sequences stay queued
-        until :meth:`leave_prefill`; FIFO order is never reshuffled, so
-        a long prompt cannot be starved by later arrivals."""
+        the plan rather than splitting a block. A grant too small to
+        release even one block is not LOST, though: it carries to the
+        next step's plan head (capped at one block), so a throttled
+        per-step budget — e.g. the engine's headroom-adaptive grant
+        under heavy decode load — still accumulates into whole-block
+        progress instead of starving the pipeline behind one misaligned
+        prompt. Sequences stay queued until :meth:`leave_prefill`; FIFO
+        order is never reshuffled, so a long prompt cannot be starved
+        by later arrivals. ``cap`` bounds the carried total: the
+        engine's packed token buffer (and the chunk compile bucket) is
+        sized for at most ``cap`` chunk tokens per step, so a banked
+        carry must never push a full-cap grant past it — the carry only
+        ever matters when the grant is throttled BELOW the cap."""
+        budget = int(budget) + self._plan_carry
+        if cap is not None:
+            budget = min(budget, int(cap))
+        self._plan_carry = 0
         plan = []
         for seq in self.prefilling:
             if budget <= 0:
@@ -94,6 +115,9 @@ class FIFOScheduler:
                     break
             plan.append((seq, n))
             budget -= n
+        if not plan and self.prefilling:
+            # blocked head: bank the sub-block grant for the next step
+            self._plan_carry = min(budget, int(align))
         return plan
 
     def admissions(self, num_free: int, hit_len_fn=None):

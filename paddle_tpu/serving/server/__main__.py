@@ -71,6 +71,17 @@ def main(argv=None):
                     help="chunked prefill: max prompt tokens prefilled "
                          "per engine step (paged engine only; bounds TTFT "
                          "under mixed traffic; 0 disables)")
+    ap.add_argument("--ragged-step", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="unified ragged step (DEFAULT, paged only): "
+                         "decode rows + prefill chunks ride ONE device "
+                         "program per step; --no-ragged-step keeps the "
+                         "two-program chunk+decode interleave")
+    ap.add_argument("--headroom-mult", type=float, default=2.0,
+                    help="adaptive chunk budget: grant ~this many "
+                         "decode-steps' worth of measured throughput to "
+                         "prefill chunks per step (unified step only; "
+                         "0 pins the fixed prefill-chunk cap)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-request access logs")
@@ -85,6 +96,8 @@ def main(argv=None):
         prefix_cache=args.prefix_cache, prefix_blocks=args.prefix_blocks,
         prefix_block_size=args.prefix_block_size,
         paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
+        ragged_step=args.ragged_step,
+        headroom_mult=args.headroom_mult or None,
         log_fn=None if args.quiet else
         (lambda m: print(m, file=sys.stderr)))
     print(json.dumps({"listening": server.url, "preset": args.preset,
@@ -96,6 +109,9 @@ def main(argv=None):
                       # the dense engine ignores it
                       "prefill_chunk":
                       server.gateway.engine.prefill_chunk,
+                      # report what actually runs: the dense engine
+                      # ignores --ragged-step
+                      "ragged_step": server.gateway.engine.ragged_step,
                       "endpoints": ["/v1/completions", "/healthz",
                                     "/metrics"]}), flush=True)
 

@@ -42,7 +42,7 @@ import weakref
 
 import numpy as np
 
-from ...profiler.metrics import TTFT_BUCKETS, MetricsRegistry
+from ...profiler.metrics import STEP_BUCKETS, TTFT_BUCKETS, MetricsRegistry
 
 
 class QueueFullError(RuntimeError):
@@ -244,6 +244,22 @@ class ServingGateway:
                   "with decode; 0 with chunking off or on the dense "
                   "engine).").set_fn(
             lambda: self.engine.stats["prefill_chunks"])
+        # per-step telemetry: the SAME duration/token measurements the
+        # engine's headroom EWMAs (adaptive chunk budget) read — the
+        # driver observes them after every step() it pumps
+        self._m_step_dur = r.histogram(
+            "serving_step_duration_seconds",
+            "Engine step() wall duration (admission + prefill grant + "
+            "decode + retire).", buckets=STEP_BUCKETS)
+        r.gauge("serving_step_tokens",
+                "Tokens the last engine step processed on device "
+                "(decode rows x fused ticks + prefill chunk tokens)."
+                ).set_fn(lambda: self.engine.stats["last_step_tokens"])
+        r.gauge("serving_prefill_headroom_tokens",
+                "Current headroom-adaptive chunk-token grant per step "
+                "(prefill_chunk is the cap; fixed at it until the "
+                "EWMAs have signal or with adaptivity off).").set_fn(
+            lambda: self.engine.stats["headroom"])
         cache = getattr(self.engine, "cache", None)
         if getattr(self.engine, "_paged", False) and cache is not None:
             # paged-attention surface: physical sharing + table pressure
@@ -374,6 +390,8 @@ class ServingGateway:
                 self._apply_cancels()
                 if self.engine.has_work():
                     self.engine.step()
+                    self._m_step_dur.observe(
+                        self.engine.stats["last_step_duration_s"])
                     continue
                 with self._lock:
                     drained = not self._intake and not self._live

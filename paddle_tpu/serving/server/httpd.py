@@ -276,7 +276,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           max_seq_len=None, decode_chunk=1, max_queue=64,
           model_name=None, registry=None, log_fn=None, start=True,
           prefix_cache=False, prefix_blocks=None, prefix_block_size=32,
-          paged_attn=True, prefill_chunk=512):
+          paged_attn=True, prefill_chunk=512, ragged_step=True,
+          headroom_mult=2.0):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -295,7 +296,15 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     so one long prompt can't stall every streaming client — the
     ``serving_ttft_seconds`` histogram and
     ``serving_prefill_chunks_total`` counter on ``/metrics`` watch it
-    (README "Chunked prefill").
+    (README "Chunked prefill"). ``ragged_step=True`` (the default on
+    the paged engine) runs decode rows and prefill chunks through ONE
+    unified ragged program per step, with the per-step chunk grant
+    adapted from the measured throughput EWMA scaled by
+    ``headroom_mult`` (README "Unified ragged attention";
+    ``headroom_mult=None`` pins fixed-cap pacing) — the
+    ``serving_step_duration_seconds`` histogram,
+    ``serving_step_tokens`` and ``serving_prefill_headroom_tokens``
+    gauges on ``/metrics`` watch exactly the signals the budget reads.
     """
     from ..engine import ContinuousBatchingEngine
     engine = ContinuousBatchingEngine(
@@ -303,6 +312,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
         decode_chunk=decode_chunk, prefix_cache=prefix_cache,
         prefix_blocks=prefix_blocks, prefix_block_size=prefix_block_size,
         paged_attn=paged_attn, prefill_chunk=prefill_chunk,
+        ragged_step=ragged_step, headroom_mult=headroom_mult,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     gateway = ServingGateway(engine, max_queue=max_queue, registry=registry)
     server = ServingHTTPServer(

@@ -127,9 +127,13 @@ def _clone(r):
 
 def _mk_engine(model, num_slots, s_max, prefill_chunk):
     from paddle_tpu.serving import ContinuousBatchingEngine
+    # ragged_step=False: THIS leg is the two-program baseline the
+    # banked CHUNKED_BENCH numbers (and bench_ragged's comparison) are
+    # defined on; the unified default must not drift it
     return ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_block_size=BLOCK_SIZE, prefill_chunk=prefill_chunk,
+        ragged_step=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
 

@@ -97,8 +97,12 @@ def _trace(quick=True):
 
 def _mk_engine(model, num_slots, s_max):
     from paddle_tpu.serving import ContinuousBatchingEngine
+    # ragged_step=False pins the two-program step this leg's banked
+    # baselines (DECODE_BENCH.json) were measured on — the unified
+    # ragged default must not silently drift the comparison
     return ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        ragged_step=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
 

@@ -76,10 +76,13 @@ def _clone(r):
 
 def _run(model, reqs, num_slots, s_max, paged):
     from paddle_tpu.serving import ContinuousBatchingEngine
+    # ragged_step=False: PAGED_BENCH's banked dense-vs-paged comparison
+    # was measured on the two-program step; the unified ragged default
+    # must not silently drift the paged leg
     eng = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_cache=True, prefix_block_size=BLOCK_SIZE,
-        paged_attn=paged,
+        paged_attn=paged, ragged_step=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([_clone(r) for r in reqs])

@@ -58,8 +58,11 @@ def _run_direct(model, reqs, num_slots, s_max):
     from dataclasses import replace
 
     from paddle_tpu.serving import ContinuousBatchingEngine
+    # ragged_step=False: the banked SERVE_BENCH baseline is the
+    # two-program engine; gateway overhead must be measured against it
     eng = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        ragged_step=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([replace(r) for r in reqs])

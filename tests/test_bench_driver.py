@@ -76,6 +76,7 @@ class TestBenchDriverFlow:
         assert art["prefix_cache"]["ok"] is False
         assert art["paged_attn"]["ok"] is False
         assert art["chunked_prefill"]["ok"] is False
+        assert art["ragged_step"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -122,6 +123,13 @@ class TestBenchDriverFlow:
                                       "p95_ttft_ratio": 4.4,
                                       "accepted": True,
                                       "tokens_equal": True}), ""
+            if leg == "--ragged":
+                # unified-ragged-step launch leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "ragged_step", "ok": True,
+                                      "launches_saved_per_mixed_step": 1.0,
+                                      "accepted": True,
+                                      "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -156,9 +164,9 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:5] == ["--decode-cb", "--serve-http",
+        assert order[:6] == ["--decode-cb", "--serve-http",
                              "--prefix-cache", "--paged-attn",
-                             "--chunked-prefill"]
+                             "--chunked-prefill", "--ragged"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -167,6 +175,8 @@ class TestBenchDriverFlow:
         assert art["paged_attn"]["copy_dispatches_eliminated"] == 24
         assert art["chunked_prefill"]["accepted"] is True
         assert art["chunked_prefill"]["p95_ttft_ratio"] == 4.4
+        assert art["ragged_step"]["accepted"] is True
+        assert art["ragged_step"]["launches_saved_per_mixed_step"] == 1.0
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

@@ -52,6 +52,11 @@ def _engine(model, **kw):
     kw.setdefault("prefix_block_size", BS)
     kw.setdefault("prefix_cache", False)
     kw.setdefault("prefill_chunk", CHUNK)
+    # fixed-cap chunk pacing: the step-count/offset pins below assume
+    # exactly CHUNK tokens per grant; the headroom-adaptive budget is
+    # wall-clock-fed (nondeterministic on a shared box) and is pinned
+    # separately in test_ragged_step.py with an injected clock
+    kw.setdefault("headroom_mult", None)
     return ContinuousBatchingEngine(model, **kw)
 
 

@@ -176,6 +176,33 @@ class TestHistogram:
             h.observe(0.0008)
         assert h.quantile(0.95) <= 0.001   # resolved, not smeared to 5ms
 
+    def test_step_ladder_strict_parsed_and_resolves_fast_steps(self):
+        """The serving_step_duration_seconds ladder (STEP_BUCKETS) —
+        the same signal the engine's headroom-adaptive chunk budget
+        reads — resolves sub-ms on-chip steps AND tens-of-ms CPU steps,
+        and a histogram on it renders valid under the strict parser."""
+        from paddle_tpu.profiler.metrics import (MetricsRegistry,
+                                                 STEP_BUCKETS)
+        assert STEP_BUCKETS[0] <= 0.0005       # real-chip step floor
+        assert STEP_BUCKETS[-1] >= 10.0        # wedged-step ceiling
+        assert list(STEP_BUCKETS) == sorted(STEP_BUCKETS)
+        r = MetricsRegistry()
+        h = r.histogram("serving_step_duration_seconds",
+                        "Engine step() wall duration.",
+                        buckets=STEP_BUCKETS)
+        for v in (0.0003, 0.02, 0.02, 1.5):
+            h.observe(v)
+        fams = parse_prometheus(r.render())
+        name = "serving_step_duration_seconds"
+        assert fams[name]["type"] == "histogram"
+        assert fams[name]["samples"][(name + "_count", ())] == 4
+        bounds = {lbl[1] for key, lbls in fams[name]["samples"]
+                  if key == name + "_bucket" for lbl in lbls
+                  if lbl[0] == "le"}
+        assert len(bounds) == len(STEP_BUCKETS) + 1   # ladder + +Inf
+        # CPU steps land mid-ladder, not smeared into +Inf
+        assert h.quantile(0.5) <= 0.025
+
     def test_empty_buckets_rejected(self):
         with pytest.raises(ValueError, match="at least one bucket"):
             Histogram("x", buckets=())

@@ -118,6 +118,10 @@ class TestTransparency:
         assert eng.cache.pool.num_blocks == 2 * (64 // BS)  # live grid
         assert eng.cache.pool.num_used == 0  # all returned at retirement
 
+    @pytest.mark.slow  # eviction-pressure duplicate: the unified
+    # engine's matrix pins evictions + byte-identical streams on the
+    # default path (test_ragged_step) and the dense eviction-equality
+    # rep stays default in test_prefix_cache
     def test_eviction_pressure_keeps_streams_exact(self, model):
         """A trie budget far smaller than the working set: evictions
         fire, live sequences always win the pool (evict-on-demand), and
@@ -306,6 +310,11 @@ class TestOwnershipDiscipline:
 
 
 class TestCompileDiscipline:
+    @pytest.mark.slow  # compile-discipline duplicate: the unified
+    # engine's hit/miss/eviction/cancel matrix (test_ragged_step),
+    # chunked closed-compile-set (test_chunked_prefill) and the
+    # engine-level request-mix closure (test_serving) stay the default
+    # reps of the same decode_compilations()==1 chain
     def test_mixed_traffic_keeps_decode_at_one(self, model):
         """Waves of hits/misses/divergence leave decode_compilations()
         at 1 and the prefill/suffix compile set closed over the pow2

@@ -1,0 +1,234 @@
+"""Unified ragged prefill+decode paged attention kernel parity
+(kernels/pallas_ragged_attention.py, "Ragged Paged Attention",
+PAPERS.md): a PACKED buffer of variable-length query spans — decode
+rows (span 1) and prefill chunks (span n) — attends causally through
+per-sequence block tables in ONE kernel invocation. Interpret-mode
+oracle suite mirroring test_pallas_paged_decode.py, plus the properties
+the unification itself must pin:
+
+- the jnp oracle equals an independently-built dense causal reference
+  over the gathered (scrambled-table) view, span by span — BITWISE,
+  because the oracle deliberately replays the old suffix-prefill
+  program's op sequence;
+- a span-1 row is BITWISE the old single-query paged decode kernel
+  (pallas vs pallas, reference vs reference) — the unified serving step
+  cannot perturb decode numerics;
+- sentinel tables / dead rows / packed padding stay finite and come
+  back as exact zeros.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.pallas_paged_decode import (
+    paged_decode_attention_pallas, paged_decode_attention_reference)
+from paddle_tpu.kernels.pallas_ragged_attention import (
+    ragged_attention_reference, ragged_paged_attention_pallas)
+
+NEG_INF = -1e30
+
+
+def _mk(R, spans, H, Hkv, D, mb, bs, seed=0, dtype=jnp.float32, T=None):
+    """Pool + scrambled tables + packed spans. ``spans``: per-sequence
+    (qlen, kvlen); qlen=0 rows are dead. Returns the kernel's full
+    argument tuple; T pads the packed buffer past the spans (dead
+    packed rows)."""
+    r = np.random.RandomState(seed)
+    num_blocks = R * mb + 2
+    pool_k = jnp.asarray(r.randn(num_blocks, bs, Hkv, D), dtype)
+    pool_v = jnp.asarray(r.randn(num_blocks, bs, Hkv, D), dtype)
+    perm = r.permutation(R * mb)
+    tables = np.asarray(perm.reshape(R, mb), np.int32)
+    qstart = np.zeros(R, np.int32)
+    qlen = np.zeros(R, np.int32)
+    kvlen = np.zeros(R, np.int32)
+    cur = 0
+    for i, (ql, kl) in enumerate(spans):
+        qstart[i], qlen[i], kvlen[i] = cur, ql, kl
+        cur += ql
+    T = T or cur
+    q = jnp.asarray(r.randn(T, H, D), dtype)
+    return (q, pool_k, pool_v, jnp.asarray(tables), jnp.asarray(qstart),
+            jnp.asarray(qlen), jnp.asarray(kvlen))
+
+
+def _dense_span_oracle(q, pool_k, pool_v, tables, qstart, qlen, kvlen):
+    """Independent oracle: per sequence, gather its logical cache dense,
+    then plain masked softmax attention for its span — the exact math
+    the old suffix-prefill program ran in-program. Built with the same
+    op sequence so the comparison against the ragged oracle is
+    BITWISE."""
+    T, H, D = q.shape
+    nb, bs, Hkv, _ = np.asarray(pool_k).shape
+    R, mb = np.asarray(tables).shape
+    G = H // Hkv
+    s_tot = mb * bs
+    out = np.zeros((T, H, D), np.asarray(q).dtype)
+    for rr in range(R):
+        ql, kl, qs = int(qlen[rr]), int(kvlen[rr]), int(qstart[rr])
+        if ql == 0:
+            continue
+        tbl = np.minimum(np.asarray(tables)[rr], nb - 1)
+        k = jnp.asarray(np.asarray(pool_k)[tbl].reshape(s_tot, Hkv, D))
+        v = jnp.asarray(np.asarray(pool_v)[tbl].reshape(s_tot, Hkv, D))
+        kf = (jnp.repeat(k, G, axis=1) if G > 1 else k)[None]
+        vf = (jnp.repeat(v, G, axis=1) if G > 1 else v)[None]
+        qs_span = q[None, qs:qs + ql]                 # [1, ql, H, D]
+        # the suffix-prefill program's exact op sequence, batch of one
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qs_span, kf,
+                            preferred_element_type=jnp.float32)
+        logits = logits * (1.0 / np.sqrt(D))
+        pos = kl - ql + np.arange(ql)
+        mask = jnp.asarray(np.arange(s_tot)[None, :] <= pos[:, None])
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(mask[None, None], probs, 0.0)
+        rv = jnp.asarray(np.arange(s_tot) < kl)
+        vf = jnp.where(rv[None, :, None, None], vf, 0.0)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vf)
+        out[qs:qs + ql] = np.asarray(o[0])
+    return out
+
+
+MIXED = [(1, 40), (5, 37), (1, 3), (16, 16), (0, 0), (9, 64)]
+
+
+class TestRaggedKernelParity:
+    @pytest.mark.parametrize("H,Hkv,D,mb,bs", [
+        (8, 2, 64, 4, 32),        # GQA group 4
+        (8, 1, 64, 3, 16),        # MQA, small blocks
+        (4, 4, 64, 4, 16),        # MHA
+    ])
+    def test_matches_reference_mixed_spans(self, H, Hkv, D, mb, bs):
+        """Decode rows, multi-token chunks (1..block and beyond), a
+        dead row — one invocation, all spans match the oracle."""
+        spans = [(1, mb * bs), (min(5, bs), 12), (0, 0), (bs, bs),
+                 (3, 2 * bs + 3), (1, 1)]
+        args = _mk(len(spans), spans, H, Hkv, D, mb, bs, seed=H + bs)
+        got = ragged_paged_attention_pallas(*args)
+        want = ragged_attention_reference(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_reference_bitwise_vs_two_program_split(self):
+        """The acceptance pin, per old-program responsibility: in the
+        two-program engine, span-1 rows were the DECODE program's and
+        span-n rows the suffix-prefill program's. The unified oracle
+        reproduces each one's output BITWISE on the same inputs —
+        multi-token spans against an independently-assembled replay of
+        the suffix program's op sequence, span-1 rows against the
+        paged-decode reference (scrambled physical placement
+        included)."""
+        args = _mk(len(MIXED), MIXED, 8, 4, 16, 4, 16, seed=7)
+        q, pk, pv, tbl, qs, ql, kl = args
+        got = np.asarray(ragged_attention_reference(*args))
+        want = _dense_span_oracle(*args)
+        multi = np.concatenate(
+            [np.arange(int(s), int(s) + int(n))
+             for s, n, in zip(np.asarray(qs), np.asarray(ql))
+             if int(n) > 1])
+        assert (got[multi] == want[multi]).all()
+        ones = [i for i, n in enumerate(np.asarray(ql)) if int(n) == 1]
+        dec = np.asarray(paged_decode_attention_reference(
+            q[np.asarray(qs)[ones]], pk, pv,
+            jnp.asarray(np.asarray(tbl)[ones]),
+            jnp.asarray(np.asarray(kl)[ones])))
+        assert (got[np.asarray(qs)[ones]] == dec).all()
+
+    def test_span1_bitwise_vs_paged_decode_kernel(self):
+        """A span-1 row IS the old single-query kernel's row: same
+        block walk, same online-softmax accumulation — pallas vs pallas
+        and reference vs reference are both bitwise."""
+        spans = [(1, 40), (1, 7), (1, 64)]
+        q, pk, pv, tbl, qs, ql, kl = _mk(3, spans, 8, 2, 64, 4, 16,
+                                         seed=3)
+        got_k = np.asarray(ragged_paged_attention_pallas(
+            q, pk, pv, tbl, qs, ql, kl))
+        got_r = np.asarray(ragged_attention_reference(
+            q, pk, pv, tbl, qs, ql, kl))
+        # the packed buffer in span order == one query per sequence
+        old_k = np.asarray(paged_decode_attention_pallas(
+            q, pk, pv, tbl, kl))
+        old_r = np.asarray(paged_decode_attention_reference(
+            q, pk, pv, tbl, kl))
+        assert (got_k == old_k).all()
+        assert (got_r == old_r).all()
+
+    def test_sentinel_dead_rows_and_padding_zero_and_finite(self):
+        """Sentinel table tails clamp harmlessly; a dead row (qlen 0)
+        and packed rows past every span come back as EXACT zeros from
+        kernel and oracle alike — the engine's padded token buffer
+        must never leak NaN into the residual stream."""
+        spans = [(1, 20), (4, 17), (0, 0)]
+        q, pk, pv, tbl, qs, ql, kl = _mk(3, spans, 8, 4, 16, 4, 8,
+                                         seed=11, T=12)
+        tbl = np.asarray(tbl).copy()
+        nb = pk.shape[0]
+        tbl[1, 3:] = nb                   # unmapped tail -> sentinel
+        tbl[2, :] = nb                    # dead row: all-sentinel
+        tbl = jnp.asarray(tbl)
+        got = np.asarray(ragged_paged_attention_pallas(
+            q, pk, pv, tbl, qs, ql, kl))
+        ref = np.asarray(ragged_attention_reference(
+            q, pk, pv, tbl, qs, ql, kl))
+        assert np.isfinite(got).all() and np.isfinite(ref).all()
+        assert (got[5:] == 0).all()       # rows past the spans
+        assert (ref[5:] == 0).all()
+        np.testing.assert_allclose(got[:5], ref[:5], rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_bf16_io(self):
+        spans = [(1, 30), (6, 22), (2, 8)]
+        args = _mk(3, spans, 8, 8, 128, 2, 16, seed=13,
+                   dtype=jnp.bfloat16)
+        got = ragged_paged_attention_pallas(*args)
+        want = ragged_attention_reference(*args)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+    def test_jit_and_scan_composable(self):
+        """Must trace under jit inside a lax.scan over layers — the
+        exact shape of the unified serving step's layer loop (per-layer
+        pool slices, one shared table + span metadata)."""
+        R, H, Hkv, D, mb, bs, L = 2, 4, 2, 64, 4, 16, 3
+        r = np.random.RandomState(5)
+        T = 6
+        q = jnp.asarray(r.randn(L, T, H, D), jnp.float32)
+        num_blocks = R * mb
+        pk = jnp.asarray(r.randn(L, num_blocks, bs, Hkv, D), jnp.float32)
+        pv = jnp.asarray(r.randn(L, num_blocks, bs, Hkv, D), jnp.float32)
+        tbl = jnp.asarray(
+            r.permutation(num_blocks).reshape(R, mb), jnp.int32)
+        qs = jnp.asarray([0, 1], jnp.int32)
+        ql = jnp.asarray([1, 5], jnp.int32)
+        kl = jnp.asarray([40, 37], jnp.int32)
+
+        @jax.jit
+        def run(q, pk, pv):
+            def body(carry, xs):
+                qq, kk, vv = xs
+                return carry + 1, ragged_paged_attention_pallas(
+                    qq, kk, vv, tbl, qs, ql, kl)
+            _, outs = jax.lax.scan(body, 0, (q, pk, pv))
+            return outs
+
+        outs = np.asarray(run(q, pk, pv))
+        for layer in range(L):
+            want = np.asarray(ragged_attention_reference(
+                q[layer], pk[layer], pv[layer], tbl, qs, ql, kl))
+            np.testing.assert_allclose(outs[layer], want, rtol=2e-5,
+                                       atol=2e-5)
+
+    def test_query_block_tiling_invariant(self):
+        """Packed buffers larger than one query block (the kernel's
+        block_q grid dim) still match — spans crossing a query-block
+        boundary are handled by the masked read-modify-write."""
+        spans = [(1, 33), (40, 40), (1, 60), (25, 26)]
+        args = _mk(4, spans, 8, 2, 64, 4, 16, seed=17)
+        got = ragged_paged_attention_pallas(*args, block_q=64)
+        want = ragged_attention_reference(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

@@ -46,6 +46,9 @@ class TestEngineBasics:
              for i in range(2)])
         np.testing.assert_array_equal(np.stack(outs), want)
 
+    @pytest.mark.slow  # slot-recycling duplicate (bigger traffic of
+    # the same property): test_slot_reuse_after_finish and the
+    # scheduler unit tests stay the default reps
     def test_queue_longer_than_slots(self, model):
         """5 requests through 2 slots: all finish, all correct."""
         reqs = [GenerationRequest(prompt=_prompt(i), max_new_tokens=4)
@@ -185,18 +188,22 @@ class TestCompileOnce:
                               temperature=0.4, top_k=0, seed=9)])
         assert eng.decode_compilations() == 1
 
+    @pytest.mark.slow  # model.generate compile-reuse duplicate:
+    # test_generate's jit-cache-reused + engine≡model.generate
+    # (test_greedy_matches_model_generate) and the engine-level
+    # request-mix closure stay the default reps
     def test_model_generate_shares_decode_program(self, model):
         """model.generate() rides the same compile-once contract when the
         cache length is pinned: sampling-knob changes add no traces.
         (model.generate inherits the paged engine default, so the
-        programs counted are the "pdecode" kind.)"""
+        programs counted are the unified "ragged" kind.)"""
         t = paddle.to_tensor(np.stack([_prompt(17)]))
         m = model
 
         def decode_traces():
             return sum(fn._cache_size()
                        for key, fn in m._serving_jit.items()
-                       if key[0] == "pdecode")
+                       if key[0] == "ragged")
 
         before = decode_traces()  # other tests share this model's cache
         m.generate(t, max_new_tokens=6, max_cache_len=32)
