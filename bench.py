@@ -495,6 +495,15 @@ def watchdog():
     rg = _parse_result(rc, out)
     cb_extra["ragged_step"] = rg if rg is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Chaos leg: availability under the deterministic fault plan
+    # (scripts/bench_chaos.py) — requests lost (must be 0), recovery
+    # latency, preemption counts. Same hang-proof contract: CPU-forced
+    # replay, banked before the tunnel can wedge anything.
+    rc, out, err = _run([me, "--chaos"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    ch = _parse_result(rc, out)
+    cb_extra["chaos"] = ch if ch is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -656,6 +665,13 @@ if __name__ == "__main__":
         from bench_ragged import measure_ragged_step
         print(json.dumps({"name": "ragged_step", "ok": True,
                           **measure_ragged_step(quick=True)}))
+        sys.exit(0)
+    if "--chaos" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_chaos import measure_chaos
+        print(json.dumps({"name": "chaos", "ok": True,
+                          **measure_chaos(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -12,7 +12,7 @@ Public surface:
 
 - :class:`GenerationRequest` / :class:`Sequence` — request & in-flight
   state (per-request deadlines via ``timeout_s``; ``finish_reason`` ∈
-  :data:`FINISH_REASONS` = stop|length|cancelled|timeout)
+  :data:`FINISH_REASONS` = stop|length|cancelled|timeout|error)
 - :class:`GenerationResult` — array-like generate() output + finish_reason
 - :class:`SlotKVCache` — the dense per-slot KV cache (legacy
   compatibility shim, ``paged_attn=False``)
@@ -33,12 +33,23 @@ Public surface:
   prompt token blocks with LRU eviction (README "Automatic prefix
   caching")
 
+Fault tolerance (README "Fault tolerance & chaos testing"):
+:class:`PoolExhausted` is the typed KV-pool-pressure signal the engine
+repairs by preempting the youngest sequence (recompute, donated chain);
+``engine.restore()`` re-enqueues a live sequence after a crash so the
+supervised gateway driver can rebuild and continue streams
+byte-identically; :mod:`.faults` is the deterministic fault-injection
+harness (:class:`FaultPlan` / :class:`VirtualClock`) the chaos tests
+and ``scripts/bench_chaos.py`` drive.
+
 The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
 (imported lazily — the engine has no HTTP dependency).
 """
 from .block_manager import BlockManager
 from .engine import ContinuousBatchingEngine
-from .kv_cache import PagedKVCache, SlotKVCache
+from .faults import (FatalFault, FaultError, FaultPlan, TransientFault,
+                     VirtualClock)
+from .kv_cache import PagedKVCache, PoolExhausted, SlotKVCache
 from .prefix_cache import PrefixCache
 from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
                       Sequence)
@@ -46,6 +57,8 @@ from .scheduler import FIFOScheduler
 
 __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
-    "Sequence", "SlotKVCache", "PagedKVCache", "FIFOScheduler",
-    "FINISH_REASONS", "BlockManager", "PrefixCache",
+    "Sequence", "SlotKVCache", "PagedKVCache", "PoolExhausted",
+    "FIFOScheduler", "FINISH_REASONS", "BlockManager", "PrefixCache",
+    "FaultPlan", "FaultError", "TransientFault", "FatalFault",
+    "VirtualClock",
 ]

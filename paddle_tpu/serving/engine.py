@@ -36,7 +36,7 @@ import numpy as np
 from .decode import build_decode_steps_fn, build_paged_decode_steps_fn, \
     build_paged_suffix_prefill_fn, build_prefill_fn, build_ragged_step_fn, \
     build_suffix_prefill_fn, llama_decode_params
-from .kv_cache import PagedKVCache, SlotKVCache
+from .kv_cache import PagedKVCache, PoolExhausted, SlotKVCache
 from .request import GenerationRequest, GenerationResult, Sequence
 from .scheduler import FIFOScheduler
 
@@ -289,7 +289,13 @@ class ContinuousBatchingEngine:
                       "unified_steps": 0,
                       "headroom": self._chunk or 0, "headroom_tps": 0.0,
                       "last_step_duration_s": 0.0, "last_step_tokens": 0,
-                      "tokens_generated": 0, "cancelled": 0, "timeouts": 0}
+                      "tokens_generated": 0, "cancelled": 0, "timeouts": 0,
+                      "preemptions": 0, "restores": 0}
+        # fault-injection hook (serving/faults.py): called with the
+        # engine at the top of every step attempt; None in production.
+        # Whatever it raises propagates to the driver — except
+        # PoolExhausted, which the step loop repairs by preemption.
+        self.fault_hook = None
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
         # moment the host sees it; on_finish(seq) fires exactly once per
@@ -461,7 +467,7 @@ class ContinuousBatchingEngine:
         matched chain immediately — before any admission this step can
         publish-and-evict — and stores it on the sequence for
         _admit_group to install. Returns the covered token count."""
-        matched = self.prefix_cache.lookup(seq.prompt)
+        matched = self.prefix_cache.lookup(seq.work)
         if matched:
             self.prefix_cache.acquire(matched)
             seq.prefix_nodes = matched
@@ -496,7 +502,7 @@ class ContinuousBatchingEngine:
             # chain could be reaped and its block re-used before
             # _admit_hits copies from it
             covered = seq.prefix_hit_tokens   # set at scheduler pop time
-            if self._chunk and seq.prompt_len - covered > self._chunk:
+            if self._chunk and seq.work_len - covered > self._chunk:
                 self._enter_chunked_prefill(seq, covered)
             elif seq.prefix_nodes:
                 hits.append((seq, seq.prefix_nodes))
@@ -526,7 +532,7 @@ class ContinuousBatchingEngine:
     def _admit_cold(self, seqs, finished):
         by_bucket = {}
         for seq in seqs:
-            by_bucket.setdefault(self._bucket(seq.prompt_len), []).append(seq)
+            by_bucket.setdefault(self._bucket(seq.work_len), []).append(seq)
         for s_pad, group in sorted(by_bucket.items()):
             G = len(group)
             Gp = 1 << (G - 1).bit_length()
@@ -536,8 +542,8 @@ class ContinuousBatchingEngine:
             topks = np.zeros(Gp, np.int32)
             keys = np.zeros((Gp, 2), np.uint32)
             for i, seq in enumerate(group):
-                ids[i, :seq.prompt_len] = seq.prompt
-                lens[i] = seq.prompt_len
+                ids[i, :seq.work_len] = seq.work
+                lens[i] = seq.work_len
                 temps[i] = float(seq.request.temperature)
                 topks[i] = int(seq.request.top_k)
                 keys[i] = np.asarray(seq.key)
@@ -547,10 +553,13 @@ class ContinuousBatchingEngine:
             tok0s = np.asarray(tok0s)
             for i, seq in enumerate(group):
                 slot = self.cache.alloc()
+                seq.slot = slot   # before the write: a PoolExhausted
+                # raised inside write_prefill's block growth must leave
+                # the claimed slot findable for _abort_admission
                 self.cache.write_prefill(slot, pk[:, i], pv[:, i],
-                                         seq.prompt_len)
+                                         seq.work_len)
                 self._install_seq(seq, slot, tok0s[i], keys2[i],
-                                  seq.prompt_len, finished)
+                                  seq.work_len, finished)
 
     def _admit_hits(self, hits, finished):
         """Admit prefix-cache hits, then ONE suffix-prefill device call
@@ -573,7 +582,7 @@ class ContinuousBatchingEngine:
         bs = pc.block_size
         by_bucket = {}
         for seq, matched in hits:
-            suffix_len = seq.prompt_len - len(matched) * bs
+            suffix_len = seq.work_len - len(matched) * bs
             by_bucket.setdefault(self._bucket(suffix_len),
                                  []).append((seq, matched))
         for s_pad, group in sorted(by_bucket.items()):
@@ -587,20 +596,20 @@ class ContinuousBatchingEngine:
                 if self._paged:
                     self.cache.install_prefix(
                         slot, [node.block_id for node in matched])
-                    self.cache.ensure_capacity(slot, seq.prompt_len)
+                    self.cache.ensure_capacity(slot, seq.work_len)
                 else:
                     for j, node in enumerate(matched):
                         self.cache.copy_block_in(slot, j * bs, pc.pool,
                                                  node.block_id)
                         self.stats["prefill_copy_dispatches"] += 1
-                rows.append((seq, covered, seq.prompt_len - covered, True))
+                rows.append((seq, covered, seq.work_len - covered, True))
             tok0s, keys2 = self._suffix_call(s_pad, rows)
             for i, (seq, matched) in enumerate(group):
                 slot = seq.slot
-                self.cache.lengths[slot] = seq.prompt_len
+                self.cache.lengths[slot] = seq.work_len
                 self.stats["prefill_tokens_saved"] += seq.prefix_hit_tokens
                 self._install_seq(seq, slot, tok0s[i], keys2[i],
-                                  seq.prompt_len - seq.prefix_hit_tokens,
+                                  seq.work_len - seq.prefix_hit_tokens,
                                   finished)
 
     def _suffix_call(self, s_pad, rows):
@@ -634,7 +643,7 @@ class ContinuousBatchingEngine:
         for i, (seq, off, n, live) in enumerate(rows):
             addr[i] = self.cache.tables[seq.slot] if self._paged \
                 else seq.slot
-            ids[i, :n] = seq.prompt[off:off + n]
+            ids[i, :n] = seq.work[off:off + n]
             suf_lens[i] = n
             prefix_lens[i] = off
             keys[i] = np.asarray(seq.key)
@@ -673,8 +682,8 @@ class ContinuousBatchingEngine:
             for seq, n in group:
                 off = seq.prefilled
                 self.cache.ensure_capacity(seq.slot, off + n)
-                # final chunk (completes the prompt): sampling is live
-                rows.append((seq, off, n, off + n == seq.prompt_len))
+                # final chunk (completes the work content): sampling live
+                rows.append((seq, off, n, off + n == seq.work_len))
             tok0s, keys2 = self._suffix_call(s_pad, rows)
             for i, (seq, n) in enumerate(group):
                 self._advance_chunk(seq, n, tok0s[i], keys2[i], finished)
@@ -691,11 +700,11 @@ class ContinuousBatchingEngine:
         self.stats["chunk_tokens"] += n
         self.cache.lengths[slot] = end
         seq.prefilled = end
-        if end == seq.prompt_len:           # prompt complete
+        if end == seq.work_len:             # work content complete
             self.scheduler.leave_prefill(seq)
             self.stats["prefill_tokens_saved"] += seq.prefix_hit_tokens
             self._install_seq(seq, slot, tok0, key0,
-                              seq.prompt_len - seq.prefix_hit_tokens,
+                              seq.work_len - seq.prefix_hit_tokens,
                               finished)
 
     def _install_seq(self, seq, slot, tok0, key2, prefilled_tokens,
@@ -704,18 +713,32 @@ class ContinuousBatchingEngine:
         admission paths — the ONE place a future per-slot knob gets
         wired, so the two paths cannot silently diverge.
         ``prefilled_tokens`` is the device prefill work actually done
-        (full prompt cold, uncovered suffix on a hit)."""
+        (full prompt cold, uncovered suffix on a hit).
+
+        A RESTORED sequence (``restore_point > 0``, recovery-by-
+        recompute after a crash or preemption) takes the same slot
+        bookkeeping but adopts no sampled output: its next decode input
+        is the last token it already streamed (for a greedy request the
+        prefill's argmax reproduces it anyway — the logits at the end of
+        ``work`` are the logits that sampled it originally) and its PRNG
+        walk resumes from the key snapshot taken when it was displaced,
+        so the continuation is byte-identical and no consumer ever sees
+        a replayed token."""
         req = seq.request
         seq.slot = slot
         seq.status = "running"
-        seq.tokens = [int(tok0)]
         self._slots[slot] = seq
-        self._last_tok[slot] = seq.tokens[0]
         self._temps[slot] = float(req.temperature)
         self._topks[slot] = int(req.top_k)
-        self._keys = self._keys.at[slot].set(key2)
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += int(prefilled_tokens)
+        if seq.restore_point:
+            self._last_tok[slot] = int(seq.tokens[-1])
+            self._keys = self._keys.at[slot].set(jnp.asarray(seq.key))
+            return
+        seq.tokens = [int(tok0)]
+        self._last_tok[slot] = seq.tokens[0]
+        self._keys = self._keys.at[slot].set(key2)
         self.stats["tokens_generated"] += 1
         self._emit(seq, seq.tokens[0])
         self._maybe_finish(seq, finished)
@@ -745,38 +768,43 @@ class ContinuousBatchingEngine:
             self._temps[slot] = 0.0
             self._topks[slot] = 0
             self._last_tok[slot] = 0
-            # publish BEFORE freeing: the slot's prompt rows/blocks are
-            # intact (decode only ever appended past them) and the
-            # sequence's own pins still shield its matched chain from
-            # eviction during the publish walk
-            if self.prefix_cache is not None and self._paged:
-                # paged publish DONATES the slot's full blocks to the
-                # trie (ownership handoff, zero copies); free() then
-                # drops only the undonated private tail. The donation
-                # range is every row actually written — prompt AND
-                # generated tokens (a multi-turn resubmission of this
-                # sequence's assistant text hits these blocks), capped
-                # at the written row count: the last sampled token's KV
-                # is never in the cache (it would be appended by the
-                # decode tick that never ran), and a mid-prefill cancel
-                # has only ``prefilled`` valid rows
-                written = int(self.cache.lengths[slot])
-                content = seq.prompt if not seq.tokens else np.concatenate(
-                    [seq.prompt, np.asarray(seq.tokens, np.int32)])
-                donated = self.prefix_cache.publish_donate(
-                    content[:written], self.cache.slot_block_ids(slot))
-                self.cache.free(slot, keep=donated)
-            elif self.prefix_cache is not None:
-                self.prefix_cache.publish(seq.prompt, slot, self.cache)
-                self.cache.free(slot)
-            else:
-                self.cache.free(slot)
+            self._donate_and_free(seq, slot)
         if self.prefix_cache is not None and seq.prefix_nodes:
             self.prefix_cache.release(seq.prefix_nodes)
             seq.prefix_nodes = []
         finished.append(seq)
         if self.on_finish is not None:
             self.on_finish(seq)
+
+    def _donate_and_free(self, seq, slot):
+        """Slot teardown shared by retirement (:meth:`_finish`) and
+        preemption (:meth:`_preempt`) — the ONE place the
+        donate-vs-free ownership handoff lives, so the two paths cannot
+        silently diverge. Publish BEFORE freeing: the slot's prompt
+        rows/blocks are intact (decode only ever appended past them)
+        and the sequence's own pins still shield its matched chain from
+        eviction during the publish walk.
+
+        Paged + trie: DONATE the slot's full blocks (ownership handoff,
+        zero copies); ``free`` then drops only the undonated private
+        tail. The donation range is every row actually written — prompt
+        AND generated tokens (a multi-turn resubmission of this
+        sequence's assistant text hits these blocks), capped at the
+        written row count: the last sampled token's KV is never in the
+        cache (it would be appended by the decode tick that never ran),
+        and a mid-prefill teardown has only ``prefilled`` valid rows."""
+        if self.prefix_cache is not None and self._paged:
+            written = int(self.cache.lengths[slot])
+            content = seq.prompt if not seq.tokens else np.concatenate(
+                [seq.prompt, np.asarray(seq.tokens, np.int32)])
+            donated = self.prefix_cache.publish_donate(
+                content[:written], self.cache.slot_block_ids(slot))
+            self.cache.free(slot, keep=donated)
+        elif self.prefix_cache is not None:
+            self.prefix_cache.publish(seq.prompt, slot, self.cache)
+            self.cache.free(slot)
+        else:
+            self.cache.free(slot)
 
     def _expire_deadlines(self, seqs, finished):
         """Retire every sequence whose deadline has passed. Runs once at
@@ -806,7 +834,19 @@ class ContinuousBatchingEngine:
         deadline expiries included — queue-side timeouts come back with
         ``slot=None`` and no tokens. Only :meth:`cancel` retires
         outside a step; those surface through ``on_finish`` / the
-        Sequence handle alone."""
+        Sequence handle alone.
+
+        Fault repair: a :class:`~.kv_cache.PoolExhausted` raised
+        anywhere in the step body (block growth on a mis-sized shared
+        pool, or injected by a fault plan) is caught HERE — any
+        admission left half-done is unwound back to the queue, the
+        YOUNGEST slot-holding sequence is preempted by recompute
+        (:meth:`_preempt`: its chain donates to the prefix trie, so the
+        re-queued prefill is usually a zero-copy trie hit), and the
+        step retries without re-admitting. Exhaustion that no
+        preemption can repair re-raises. Anything the injected
+        ``fault_hook`` raises other than PoolExhausted propagates to
+        the driver (the gateway's supervisor)."""
         t0 = self._clock()
         finished = []
         # deadline sweep BEFORE admission: an expired queued request
@@ -815,19 +855,140 @@ class ContinuousBatchingEngine:
         self._expire_deadlines(
             list(self.scheduler.queue)
             + [s for s in self._slots if s is not None], finished)
-        admitted = self.scheduler.admissions(
-            self.cache.num_free,
-            hit_len_fn=self._admission_hit_len
-            if self.prefix_cache is not None else None)
-        if admitted:
-            self._admit_group(admitted, finished)
-        if self._ragged:
-            step_tokens, had_chunks = self._unified_step(finished)
-        else:
-            step_tokens, had_chunks = self._two_program_step(finished)
+        step_tokens, had_chunks = 0, False
+        admitted = []
+        for attempt in range(self.num_slots + 2):
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(self)
+                if attempt == 0:
+                    admitted = self.scheduler.admissions(
+                        self.cache.num_free,
+                        hit_len_fn=self._admission_hit_len
+                        if self.prefix_cache is not None else None)
+                    if admitted:
+                        self._admit_group(admitted, finished)
+                if self._ragged:
+                    step_tokens, had_chunks = self._unified_step(finished)
+                else:
+                    step_tokens, had_chunks = self._two_program_step(
+                        finished)
+                break
+            except PoolExhausted:
+                # unwind, preempt, retry — no device work was committed
+                # for the failed attempt (every raise site runs before
+                # its device call), so host bookkeeping is consistent
+                self._abort_admission(admitted)
+                admitted = []
+                if not self._preempt_youngest():
+                    raise
+            except BaseException:
+                # ANY other failure escaping mid-admission (a real
+                # device/runtime error — the crash class the supervisor
+                # rebuilds for) must not strand popped-but-uninstalled
+                # sequences in limbo: back to the queue they go, where
+                # crash recovery's snapshot can see them
+                self._abort_admission(admitted)
+                raise
         self.stats["steps"] += 1
         self._record_step(self._clock() - t0, step_tokens, had_chunks)
         return finished
+
+    # ----------------------------------------------------- fault recovery
+    def _abort_admission(self, seqs):
+        """Unwind a half-done admission after a step-body failure:
+        every popped sequence not yet installed goes back to the queue
+        HEAD in its original FIFO order (by ``queue_tick`` — the batch
+        itself arrives suffix-sorted, so arrival order must come from
+        the stamp), its claimed slot freed (partial block growth
+        included — ``free`` drops exactly the owned tail) and its
+        prefix pins released, so ``num_free`` and the pool refcounts
+        are exactly what they were before the attempt."""
+        for seq in sorted(seqs, key=lambda s: -s.queue_tick):
+            if seq.status != "queued":
+                continue      # installed (running/prefilling) — keep
+            if self.prefix_cache is not None and seq.prefix_nodes:
+                self.prefix_cache.release(seq.prefix_nodes)
+                seq.prefix_nodes = []
+            seq.prefix_hit_tokens = 0
+            if seq.slot is not None:
+                if self._slots[seq.slot] is None:
+                    self.cache.free(seq.slot)
+                seq.slot = None
+            self.scheduler.requeue_front(seq)
+
+    def _preempt_youngest(self) -> bool:
+        """PoolExhausted repair: displace the YOUNGEST slot-holding
+        sequence (latest arrival — the one with the least sunk work and
+        the least head-of-line seniority). Returns False when no slot
+        holds a preemptible sequence."""
+        victims = [s for s in self._slots if s is not None and not s.done]
+        if not victims:
+            return False
+        self._preempt(max(victims, key=lambda s: s.request_id))
+        return True
+
+    def _preempt(self, seq):
+        """Preemption-by-recompute: free the sequence's slot NOW —
+        donating its written chain (prompt + generated blocks) to the
+        prefix trie when one is on, exactly like retirement — and
+        re-queue it via :meth:`restore`. Because the chain was just
+        donated, the recompute prefill is typically a zero-copy trie
+        hit; the PRNG walk snapshot keeps the continuation
+        byte-identical. Nothing is emitted and the sequence does not
+        finish — consumers just see a pause."""
+        self.stats["preemptions"] += 1
+        slot = seq.slot
+        if seq.status == "prefilling":
+            self.scheduler.leave_prefill(seq)
+        if seq.tokens and seq.status == "running":
+            # the slot's CURRENT key state — what the next decode tick
+            # would have sampled with — so the recomputed continuation
+            # resumes the identical PRNG walk. A mid-recompute
+            # (prefilling, restore_point > 0) sequence keeps the
+            # snapshot it already carries: its key was never installed
+            # into the slot array.
+            seq.key = np.asarray(self._keys, np.uint32)[slot].copy()
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._last_tok[slot] = 0
+        self._donate_and_free(seq, slot)
+        if self.prefix_cache is not None and seq.prefix_nodes:
+            self.prefix_cache.release(seq.prefix_nodes)
+            seq.prefix_nodes = []
+        seq.slot = None
+        self.restore(seq)
+
+    def restore(self, seq: Sequence) -> bool:
+        """Re-enqueue a LIVE sequence for recovery-by-recompute (crash
+        recovery and preemption both land here): its prompt and
+        generated-so-far tokens are known host-side, so its KV is
+        rebuilt by prefilling ``prompt + tokens[:-1]`` — chunked when
+        long, and often a zero-copy prefix-trie hit on a donated chain
+        — after which decode resumes from the last generated token with
+        the saved PRNG walk. Greedy streams continue byte-identically
+        (the recompute reproduces the exact logits), consumers never
+        see a replayed token, and a pre-token sequence simply requeues.
+        The caller must have torn down any slot state first (crash
+        recovery starts from a fresh engine; :meth:`_preempt` frees the
+        slot). Returns False for an already-finished sequence."""
+        if seq.done:
+            return False
+        seq.status = "queued"
+        seq.slot = None
+        seq.prefix_nodes = []
+        seq.prefix_hit_tokens = 0
+        seq.prefilled = 0
+        seq.restore_point = len(seq.tokens)
+        if seq.tokens:
+            seq.work = np.concatenate(
+                [seq.prompt, np.asarray(seq.tokens[:-1], np.int32)])
+        else:
+            seq.work = seq.prompt
+        self.stats["restores"] += 1
+        self.scheduler.submit(seq)
+        return True
 
     def _record_step(self, dt, tokens, had_chunks):
         """Feed the step's measured duration + processed tokens into
@@ -937,11 +1098,11 @@ class ContinuousBatchingEngine:
         for seq, ntok in plan:
             slot, off = seq.slot, seq.prefilled
             self.cache.ensure_capacity(slot, off + ntok)
-            final = off + ntok == seq.prompt_len
+            final = off + ntok == seq.work_len
             qstart[slot] = cursor
             qlen[slot] = ntok
             kvlen[slot] = off + ntok
-            ids[cursor:cursor + ntok] = seq.prompt[off:off + ntok]
+            ids[cursor:cursor + ntok] = seq.work[off:off + ntok]
             seg[cursor:cursor + ntok] = slot
             pos[cursor:cursor + ntok] = np.arange(off, off + ntok,
                                                   dtype=np.int32)

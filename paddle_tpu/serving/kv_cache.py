@@ -45,6 +45,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class PoolExhausted(RuntimeError):
+    """KV block pool exhausted: live sequences + pinned prefix blocks
+    exceed the pool. A RuntimeError subclass for back-compat with
+    callers that caught the old untyped raise, but TYPED so the engine
+    can catch it and preempt the youngest sequence by recompute
+    (``ContinuousBatchingEngine`` donates the victim's chain to the
+    prefix trie and re-queues it) instead of taking the server down.
+    Carries the pool occupancy snapshot at the failed allocation."""
+
+    def __init__(self, live_blocks=0, pinned_blocks=0, free_blocks=0,
+                 message=None):
+        self.live_blocks = int(live_blocks)
+        self.pinned_blocks = int(pinned_blocks)
+        self.free_blocks = int(free_blocks)
+        super().__init__(message or (
+            f"KV block pool exhausted: live sequences + pinned prefix "
+            f"blocks exceed the pool (live={self.live_blocks}, "
+            f"pinned={self.pinned_blocks}, free={self.free_blocks}); "
+            f"size the pool to at least num_slots * max_blocks + prefix "
+            f"budget"))
+
+
 def _write_prefill(cache_k, cache_v, pk, pv, slot):
     # pk/pv: [L, S_pad, Hkv, D] -> one slot's leading rows. Rows past the
     # real prompt length hold prefill padding garbage; they sit beyond
@@ -343,11 +365,14 @@ class PagedKVCache:
             # unreachable when the pool is sized num_slots*max_blocks +
             # trie budget (live demand is bounded by the table grid and
             # everything else is an evictable unpinned trie block) —
-            # kept as a hard stop for mis-sized shared pools
-            raise RuntimeError(
-                "KV block pool exhausted: live sequences + pinned prefix "
-                "blocks exceed the pool; size the pool to at least "
-                "num_slots * max_blocks + prefix budget")
+            # typed so a mis-sized shared pool degrades to
+            # preemption-by-recompute (the engine catches it) instead
+            # of a server-killing crash
+            pool = self.pool
+            raise PoolExhausted(
+                live_blocks=pool.num_used,
+                pinned_blocks=int((pool._ref > 0).sum()),
+                free_blocks=pool.num_free)
         self.pool.ref(b)             # the slot's ownership pin
         return b
 

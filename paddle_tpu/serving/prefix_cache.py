@@ -43,6 +43,11 @@ work for every prompt block some earlier request already computed:
   the decode loop wrote them through the same table into the same
   private tail, so adopting them is equally free, and a multi-turn
   resubmission of an assistant turn hits that turn's own blocks.
+  PREEMPTION rides the same path (``engine._preempt``, README "Fault
+  tolerance & chaos testing"): a sequence displaced under pool
+  pressure donates its written chain exactly like retirement, so its
+  recovery-by-recompute readmission is usually a zero-copy hit on its
+  own blocks — preempt-by-donation is what makes recompute cheap.
 
 Compile discipline: lookups/inserts/evictions are pure host work; the
 only device programs are the two block-copy programs (compile-once, see

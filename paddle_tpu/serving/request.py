@@ -46,8 +46,11 @@ class GenerationRequest:
 
 #: the closed finish_reason vocabulary (OpenAI-style names): "stop" =
 #: EOS hit, "length" = token budget spent, "cancelled" = caller cancel,
-#: "timeout" = deadline expired.
-FINISH_REASONS = ("stop", "length", "cancelled", "timeout")
+#: "timeout" = deadline expired, "error" = the request itself faulted
+#: (a poisoned request isolated by the gateway's crash-recovery
+#: bisection, or an unrecoverable engine failure) — the ONLY reason
+#: under which output may be lost.
+FINISH_REASONS = ("stop", "length", "cancelled", "timeout", "error")
 
 
 class Sequence:
@@ -68,7 +71,8 @@ class Sequence:
 
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
                  "finish_reason", "slot", "key", "submit_step", "deadline",
-                 "prefix_nodes", "prefix_hit_tokens", "prefilled")
+                 "prefix_nodes", "prefix_hit_tokens", "prefilled",
+                 "work", "restore_point", "queue_tick")
 
     def __init__(self, request: GenerationRequest, key, submit_step=0,
                  deadline=None):
@@ -91,6 +95,22 @@ class Sequence:
         # installed (cache-hit prefix + completed chunks). Block-aligned
         # by construction while status == "prefilling".
         self.prefilled = 0
+        # recovery-by-recompute state (engine.restore): ``work`` is the
+        # token content the prefill paths install — the prompt for a
+        # fresh sequence, prompt + tokens[:-1] for one recovered after a
+        # crash or preemption (its KV is rebuilt by re-prefilling what
+        # was already computed; the LAST generated token's KV is never
+        # in the cache, so it re-enters as the resumed decode input).
+        # ``restore_point`` is len(tokens) at the last restore — 0 means
+        # a normal install, > 0 tells _install_seq the first "sampled"
+        # token is already known and already streamed.
+        self.work = self.prompt
+        self.restore_point = 0
+        # FIFO seniority stamp, set by FIFOScheduler.submit: the queue
+        # position authority when an aborted admission is unwound (the
+        # admitted batch is suffix-sorted, so arrival order cannot be
+        # reconstructed from it)
+        self.queue_tick = None
 
     @property
     def done(self) -> bool:
@@ -99,6 +119,12 @@ class Sequence:
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    @property
+    def work_len(self) -> int:
+        """Length of the prefill work content (== ``prompt_len`` unless
+        the sequence was restored for recovery-by-recompute)."""
+        return int(self.work.shape[0])
 
     @property
     def remaining(self) -> int:

@@ -33,6 +33,7 @@ by keeping ``decode_chunk`` modest, or set it to 1 to disable fusion.
 """
 from __future__ import annotations
 
+import itertools
 from collections import deque
 
 
@@ -44,8 +45,20 @@ class FIFOScheduler:
         self.queue = deque()
         self.prefilling = deque()   # admitted, mid-chunked-prefill (FIFO)
         self._plan_carry = 0        # sub-block budget owed to the plan head
+        self._intake = itertools.count()  # FIFO seniority stamps
 
     def submit(self, seq):
+        # the tick, not request_id, is the queue-order authority: a
+        # sequence re-enqueued for recovery (engine.restore) keeps its
+        # old id but arrives at its NEW queue position. Guarded setattr:
+        # the scheduler stays duck-typed — unit tests submit plain
+        # strings as queue entries, which reject attribute assignment;
+        # only real Sequences ever reach the engine's admission unwind,
+        # the stamp's one consumer.
+        try:
+            seq.queue_tick = next(self._intake)
+        except AttributeError:
+            pass
         self.queue.append(seq)
 
     @property
@@ -107,7 +120,10 @@ class FIFOScheduler:
         for seq in self.prefilling:
             if budget <= 0:
                 break
-            remaining = seq.prompt_len - seq.prefilled
+            # work_len, not prompt_len: a sequence restored for
+            # recovery-by-recompute chunks through prompt + generated
+            # content (engine.restore), a fresh one through its prompt
+            remaining = seq.work_len - seq.prefilled
             n = min(budget, remaining)
             if n < remaining:           # non-final: block-align the cut
                 n -= (seq.prefilled + n) % align
@@ -143,7 +159,10 @@ class FIFOScheduler:
             for seq in out:
                 seq.prefix_hit_tokens = int(hit_len_fn(seq))
             if len(out) > 1:
-                out.sort(key=lambda s: s.prompt_len - s.prefix_hit_tokens)
+                # work_len, not prompt_len: the hit is measured against
+                # the prefill work content, which for a restored
+                # sequence includes its generated tokens
+                out.sort(key=lambda s: s.work_len - s.prefix_hit_tokens)
         return out
 
     def remove(self, seq) -> bool:
@@ -154,6 +173,13 @@ class FIFOScheduler:
             return True
         except ValueError:
             return False
+
+    def requeue_front(self, seq):
+        """Put an admission-aborted sequence back at the queue HEAD
+        (the engine's PoolExhausted repair path): it was popped this
+        step but never installed, so restoring its FIFO position keeps
+        admission order deterministic under preemption retries."""
+        self.queue.appendleft(seq)
 
     def choose_num_steps(self, active_seqs) -> int:
         """How many decode steps to fuse into the next device call:

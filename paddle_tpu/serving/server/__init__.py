@@ -15,10 +15,10 @@ Run one with ``python -m paddle_tpu.serving.server`` (or
 ``scripts/serve.py``).
 """
 from .gateway import (GatewayClosedError, QueueFullError, ServingGateway,
-                      TokenStream)
+                      TokenStream, WatchdogTimeout)
 from .httpd import ServingHTTPServer, serve
 
 __all__ = [
     "ServingGateway", "TokenStream", "QueueFullError",
-    "GatewayClosedError", "ServingHTTPServer", "serve",
+    "GatewayClosedError", "WatchdogTimeout", "ServingHTTPServer", "serve",
 ]

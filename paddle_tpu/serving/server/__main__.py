@@ -82,6 +82,15 @@ def main(argv=None):
                          "decode-steps' worth of measured throughput to "
                          "prefill chunks per step (unified step only; "
                          "0 pins the fixed prefill-chunk cap)")
+    ap.add_argument("--watchdog-deadline", type=float, default=30.0,
+                    help="supervised driver: a step slower than this "
+                         "(seconds) is classified hung and the engine is "
+                         "rebuilt with in-flight requests recovered by "
+                         "recompute (0 disables the watchdog)")
+    ap.add_argument("--max-restarts", type=int, default=8,
+                    help="engine rebuild budget after fatal/hung step "
+                         "faults before the gateway gives up (0 disables "
+                         "crash recovery)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-request access logs")
@@ -98,6 +107,8 @@ def main(argv=None):
         paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
         ragged_step=args.ragged_step,
         headroom_mult=args.headroom_mult or None,
+        watchdog_deadline_s=args.watchdog_deadline or None,
+        max_restarts=args.max_restarts,
         log_fn=None if args.quiet else
         (lambda m: print(m, file=sys.stderr)))
     print(json.dumps({"listening": server.url, "preset": args.preset,
@@ -112,6 +123,9 @@ def main(argv=None):
                       # report what actually runs: the dense engine
                       # ignores --ragged-step
                       "ragged_step": server.gateway.engine.ragged_step,
+                      "watchdog_deadline_s":
+                      server.gateway.watchdog_deadline_s,
+                      "max_restarts": server.gateway.max_restarts,
                       "endpoints": ["/v1/completions", "/healthz",
                                     "/metrics"]}), flush=True)
 

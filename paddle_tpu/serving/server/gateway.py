@@ -25,10 +25,41 @@ Deadlines ride on the engine itself (``GenerationRequest.timeout_s``,
 checked at step boundaries), so a request expires whether it is queued
 or mid-decode, and the gateway just observes the ``"timeout"`` finish.
 
-The compile-once property survives serving: the gateway adds no
-device-side work, so ``decode_compilations()`` stays at one per
-``(num_slots, max_seq_len, n_steps)`` no matter the HTTP traffic mix —
-pinned by tests/test_serving_server.py.
+The driver loop is SUPERVISED (README "Fault tolerance & chaos
+testing"): an exception out of ``engine.step()`` no longer kills
+serving forever. The supervisor classifies each step failure —
+
+- **transient** (:class:`~..faults.TransientFault`, or any type in
+  ``transient_types``): retry the same engine with bounded backoff; a
+  streak past ``max_transient_retries`` escalates to fatal;
+- **hung**: a step whose measured duration (injectable ``clock``)
+  overran ``watchdog_deadline_s`` — treated as fatal, and externally
+  visible either way through the
+  ``serving_watchdog_last_step_age_seconds`` gauge and ``/healthz``;
+- **fatal** (everything else): rebuild the engine via
+  ``engine_factory`` and RECOVER every in-flight request by recompute
+  — each live sequence's prompt + generated-so-far tokens are known
+  host-side, so ``engine.restore()`` re-enqueues them as (chunked)
+  prefills and streams continue byte-identically for greedy requests;
+  the factory shares the model-level jit cache, so the rebuilt engine
+  re-traces nothing (``decode_compilations()`` stays 1).
+
+If a fault recurs while the last recovery's readmissions are still
+live, the supervisor assumes a POISON request is pinned to the crash
+and bisects the readmitted set: half re-enters, half parks outside the
+engine; the half the fault follows keeps shrinking until a single
+culprit remains, which is the ONLY request failed
+(``finish_reason="error"`` — SSE clients get a final error event,
+blocking clients a JSON 500) while every bystander — parked or
+readmitted — runs to completion. ``max_restarts`` bounds the total
+rebuild budget; past it the gateway gives up and strands with errors
+(the pre-supervision behavior).
+
+The compile-once property survives serving AND recovery: the gateway
+adds no device-side work, so ``decode_compilations()`` stays at one per
+``(num_slots, max_seq_len, n_steps)`` no matter the HTTP traffic mix
+or how many times the engine was rebuilt — pinned by
+tests/test_serving_server.py and tests/test_fault_tolerance.py.
 """
 from __future__ import annotations
 
@@ -43,6 +74,7 @@ import weakref
 import numpy as np
 
 from ...profiler.metrics import STEP_BUCKETS, TTFT_BUCKETS, MetricsRegistry
+from ..faults import TransientFault
 
 
 class QueueFullError(RuntimeError):
@@ -51,6 +83,15 @@ class QueueFullError(RuntimeError):
 
 class GatewayClosedError(RuntimeError):
     """Gateway is draining or stopped — no new work (HTTP 503)."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """An engine step overran the supervisor's watchdog deadline —
+    classified "hung" and recovered like a fatal fault. (A step that
+    never returns at all cannot be preempted from inside its own
+    thread; it is visible externally through ``/healthz``'s
+    ``last_step_age_s`` and the watchdog gauge, for an orchestrator's
+    liveness probe to act on.)"""
 
 
 class TokenStream:
@@ -168,7 +209,11 @@ class ServingGateway:
     """
 
     def __init__(self, engine, max_queue=64, idle_wait_s=0.02,
-                 registry=None, start=True):
+                 registry=None, start=True, engine_factory=None,
+                 watchdog_deadline_s=None, max_transient_retries=3,
+                 retry_backoff_s=0.02, max_restarts=8,
+                 transient_types=(TransientFault,), clock=None,
+                 fault_hook=None):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.idle_wait_s = float(idle_wait_s)
@@ -180,8 +225,34 @@ class ServingGateway:
         self._closed = False
         self._drain = True
         self._ids = itertools.count(1)
+        # ----------------------------------------------- supervision state
+        # engine_factory() -> a fresh engine with the SAME config and the
+        # SAME shared jit_cache (so recovery never re-traces); None
+        # disables crash recovery (a fatal fault strands, pre-PR-7 style)
+        self.engine_factory = engine_factory
+        self.watchdog_deadline_s = (None if not watchdog_deadline_s
+                                    else float(watchdog_deadline_s))
+        self.max_transient_retries = int(max_transient_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_restarts = int(max_restarts)
+        self.transient_types = tuple(transient_types)
+        self._clock = clock if clock is not None else time.monotonic
+        self._fault_hook = fault_hook        # re-installed on every rebuild
+        self._transient_streak = 0
+        self._restarts = 0
+        self._preempt_base = 0               # dead engines' preemption sum
+        self._last_step_done = self._clock()
+        self._recovering = False
+        self._fault_at = None                # clock() of the fault being
+        self.restart_latencies = []          # recovered; -> latency sample
+        # poison-quarantine / bisection state (module docstring):
+        self._probation = set()   # ids readmitted by the last recovery
+        self._suspect_ids = None  # active bisection half (None = off)
+        self._parked = []         # Sequences held out of the engine
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
+        if fault_hook is not None:
+            engine.fault_hook = fault_hook
         self._init_metrics(registry)
         self._thread = threading.Thread(target=self._run,
                                         name="engine-driver", daemon=True)
@@ -194,7 +265,16 @@ class ServingGateway:
             drain=False, timeout=10))(ref())
         atexit.register(self._atexit_hook)
         if start:
+            self.start()
+
+    def start(self):
+        """Start the engine-driver thread (for gateways built with
+        ``start=False`` — tests and benches submit their whole workload
+        first so a fault plan's step indices are deterministic relative
+        to the traffic). Idempotent once running; returns self."""
+        if not self._thread.is_alive():
             self._thread.start()
+        return self
 
     # ------------------------------------------------------------- metrics
     def _init_metrics(self, registry):
@@ -260,33 +340,69 @@ class ServingGateway:
                 "(prefill_chunk is the cap; fixed at it until the "
                 "EWMAs have signal or with adaptivity off).").set_fn(
             lambda: self.engine.stats["headroom"])
-        cache = getattr(self.engine, "cache", None)
-        if getattr(self.engine, "_paged", False) and cache is not None:
+        # fault-tolerance surface (README "Fault tolerance & chaos
+        # testing"). Gateway-owned counters, NOT engine-stat-backed:
+        # engine stats die with a rebuilt engine, and a restart must
+        # never scrape as a counter reset.
+        self._m_faults = r.counter(
+            "serving_faults_total",
+            "Engine step faults observed by the supervisor, by class "
+            "(kind = transient|fatal|hung).")
+        self._m_restarts = r.counter(
+            "serving_engine_restarts_total",
+            "Engine rebuilds after a fatal/hung step fault (recovery-"
+            "by-recompute; the jit cache is shared, so a restart "
+            "re-traces nothing).")
+        self._m_recovered = r.counter(
+            "serving_recovered_requests_total",
+            "Live requests re-enqueued for recompute after an engine "
+            "rebuild (each readmission counts, including bisection "
+            "re-entries).")
+        r.counter("serving_preemptions_total",
+                  "Sequences preempted by recompute under KV pool "
+                  "pressure (PoolExhausted: chain donated to the trie, "
+                  "request re-queued). Monotonic across engine rebuilds."
+                  ).set_fn(lambda: self._preempt_base
+                           + self.engine.stats["preemptions"])
+        r.gauge("serving_watchdog_last_step_age_seconds",
+                "Seconds since the last completed engine step (the "
+                "supervisor's hung-step signal; an orchestrator's "
+                "external liveness probe for a step that never "
+                "returns).").set_fn(self.last_step_age)
+        # paged/prefix gauges read THROUGH self.engine at scrape time:
+        # a recovery rebuild swaps the engine (and its cache/pool/trie)
+        # underneath the registry, and the gauges must follow it rather
+        # than keep reporting a dead engine's bookkeeping
+        if getattr(self.engine, "_paged", False) \
+                and getattr(self.engine, "cache", None) is not None:
             # paged-attention surface: physical sharing + table pressure
             # (scrape-time reads of host bookkeeping; driver is the only
             # writer, a scrape reads ints under the GIL)
             r.gauge("kv_blocks_shared",
                     "Pool blocks physically shared by concurrent "
                     "readers (refcount >= 2) — the zero-copy win."
-                    ).set_fn(lambda: cache.pool.num_shared)
+                    ).set_fn(lambda: self.engine.cache.pool.num_shared)
             r.gauge("kv_block_table_fill",
                     "Fraction of the [num_slots, max_blocks] block "
                     "table grid populated by live sequences."
-                    ).set_fn(cache.table_fill)
-        pc = getattr(self.engine, "prefix_cache", None)
-        if pc is not None:
+                    ).set_fn(lambda: self.engine.cache.table_fill())
+        if getattr(self.engine, "prefix_cache", None) is not None:
             # scrape-time counters backed by the cache's own monotonic
             # stats (the driver thread is the only writer; a scrape reads
-            # one int — no sync needed beyond the GIL)
+            # one int — no sync needed beyond the GIL). A rebuild starts
+            # a fresh trie: these reset, which Prometheus counter
+            # semantics absorb (rate() handles resets).
             r.counter("serving_prefix_cache_hits_total",
                       "Admissions that matched a cached prefix chain."
-                      ).set_fn(lambda: pc.stats["hits"])
+                      ).set_fn(
+                lambda: self.engine.prefix_cache.stats["hits"])
             r.counter("serving_prefix_cache_misses_total",
-                      "Admissions with no cached prefix."
-                      ).set_fn(lambda: pc.stats["misses"])
+                      "Admissions with no cached prefix.").set_fn(
+                lambda: self.engine.prefix_cache.stats["misses"])
             r.counter("serving_prefix_cache_evictions_total",
                       "Cached blocks evicted under pool pressure."
-                      ).set_fn(lambda: pc.stats["evictions"])
+                      ).set_fn(
+                lambda: self.engine.prefix_cache.stats["evictions"])
             r.counter("serving_prefill_tokens_saved_total",
                       "Prompt tokens served from cached KV blocks "
                       "instead of device prefill."
@@ -294,10 +410,11 @@ class ServingGateway:
                           "prefill_tokens_saved"])
             r.gauge("kv_prefix_blocks",
                     "Prefix-cache pool blocks in use (published + "
-                    "pinned).").set_fn(lambda: pc.pool.num_used)
+                    "pinned).").set_fn(
+                lambda: self.engine.prefix_cache.pool.num_used)
             r.gauge("kv_prefix_blocks_capacity",
-                    "Prefix-cache pool size in blocks.").set(
-                pc.pool.num_blocks)
+                    "Prefix-cache pool size in blocks.").set_fn(
+                lambda: self.engine.prefix_cache.pool.num_blocks)
 
     # ---------------------------------------------------------- front door
     def submit(self, request) -> TokenStream:
@@ -349,14 +466,28 @@ class ServingGateway:
             self._leave_waiting_room(stream)
         stream._push_token(token)
 
-    def _on_finish(self, seq):
+    def _finish_teardown(self, seq):
+        """Bookkeeping shared by every terminal path — engine finishes
+        (:meth:`_on_finish`) and the quarantine's poison conviction
+        (:meth:`_fail_poisoned`) — so metrics and quarantine state
+        cannot drift between them. Returns the stream (if any) still
+        owed its terminal event."""
         stream = self._live.pop(seq.request_id, None)
         self._m_finished.inc(reason=seq.finish_reason)
+        # quarantine bookkeeping: any terminal outcome clears suspicion
+        self._probation.discard(seq.request_id)
+        if self._suspect_ids is not None:
+            self._suspect_ids.discard(seq.request_id)
         if stream is None:
-            return
+            return None
         self._leave_waiting_room(stream)  # finished while still queued
         self._m_latency.observe(time.monotonic() - stream.submit_time)
-        stream._push_finish(seq.finish_reason)
+        return stream
+
+    def _on_finish(self, seq):
+        stream = self._finish_teardown(seq)
+        if stream is not None:
+            stream._push_finish(seq.finish_reason)
 
     # ------------------------------------------------------- driver thread
     def _admit_intake(self):
@@ -381,35 +512,266 @@ class ServingGateway:
 
     def _apply_cancels(self):
         for stream in [s for s in self._live.values() if s._cancel]:
-            self.engine.cancel(stream.seq)  # fires _on_finish
+            seq = stream.seq
+            parked = next((p for p in self._parked if p is seq), None)
+            if parked is not None:
+                # bisection-parked: not in any engine, cancel by hand —
+                # honoring cancellation DURING recovery is part of the
+                # fault-tolerance contract
+                self._parked.remove(seq)
+                seq.status = "finished"
+                seq.finish_reason = "cancelled"
+                self._on_finish(seq)
+                continue
+            self.engine.cancel(seq)         # fires _on_finish
+
+    def _sweep_parked_deadlines(self):
+        """Bisection-parked sequences live outside the engine, so its
+        per-step deadline sweep cannot see them — a parked request's
+        ``timeout_s`` must still be honored here (deadlines share the
+        engine's ``time.monotonic`` basis)."""
+        if not self._parked:
+            return
+        now = time.monotonic()
+        for seq in [p for p in self._parked
+                    if p.deadline is not None and now >= p.deadline]:
+            self._parked.remove(seq)
+            seq.status = "finished"
+            seq.finish_reason = "timeout"
+            self._on_finish(seq)
 
     def _run(self):
         try:
             while True:
                 self._admit_intake()
                 self._apply_cancels()
+                self._sweep_parked_deadlines()
+                self._advance_bisection()
                 if self.engine.has_work():
-                    self.engine.step()
-                    self._m_step_dur.observe(
-                        self.engine.stats["last_step_duration_s"])
+                    self._step_supervised()
                     continue
                 with self._lock:
-                    drained = not self._intake and not self._live
+                    drained = (not self._intake and not self._live
+                               and not self._parked)
                     if self._closed and drained:
                         return
+                # idle is provably not hung: refresh the watchdog
+                # timestamp so last_step_age_s / the gauge measure
+                # time-stuck-in-a-step, not time-without-traffic (an
+                # orchestrator must not kill a healthy idle server)
+                self._last_step_done = self._clock()
                 self._wake.wait(self.idle_wait_s)
                 self._wake.clear()
         except BaseException as e:
-            # the driver is the only thread that can unblock consumers —
-            # a dying engine must not strand them mid-result()
+            # supervision exhausted (max_restarts, no factory, or a
+            # non-Exception): the driver is the only thread that can
+            # unblock consumers — it must not strand them mid-result()
             with self._lock:
                 self._closed = True
-                stranded = list(self._intake) + list(self._live.values())
+                stranded = (list(self._intake) + list(self._live.values()))
                 self._intake.clear()
                 self._live.clear()
+                self._parked.clear()
             for s in stranded:
                 s._push_error(f"engine driver died: {e!r}")
             raise
+
+    # ---------------------------------------------------------- supervisor
+    def _step_supervised(self):
+        """One engine step under supervision: classify any failure,
+        retry transients with bounded backoff, rebuild + recover on
+        fatal/hung, give up (re-raise, stranding with errors) only past
+        ``max_restarts`` or without an ``engine_factory``."""
+        t0 = self._clock()
+        try:
+            # a step that TRACED a new program (first hit of a prefill
+            # bucket / decode geometry — routinely tens of seconds on a
+            # real chip) is exempt from the watchdog: compile time is
+            # not a hang, and classifying it as one would burn the
+            # restart budget on healthy cold starts
+            traces0 = (self.engine.decode_compilations()
+                       + self.engine.prefill_compilations())
+            self.engine.step()
+            dt = self._clock() - t0
+            compiled = (self.engine.decode_compilations()
+                        + self.engine.prefill_compilations()) > traces0
+            if self.watchdog_deadline_s is not None and not compiled \
+                    and dt > self.watchdog_deadline_s:
+                raise WatchdogTimeout(
+                    f"engine step took {dt:.3f}s, watchdog deadline is "
+                    f"{self.watchdog_deadline_s:.3f}s")
+        except Exception as e:
+            self._on_fault(e)
+            return
+        self._last_step_done = self._clock()
+        self._transient_streak = 0
+        if self._fault_at is not None:
+            # first completed step on the rebuilt engine: recovery done
+            self.restart_latencies.append(self._clock() - self._fault_at)
+            self._fault_at = None
+        self._m_step_dur.observe(self.engine.stats["last_step_duration_s"])
+
+    def _classify(self, exc) -> str:
+        if isinstance(exc, WatchdogTimeout):
+            return "hung"
+        if isinstance(exc, self.transient_types):
+            return "transient"
+        return "fatal"
+
+    def _on_fault(self, exc):
+        kind = self._classify(exc)
+        self._m_faults.inc(kind=kind)
+        if self._fault_at is None:
+            self._fault_at = self._clock()
+        if kind == "transient":
+            self._transient_streak += 1
+            if self._transient_streak <= self.max_transient_retries:
+                # retry the SAME engine: injected transients fire at a
+                # step boundary, so engine bookkeeping is intact; real
+                # ones (a flaky transfer) are worth one cheap retry
+                # before paying a rebuild
+                time.sleep(self.retry_backoff_s * self._transient_streak)
+                return
+            self._transient_streak = 0      # escalate: streak is a wedge
+        if self.engine_factory is None or self._restarts >= self.max_restarts:
+            raise exc
+        self._rebuild_and_recover()
+
+    def _rebuild_and_recover(self):
+        """Fatal-fault recovery: rebuild the engine and re-enqueue every
+        live request by recompute — modulo the poison quarantine, which
+        decides who re-enters now, who parks, and (once isolated) who
+        is failed as the culprit."""
+        self._recovering = True
+        old = self.engine
+        self._preempt_base += old.stats["preemptions"]
+        # best-effort PRNG-walk snapshot: per-slot current keys, so
+        # sampled continuations restart mid-walk. Unreadable device
+        # state (real crashes can corrupt it) only costs sampled-stream
+        # identity — recovery itself runs on host token state.
+        try:
+            keys = np.asarray(old._keys, np.uint32)
+        except Exception:
+            keys = None
+        live = [s for s in old._slots if s is not None and not s.done]
+        live.sort(key=lambda s: s.request_id)   # arrival order
+        for s in live:
+            if keys is not None and s.tokens and s.status == "running" \
+                    and s.slot is not None:
+                s.key = keys[s.slot].copy()
+        queued = [s for s in old.scheduler.queue if not s.done]
+        new = self.engine_factory()
+        new.on_token = self._on_token
+        new.on_finish = self._on_finish
+        if self._fault_hook is not None:
+            new.fault_hook = self._fault_hook
+        self.engine = new
+        self._restarts += 1
+        self._m_restarts.inc()
+        readmit, culprit = self._quarantine_plan(live)
+        for s in readmit + queued:
+            if new.restore(s):
+                self._m_recovered.inc()
+        self._probation = {s.request_id for s in readmit + queued}
+        if culprit is not None:
+            self._fail_poisoned(culprit)
+        self._recovering = False
+
+    def _quarantine_plan(self, live):
+        """Split the recovered slot-holders into (readmit-now, culprit).
+        First fault: readmit everyone (they enter probation). A repeat
+        fault while probation members are still live starts the
+        bisection: suspects are the probation members present at the
+        fault; half readmit as the active set, half park. Conviction
+        requires RECURRENCE UNDER ACTIVE BISECTION — a fault that
+        follows a single-member active set is the poison (fail it,
+        unpark everyone) — so two coincidental independent faults can
+        shrink an innocent request to sole-suspect, but it is only
+        failed if the fault then follows it a further time; otherwise
+        it finishes and is exonerated."""
+        bisecting = self._suspect_ids is not None
+        watched = self._suspect_ids if bisecting else self._probation
+        suspects = [s for s in live if s.request_id in watched]
+        bystanders = [s for s in live if s.request_id not in watched]
+        if not suspects:
+            # fault not attributable to any prior readmission (fresh
+            # fault, or suspects all finished): plain recovery
+            self._suspect_ids = None
+            return live, None
+        if bisecting and len(suspects) == 1:
+            # the fault followed this request through the halvings and
+            # recurred on it alone — it is the poison. Everyone parked
+            # re-enters.
+            culprit = suspects[0]
+            readmit = bystanders + self._parked
+            self._parked = []
+            self._suspect_ids = None
+            return readmit, culprit
+        half = (len(suspects) + 1) // 2
+        active, benched = suspects[:half], suspects[half:]
+        self._parked.extend(benched)
+        self._suspect_ids = {s.request_id for s in active}
+        return bystanders + active, None
+
+    def _advance_bisection(self):
+        """Driver-loop bookkeeping between steps: when the active
+        suspect half has fully drained without re-faulting, it is
+        exonerated — the culprit (if any) hides among the parked, so
+        half of them re-enter as the next suspects. With nothing parked
+        left, the bisection ends (the fault did not recur: poison gone,
+        or it was step-pinned rather than request-pinned)."""
+        if self._suspect_ids:
+            return                  # active half still live — wait
+        if not self._parked:
+            self._suspect_ids = None
+            return
+        half = (len(self._parked) + 1) // 2
+        batch, self._parked = self._parked[:half], self._parked[half:]
+        batch = [s for s in batch if not s.done]
+        for s in batch:
+            if self.engine.restore(s):
+                self._m_recovered.inc()
+        ids = {s.request_id for s in batch}
+        self._suspect_ids = ids if (ids or self._parked) else None
+        self._probation |= ids
+
+    def _fail_poisoned(self, seq):
+        """Terminate the isolated culprit — the ONLY request a poison
+        fault costs. Consumers see ``finish_reason="error"``: SSE gets
+        a terminal error event, blocking a JSON 500."""
+        seq.status = "finished"
+        seq.finish_reason = "error"
+        stream = self._finish_teardown(seq)
+        if stream is not None:
+            stream._push_error(
+                "poisoned request: engine fault recurred pinned to this "
+                "request; bystanders recovered")
+
+    # ------------------------------------------------------ health surface
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    def last_step_age(self) -> float:
+        """Seconds since the last completed engine step (the watchdog's
+        external visibility — grows without bound while a step is hung)."""
+        return max(0.0, self._clock() - self._last_step_done)
+
+    @property
+    def health_state(self) -> str:
+        """``ok`` | ``degraded`` | ``recovering`` | ``draining`` — the
+        ``/healthz`` status. ``recovering``: an engine rebuild or a
+        poison bisection is in progress (parked requests exist or a
+        suspect half is live). ``degraded``: serving, but the last
+        recovery's readmissions have not all finished yet (probation)
+        or a transient-retry streak is active."""
+        if self._closed:
+            return "draining"
+        if self._recovering or self._parked or self._suspect_ids:
+            return "recovering"
+        if self._probation or self._transient_streak:
+            return "degraded"
+        return "ok"
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self, drain=True, timeout=None):

@@ -92,11 +92,16 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             gw = self.gateway
-            self._send_json(200 if not gw.closed else 503, {
-                "status": "draining" if gw.closed else "ok",
+            st = gw.health_state    # ok|degraded|recovering|draining
+            self._send_json(503 if st == "draining" else 200, {
+                "status": st,
                 "active_slots": gw.engine.num_active,
                 "num_slots": gw.engine.num_slots,
                 "queue_depth": gw.queue_depth,
+                # the supervisor's watchdog, externally visible: a step
+                # that never returns can only be seen from out here
+                "last_step_age_s": round(gw.last_step_age(), 3),
+                "engine_restarts": gw.restarts,
             })
         elif path == "/metrics":
             body = self.gateway.registry.render().encode()
@@ -147,9 +152,23 @@ class _Handler(BaseHTTPRequestHandler):
         # slot early.
         try:
             ids, reason = stream.result()
-        except RuntimeError as e:  # engine driver died mid-request
+        except RuntimeError as e:
+            # request failed engine-side (poisoned request isolated by
+            # the recovery bisection, or the driver died): a PROPER
+            # terminal response, never a stranded connection — the 500
+            # body carries finish_reason="error" plus whatever tokens
+            # streamed before the fault
             try:
-                self._error(500, str(e), "server_error")
+                self._send_json(500, {
+                    "id": stream.id,
+                    "object": "text_completion",
+                    "model": self.server.model_name,
+                    "error": {"message": str(e), "type": "server_error"},
+                    "choices": [{
+                        "index": 0,
+                        "token_ids": [int(t) for t in stream.tokens()],
+                        "finish_reason": "error",
+                    }]})
             except OSError:
                 pass
             return
@@ -210,9 +229,18 @@ class _Handler(BaseHTTPRequestHandler):
             # client went away mid-stream: free the KV slot, leave the
             # rest of the batch untouched
             stream.cancel()
-        except RuntimeError as e:  # engine-side error event
+        except RuntimeError as e:
+            # engine-side failure: a FINAL terminal error event (with
+            # finish_reason="error") so the client sees a proper end of
+            # stream, never a silently dropped connection
             try:
-                event({"error": {"message": str(e), "type": "server_error"}})
+                event({"id": stream.id, "object": "text_completion.chunk",
+                       "model": self.server.model_name,
+                       "choices": [{"index": 0, "token_id": None,
+                                    "finish_reason": "error"}],
+                       "error": {"message": str(e),
+                                 "type": "server_error"}})
+                event("[DONE]")
             except OSError:
                 pass
         finally:
@@ -277,7 +305,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           model_name=None, registry=None, log_fn=None, start=True,
           prefix_cache=False, prefix_blocks=None, prefix_block_size=32,
           paged_attn=True, prefill_chunk=512, ragged_step=True,
-          headroom_mult=2.0):
+          headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
+          fault_hook=None, clock=None):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -305,16 +334,46 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     ``serving_step_duration_seconds`` histogram,
     ``serving_step_tokens`` and ``serving_prefill_headroom_tokens``
     gauges on ``/metrics`` watch exactly the signals the budget reads.
+
+    The driver is SUPERVISED (README "Fault tolerance & chaos
+    testing"): a step fault is classified transient/fatal/hung, and a
+    fatal one rebuilds the engine through the factory below — same
+    config, same shared jit cache, so recovery re-traces nothing — and
+    recovers every in-flight request by recompute.
+    ``watchdog_deadline_s`` bounds a step's duration before it is
+    classified hung (``0``/``None`` disables); ``max_restarts`` bounds
+    the rebuild budget; ``fault_hook`` threads a
+    :class:`~..faults.FaultPlan` through every engine incarnation (the
+    chaos-testing entry point — pass the plan's
+    :class:`~..faults.VirtualClock` as ``clock`` too when it carries
+    ``hung`` faults, since the watchdog measures step durations on this
+    clock). ``/healthz`` reports
+    ``ok|degraded|recovering|draining`` plus ``last_step_age_s``, and
+    ``/metrics`` grows ``serving_faults_total{kind}``,
+    ``serving_engine_restarts_total``, ``serving_preemptions_total``
+    and ``serving_recovered_requests_total``.
     """
     from ..engine import ContinuousBatchingEngine
-    engine = ContinuousBatchingEngine(
-        model, num_slots=num_slots, max_seq_len=max_seq_len,
-        decode_chunk=decode_chunk, prefix_cache=prefix_cache,
-        prefix_blocks=prefix_blocks, prefix_block_size=prefix_block_size,
-        paged_attn=paged_attn, prefill_chunk=prefill_chunk,
-        ragged_step=ragged_step, headroom_mult=headroom_mult,
-        jit_cache=model.__dict__.setdefault("_serving_jit", {}))
-    gateway = ServingGateway(engine, max_queue=max_queue, registry=registry)
+
+    def engine_factory():
+        # one factory builds the first engine AND every recovery
+        # rebuild: identical config, and the model-level jit cache is
+        # shared, so a rebuilt engine re-traces nothing
+        # (decode_compilations() continuity across restarts)
+        return ContinuousBatchingEngine(
+            model, num_slots=num_slots, max_seq_len=max_seq_len,
+            decode_chunk=decode_chunk, prefix_cache=prefix_cache,
+            prefix_blocks=prefix_blocks,
+            prefix_block_size=prefix_block_size,
+            paged_attn=paged_attn, prefill_chunk=prefill_chunk,
+            ragged_step=ragged_step, headroom_mult=headroom_mult,
+            jit_cache=model.__dict__.setdefault("_serving_jit", {}))
+
+    gateway = ServingGateway(
+        engine_factory(), max_queue=max_queue, registry=registry,
+        engine_factory=engine_factory,
+        watchdog_deadline_s=watchdog_deadline_s,
+        max_restarts=max_restarts, fault_hook=fault_hook, clock=clock)
     server = ServingHTTPServer(
         gateway, host=host, port=port,
         model_name=model_name or type(model).__name__, log_fn=log_fn)

@@ -77,6 +77,7 @@ class TestBenchDriverFlow:
         assert art["paged_attn"]["ok"] is False
         assert art["chunked_prefill"]["ok"] is False
         assert art["ragged_step"]["ok"] is False
+        assert art["chaos"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -130,6 +131,13 @@ class TestBenchDriverFlow:
                                       "launches_saved_per_mixed_step": 1.0,
                                       "accepted": True,
                                       "tokens_equal": True}), ""
+            if leg == "--chaos":
+                # fault-tolerance leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "chaos", "ok": True,
+                                      "accepted": True,
+                                      "chaos": {"requests_lost": 0},
+                                      "deterministic": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -164,9 +172,9 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:6] == ["--decode-cb", "--serve-http",
+        assert order[:7] == ["--decode-cb", "--serve-http",
                              "--prefix-cache", "--paged-attn",
-                             "--chunked-prefill", "--ragged"]
+                             "--chunked-prefill", "--ragged", "--chaos"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -177,6 +185,8 @@ class TestBenchDriverFlow:
         assert art["chunked_prefill"]["p95_ttft_ratio"] == 4.4
         assert art["ragged_step"]["accepted"] is True
         assert art["ragged_step"]["launches_saved_per_mixed_step"] == 1.0
+        assert art["chaos"]["accepted"] is True
+        assert art["chaos"]["chaos"]["requests_lost"] == 0
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

@@ -317,7 +317,9 @@ class TestSchedulerPolicy:
     def test_prefill_plan_budgets_fifo_block_aligned(self):
         class S:
             def __init__(self, plen, done):
-                self.prompt_len, self.prefilled = plen, done
+                # work_len is what the plan budgets (== prompt_len for
+                # anything not restored for recovery-by-recompute)
+                self.work_len, self.prefilled = plen, done
         sched = FIFOScheduler()
         a, b = S(100, 64), S(50, 0)
         sched.enter_prefill(a)

@@ -238,7 +238,7 @@ class TestSchedulerCarry:
     def test_sub_block_budgets_accumulate_at_plan_head(self):
         class S:
             def __init__(self, plen, done):
-                self.prompt_len, self.prefilled = plen, done
+                self.work_len, self.prefilled = plen, done
         sched = FIFOScheduler()
         a = S(100, 0)
         sched.enter_prefill(a)
@@ -263,7 +263,7 @@ class TestSchedulerCarry:
         so an uncapped budget would hand out ``cap + carry`` tokens."""
         class S:
             def __init__(self, plen, done):
-                self.prompt_len, self.prefilled = plen, done
+                self.work_len, self.prefilled = plen, done
         sched = FIFOScheduler()
         a = S(24 + 7, 0)                   # remaining > cap, final-chunk
         sched.enter_prefill(a)
@@ -278,7 +278,7 @@ class TestSchedulerCarry:
     def test_carry_caps_at_one_block_and_clears_when_idle(self):
         class S:
             def __init__(self, plen, done):
-                self.prompt_len, self.prefilled = plen, done
+                self.work_len, self.prefilled = plen, done
         sched = FIFOScheduler()
         a = S(40, 0)
         sched.enter_prefill(a)

@@ -394,7 +394,9 @@ class TestScheduler:
         prefills group; ties keep FIFO order (stable sort)."""
         class S:
             def __init__(self, name, plen):
-                self.name, self.prompt_len = name, plen
+                # work_len is what admission orders by (== prompt_len
+                # unless restored for recovery-by-recompute)
+                self.name, self.work_len = name, plen
                 self.prefix_hit_tokens = 0
         a, b, c, d = S("a", 40), S("b", 48), S("c", 40), S("d", 8)
         sched = FIFOScheduler()
