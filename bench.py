@@ -495,6 +495,15 @@ def watchdog():
     rg = _parse_result(rc, out)
     cb_extra["ragged_step"] = rg if rg is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Speculative-decode leg: decode launches per generated token,
+    # spec on vs off over the repetitive + adversarial traces
+    # (scripts/bench_spec.py) — exact launch counters, byte-identical
+    # streams. Same hang-proof contract: CPU-forced, banked up front.
+    rc, out, err = _run([me, "--spec"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    sp = _parse_result(rc, out)
+    cb_extra["spec_decode"] = sp if sp is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     # Chaos leg: availability under the deterministic fault plan
     # (scripts/bench_chaos.py) — requests lost (must be 0), recovery
     # latency, preemption counts. Same hang-proof contract: CPU-forced
@@ -665,6 +674,13 @@ if __name__ == "__main__":
         from bench_ragged import measure_ragged_step
         print(json.dumps({"name": "ragged_step", "ok": True,
                           **measure_ragged_step(quick=True)}))
+        sys.exit(0)
+    if "--spec" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_spec import measure_spec_decode
+        print(json.dumps({"name": "spec_decode", "ok": True,
+                          **measure_spec_decode(quick=True)}))
         sys.exit(0)
     if "--chaos" in sys.argv:
         sys.path.insert(0, os.path.join(os.path.dirname(

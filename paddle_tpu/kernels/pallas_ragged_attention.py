@@ -12,7 +12,12 @@ metadata ``(query_start, query_len, kv_len)`` scalar-prefetched
 alongside the block tables. One invocation computes causal-within-span
 attention for the whole mixed batch, which is what lets the serving
 engine fuse its prefill-chunk and decode programs into a single device
-call (``serving/decode.build_ragged_step_fn``).
+call (``serving/decode.build_ragged_step_fn``). Speculative decode
+rides the SAME span metadata (``serving/decode.build_spec_verify_fn``,
+README "Speculative decoding"): a k-token draft verify is just a span
+with ``qlen = k + 1`` — last sampled token plus the drafts — whose
+per-position causal attention this kernel already prices at live spans
+only; nothing kernel-side is speculation-specific.
 
 Semantics per sequence ``r`` (dead rows carry ``query_len == 0``):
 

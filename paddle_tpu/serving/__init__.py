@@ -32,6 +32,11 @@ Public surface:
   prefix-cache subsystem: ref-counted KV block pool + hash-trie over
   prompt token blocks with LRU eviction (README "Automatic prefix
   caching")
+- :class:`Drafter` / :class:`NgramDrafter` / :class:`ModelDrafter` —
+  speculative-decode proposers (engine ``spec_decode=True``, README
+  "Speculative decoding"): draft tokens verified as ragged spans
+  through the paged kernel, rejected K/V rolled back by
+  ``PagedKVCache.truncate``, streams byte-identical to speculation off
 
 Fault tolerance (README "Fault tolerance & chaos testing"):
 :class:`PoolExhausted` is the typed KV-pool-pressure signal the engine
@@ -46,6 +51,7 @@ The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
 (imported lazily — the engine has no HTTP dependency).
 """
 from .block_manager import BlockManager
+from .drafter import Drafter, ModelDrafter, NgramDrafter
 from .engine import ContinuousBatchingEngine
 from .faults import (FatalFault, FaultError, FaultPlan, TransientFault,
                      VirtualClock)
@@ -60,5 +66,5 @@ __all__ = [
     "Sequence", "SlotKVCache", "PagedKVCache", "PoolExhausted",
     "FIFOScheduler", "FINISH_REASONS", "BlockManager", "PrefixCache",
     "FaultPlan", "FaultError", "TransientFault", "FatalFault",
-    "VirtualClock",
+    "VirtualClock", "Drafter", "NgramDrafter", "ModelDrafter",
 ]

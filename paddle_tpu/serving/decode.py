@@ -531,6 +531,64 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 
 
 # ------------------------------------------------------ unified ragged step
+def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
+                         qstart, qlen, kvlen, sin, cos, *, nh, nkv, hd,
+                         eps, decode_attn):
+    """ONE forward pass over a packed buffer of variable-length query
+    spans through the block tables — the shared tick-0 assembly of the
+    unified ragged step AND the speculative verify program (the two
+    must write/attend identically or their streams could drift). K/V
+    for every live packed token is scattered through its slot's table
+    at its logical position (dead rows — ``seg == R`` — and positions
+    past the logical capacity DROP), attention runs through the ragged
+    paged kernel or its jnp oracle. Returns ``(x [1, T, H], pk, pv)``.
+    """
+    R = tables.shape[0]
+    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    mb = tables.shape[1]
+    s_tot = mb * bs
+    T = ids.shape[0]
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    sin_p = jnp.take(sin, pos, axis=0, mode="clip")[None]   # [1, T, D]
+    cos_p = jnp.take(cos, pos, axis=0, mode="clip")[None]
+    # pool write coordinates: token t appends at its logical position
+    # through its OWN slot's table; dead packed rows (seg == R) and
+    # positions past the logical capacity drop — never clamp into a
+    # block another sequence owns
+    live_tok = seg < R
+    seg_c = jnp.minimum(seg, R - 1)
+    bi = jnp.minimum(pos // bs, mb - 1)
+    phys0 = jnp.take_along_axis(jnp.take(tables, seg_c, axis=0),
+                                bi[:, None], axis=1)[:, 0]
+    phys0 = jnp.where(live_tok & (pos < s_tot), phys0, nb)
+    prow0 = pos % bs
+
+    def layer0(h, lp):
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope_grid(q, sin_p, cos_p)
+        k = _apply_rope_grid(k, sin_p, cos_p)
+        # write the packed K/V through the tables, then attend over each
+        # span causally at its row's kv length
+        pk_l = pk_l.at[phys0, prow0].set(k[0], mode="drop")
+        pv_l = pv_l.at[phys0, prow0].set(v[0], mode="drop")
+        if decode_attn == "pallas":
+            attn = ragged_paged_attention_pallas(
+                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+        else:
+            attn = ragged_attention_reference(
+                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+        h = h + jnp.einsum("bsd,dh->bsh",
+                           attn.reshape(1, T, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (pk_l, pv_l)
+
+    x = jnp.take(params["embed"], ids[None], axis=0)        # [1, T, H]
+    x, (pk, pv) = jax.lax.scan(layer0, x, stack + (pool_k, pool_v))
+    return x, pk, pv
+
+
 def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, dec_mask, keys, temps, top_ks,
                       *, n_steps, nh, nkv, hd, eps, theta, tied,
@@ -585,44 +643,11 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     stack = tuple(params[k] for k in _STACK_KEYS)
     head = params["lm_head"].T if tied else params["lm_head"]
 
-    # ---------------------------------------------------------- tick 0
-    sin_p = jnp.take(sin, pos, axis=0, mode="clip")[None]   # [1, T, D]
-    cos_p = jnp.take(cos, pos, axis=0, mode="clip")[None]
-    # pool write coordinates: token t appends at its logical position
-    # through its OWN slot's table; dead packed rows (seg == R) and
-    # positions past the logical capacity drop — never clamp into a
-    # block another sequence owns
-    live_tok = seg < R
-    seg_c = jnp.minimum(seg, R - 1)
-    bi = jnp.minimum(pos // bs, mb - 1)
-    phys0 = jnp.take_along_axis(jnp.take(tables, seg_c, axis=0),
-                                bi[:, None], axis=1)[:, 0]
-    phys0 = jnp.where(live_tok & (pos < s_tot), phys0, nb)
-    prow0 = pos % bs
-
-    def layer0(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
-        hn = _rms(h, lin, eps)
-        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
-        q = _apply_rope_grid(q, sin_p, cos_p)
-        k = _apply_rope_grid(k, sin_p, cos_p)
-        # write the packed K/V through the tables, then attend over each
-        # span causally at its row's kv length
-        pk_l = pk_l.at[phys0, prow0].set(k[0], mode="drop")
-        pv_l = pv_l.at[phys0, prow0].set(v[0], mode="drop")
-        if decode_attn == "pallas":
-            attn = ragged_paged_attention_pallas(
-                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
-        else:
-            attn = ragged_attention_reference(
-                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
-        h = h + jnp.einsum("bsd,dh->bsh",
-                           attn.reshape(1, T, nh * hd), lwo)
-        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
-        return h, (pk_l, pv_l)
-
-    x = jnp.take(params["embed"], ids[None], axis=0)        # [1, T, H]
-    x, (pk, pv) = jax.lax.scan(layer0, x, stack + (pool_k, pool_v))
+    # ----------------------------------- tick 0 (shared packed forward)
+    x, pk, pv = _packed_span_forward(
+        params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
+        kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
+        decode_attn=decode_attn)
     # each slot samples from its span's LAST packed position (decode
     # rows: the one token; chunk rows: the chunk end — live only when
     # the chunk completes the prompt)
@@ -698,5 +723,100 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
     return jax.jit(
         functools.partial(
             _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+        donate_argnums=(1, 2) if donate else ())
+
+
+# ------------------------------------------------- speculative verify step
+def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
+                      qstart, qlen, kvlen, sample_start, keys, temps,
+                      top_ks, *, spec_len, nh, nkv, hd, eps, theta, tied,
+                      decode_attn):
+    """THE speculative serving step (README "Speculative decoding"):
+    one device call that scores every slot's draft-extended span — a
+    verify row packs ``[last_token, d_1 .. d_k]`` at positions
+    ``len .. len+k`` and a prefill chunk packs its prompt slice, both
+    writing K/V through the block tables exactly like
+    ``_ragged_step_impl``'s tick 0 (the forward IS that tick's shared
+    assembly, ``_packed_span_forward``) — then samples ``spec_len``
+    consecutive positions per row under the standard split-per-token
+    PRNG walk, so the host can accept the longest draft prefix whose
+    tokens the target model reproduces and adopt the key exactly where
+    sequential decode would have left it.
+
+    Packed layout (host-built runtime arrays; shapes depend only on
+    ``(num_slots, spec token budget, spec_len)``):
+
+    ids/seg/pos:   [T] — as in ``_ragged_step_impl`` (dead rows drop)
+    qstart/qlen/kvlen: [R] span metadata (``qlen == 0`` = idle slot;
+                   ``kvlen`` counts KV valid AFTER this step's writes)
+    sample_start:  [R] — the packed row the sampling walk starts at:
+                   a VERIFY row samples from its span START (position
+                   ``j`` scores the token after input ``j``), a chunk
+                   row from its span END (only its final-position
+                   sample — token 0 — is ever adopted); reads clamp
+                   inside the span, so short spans repeat their last
+                   position and the host ignores the surplus.
+    keys/temps/top_ks: [R] per-slot sampling state (chunk rows carry
+                   the sequence's resume key, live only on their final
+                   chunk — exactly like ``_suffix_call`` rows).
+
+    Walk step ``j``: split every row's key, sample position ``j``'s
+    logits with the split — byte-identical to ``spec_len`` sequential
+    decode ticks for any prefix the drafts match, which is the whole
+    acceptance argument: an accepted token was sampled with the same
+    key and the same logits sequential decode would have used, so
+    streams with speculation ON equal streams with it OFF, greedy AND
+    seeded-sampled. Rejected positions' samples/keys are garbage the
+    host never adopts (and their K/V rows are truncated away).
+
+    Returns ``(pool_k', pool_v', toks [spec_len, R],
+    keys_walk [spec_len, R, 2])`` — ``keys_walk[j]`` is each row's key
+    after ``j + 1`` splits; a row that emits ``m`` tokens adopts
+    ``keys_walk[m - 1]``.
+    """
+    T = ids.shape[0]
+    R = tables.shape[0]
+    s_tot = tables.shape[1] * pool_k.shape[2]
+    sin, cos = _rope_tables(s_tot, hd, theta)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    x, pk, pv = _packed_span_forward(
+        params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
+        kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
+        decode_attn=decode_attn)
+    # per-row sample positions: spec_len consecutive packed rows from
+    # sample_start, clamped inside the row's span (idle rows clamp to
+    # row 0 — garbage the host never reads)
+    span_end = jnp.clip(qstart + jnp.maximum(qlen, 1) - 1, 0, T - 1)
+    j_idx = jnp.arange(spec_len, dtype=jnp.int32)
+    idx = jnp.clip(sample_start[:, None] + j_idx[None, :],
+                   qstart[:, None], span_end[:, None])       # [R, S]
+    hsel = jnp.take(x[0], idx.reshape(-1), axis=0)           # [R*S, H]
+    last_h = _rms(hsel, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    logits = logits.reshape(R, spec_len, -1)
+
+    def walk(kys, lg_j):
+        both = jax.vmap(jax.random.split)(kys)               # [R, 2, 2]
+        tok = sample_rows(lg_j, both[:, 1], temps, top_ks)
+        return both[:, 0], (tok, both[:, 0])
+
+    _, (toks, keys_walk) = jax.lax.scan(
+        walk, keys, jnp.moveaxis(logits, 1, 0))
+    return pk, pv, toks, keys_walk
+
+
+def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
+                         decode_attn, donate=None):
+    """One jitted speculative verify step (``_spec_verify_impl``):
+    shapes depend only on ``(num_slots, spec token budget, spec_len)``
+    — one compilation serves every draft/acceptance/chunk mix, the
+    same compile-once contract as the programs it replaces."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(
+            _spec_verify_impl, spec_len=spec_len, nh=nh, nkv=nkv, hd=hd,
             eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
         donate_argnums=(1, 2) if donate else ())

@@ -1,0 +1,153 @@
+"""Draft-token proposers for speculative decoding (README "Speculative
+decoding").
+
+Speculative decode splits each decode advance into a cheap PROPOSE and
+one batched VERIFY: a :class:`Drafter` guesses the next ``k`` tokens of
+a running sequence from host-visible state, the engine scores all
+``k + 1`` positions in one ragged-span forward through the paged block
+tables (``decode.build_spec_verify_fn``), accepts the longest matching
+prefix, and rolls rejected K/V back by truncating the slot's private
+block tail (``PagedKVCache.truncate``). The drafter is therefore pure
+host-side policy: it never touches the KV pool, never affects the
+compile surface, and a wrong guess costs only the packed-buffer
+positions the verify span spent — never a wrong token (acceptance is
+exact-match against the target model's own samples, so streams are
+byte-identical to speculation off).
+
+Two drafters ship behind the one interface:
+
+- :class:`NgramDrafter` — model-free prompt lookup (PLD, PAPERS.md):
+  match the longest recent n-gram of the sequence's history (prompt +
+  generated tokens) against an earlier occurrence and propose its
+  continuation. Zero extra weights, zero device work; it feeds on the
+  repetition that dominates real serving traffic (quotes, code,
+  structured output, the model's own loops). The engine's default.
+- :class:`ModelDrafter` — a separate (typically much smaller) LLaMA
+  draft model proposing greedily. Shares the engine's jit-cache-factory
+  idiom: pass one dict to every instance (and every engine rebuild) so
+  proposals never re-trace. The reference implementation re-runs the
+  draft model's bucketed prefill per proposed token — correct and
+  compile-bounded, but O(k) full forwards per call; a production
+  drafter would keep its own KV cache (ROADMAP follow-on).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_EMPTY = np.zeros(0, np.int32)
+
+
+def _history(seq):
+    """The sequence's full known token history — prompt plus every
+    ACCEPTED generated token (draft tokens never enter ``seq.tokens``
+    until verification accepts them, which is what makes crash recovery
+    safe: ``engine.restore()`` recomputes from exactly this)."""
+    if seq.tokens:
+        return np.concatenate(
+            [seq.prompt, np.asarray(seq.tokens, np.int32)])
+    return np.asarray(seq.prompt, np.int32)
+
+
+class Drafter:
+    """Interface: ``propose(seq, k)`` returns up to ``k`` draft token
+    ids (1-D int32, possibly empty) guessing the sequence's next
+    tokens. Called on the engine-driver thread once per running slot
+    per speculative step — keep it cheap; returning fewer than ``k``
+    (or none) is always safe and merely shrinks the verify span."""
+
+    def propose(self, seq, k):
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup n-gram drafter (self-speculative, model-free).
+
+    Finds the longest ``n``-gram (``max_ngram`` down to ``min_ngram``)
+    ending the sequence's history that also occurs EARLIER in the
+    history, and proposes the continuation after the most recent such
+    occurrence. Repetitive continuations — the model re-quoting the
+    prompt, structured output, greedy decode settling into a loop —
+    verify at near-full acceptance; on non-repetitive text it simply
+    finds no match and the verify span degenerates to a plain decode
+    row (no wasted device work beyond the packed position).
+    """
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        if int(min_ngram) < 1 or int(max_ngram) < int(min_ngram):
+            raise ValueError(
+                f"need max_ngram >= min_ngram >= 1, got "
+                f"max_ngram={max_ngram}, min_ngram={min_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, seq, k):
+        k = int(k)
+        if k <= 0:
+            return _EMPTY
+        hist = _history(seq)
+        L = int(hist.shape[0])
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if L < n + 1:
+                continue        # history too short for this n + 1 cont.
+            tail = hist[L - n:]
+            win = np.lib.stride_tricks.sliding_window_view(hist, n)
+            hits = np.nonzero((win == tail).all(axis=1))[0]
+            hits = hits[hits < L - n]   # exclude the tail itself
+            if hits.size:
+                i = int(hits[-1])       # most recent earlier occurrence
+                return hist[i + n:i + n + k].astype(np.int32, copy=True)
+        return _EMPTY
+
+
+class ModelDrafter(Drafter):
+    """Greedy proposals from a separate LLaMA-family draft model.
+
+    ``jit_cache`` follows the engine's shared-factory idiom: pass the
+    same dict to every drafter the engine factory builds so crash-
+    recovery rebuilds re-trace nothing. Context lengths are padded to
+    pow2 buckets, so the compile set is bounded exactly like the
+    engine's cold prefill. Drafting with the TARGET model itself is the
+    always-accept oracle (the verify argmax is the same function) —
+    useful for tests and as the acceptance upper bound, not for speed.
+    """
+
+    def __init__(self, model, jit_cache=None):
+        from .decode import build_prefill_fn, llama_decode_params
+        c = model.config
+        self._params, tied = llama_decode_params(model)
+        self._consts = dict(
+            nh=c.num_attention_heads, nkv=c.num_key_value_heads,
+            hd=c.head_dim, eps=float(c.rms_norm_eps),
+            theta=float(c.rope_theta), tied=tied)
+        self._build = build_prefill_fn
+        self._jit = jit_cache if jit_cache is not None else {}
+        self._max_len = int(c.max_position_embeddings)
+
+    def _fn(self):
+        # "draft" key: the draft model's traces must not count against
+        # the serving engine's prefill_compilations() pin when the two
+        # share one jit-cache dict
+        key = ("draft",)
+        if key not in self._jit:
+            self._jit[key] = self._build(**self._consts)
+        return self._jit[key]
+
+    def propose(self, seq, k):
+        import jax.numpy as jnp
+        hist = _history(seq)
+        out = []
+        for _ in range(int(k)):
+            L = int(hist.shape[0])
+            if L >= self._max_len:
+                break
+            pad = min(max(8, 1 << (L - 1).bit_length()), self._max_len)
+            ids = np.zeros((1, pad), np.int32)
+            ids[0, :L] = hist
+            _, _, tok0, _ = self._fn()(
+                self._params, jnp.asarray(ids),
+                np.asarray([L], np.int32), jnp.zeros((1, 2), jnp.uint32),
+                np.zeros(1, np.float32), np.zeros(1, np.int32))
+            t = int(np.asarray(tok0)[0])
+            out.append(t)
+            hist = np.append(hist, np.int32(t))
+        return np.asarray(out, np.int32)

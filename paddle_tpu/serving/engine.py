@@ -111,6 +111,31 @@ class ContinuousBatchingEngine:
     ``step_clock`` injects the timebase the EWMA reads (tests/benches
     pass a virtual clock; default ``time.perf_counter``).
 
+    ``spec_decode=True`` (paged only, default OFF — every banked
+    baseline is an A/B away) turns on speculative multi-token decode
+    (README "Speculative decoding"): a :class:`~.drafter.Drafter`
+    (default: model-free prompt-lookup n-grams,
+    :class:`~.drafter.NgramDrafter`; or a tiny draft model via
+    :class:`~.drafter.ModelDrafter`) proposes up to ``spec_k`` tokens
+    per running slot, one batched forward scores all ``k + 1``
+    positions per slot as a ragged span through the same paged
+    attention kernel (draft K/V appended through the block tables
+    exactly like a prefill chunk), the longest matching prefix is
+    accepted — plus the model's own token at the first mismatch, so a
+    launch always advances every slot — and rejected draft K/V rolls
+    back via ``PagedKVCache.truncate`` (exact block accounting,
+    donated/shared blocks untouched). Acceptance is exact-match
+    against the target model's own sampling walk, so token streams are
+    BYTE-IDENTICAL to ``spec_decode=False`` — greedy and seeded-
+    sampled alike; speculation only reorders work. Prefill chunks ride
+    the same one-launch-per-step program; drafts share the packed
+    buffer's headroom with the chunk grant
+    (``FIFOScheduler.spec_grants``). ``decode_compilations()`` counts
+    the verify geometry and stays 1. On the CPU/jnp substrate the
+    verify walk prices the packed buffer densely (same caveat as the
+    unified step below); the modeled win is launches-per-token
+    (``scripts/bench_spec.py``, SPEC_BENCH.json).
+
     Substrate note: the unified program's packed buffer is a fixed
     ``num_slots + prefill_chunk`` tokens, which the TPU Pallas kernel
     prices at the LIVE spans only (span-block gating + ragged DMA
@@ -128,7 +153,8 @@ class ContinuousBatchingEngine:
                  prefix_cache=False, prefix_blocks=None,
                  prefix_block_size=32, paged_attn=True,
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
-                 step_clock=None):
+                 step_clock=None, spec_decode=False, spec_k=4,
+                 drafter=None):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -256,6 +282,34 @@ class ContinuousBatchingEngine:
         chunkable = self._chunk is not None and self._chunk < self.max_seq_len
         self._token_budget = self.num_slots + (self._chunk if chunkable
                                                else 0)
+        # speculative decode (paged only — rollback truncates the block
+        # tail; README "Speculative decoding"): every step becomes ONE
+        # draft-extended verify launch whose packed buffer shares its
+        # headroom between prefill-chunk tokens and verify spans (a
+        # verify span spends 1 + k positions of it). The buffer is
+        # sized for the LARGER of the two demands, not their sum —
+        # chunk-heavy steps throttle drafts, decode-heavy steps have
+        # the chunk headroom to speculate into.
+        self._spec = bool(spec_decode)
+        if self._spec and not self._paged:
+            raise ValueError(
+                "spec_decode requires the paged engine (paged_attn="
+                "True): draft rollback truncates the slot's private "
+                "block tail, which the dense per-slot cache does not "
+                "have")
+        if self._spec and int(spec_k) < 1:
+            raise ValueError(f"spec_k must be >= 1, got {int(spec_k)}")
+        self._spec_k = int(spec_k)
+        self._spec_len = self._spec_k + 1       # the sampling-walk depth
+        self._spec_budget = self.num_slots + max(
+            self._chunk if chunkable else 0,
+            self.num_slots * self._spec_k)
+        self.drafter = None
+        if self._spec:
+            if drafter is None:
+                from .drafter import NgramDrafter
+                drafter = NgramDrafter()
+            self.drafter = drafter
         if headroom_mult is not None and float(headroom_mult) <= 0:
             raise ValueError(
                 f"headroom_mult must be > 0 (or None for fixed-cap chunk "
@@ -287,6 +341,9 @@ class ContinuousBatchingEngine:
                       "prefill_copy_dispatches": 0,
                       "prefill_chunks": 0, "chunk_tokens": 0,
                       "unified_steps": 0,
+                      "spec_steps": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_tokens": 0,
+                      "spec_last_accept": [],
                       "headroom": self._chunk or 0, "headroom_tps": 0.0,
                       "last_step_duration_s": 0.0, "last_step_tokens": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0,
@@ -357,6 +414,33 @@ class ContinuousBatchingEngine:
                 **self._fn_consts())
         return self._jit[key]
 
+    def _spec_fn(self):
+        # like the ragged key: the full packed geometry (num_slots AND
+        # the spec token budget) plus the sampling-walk depth key the
+        # trace apart from other engines sharing one jit_cache
+        key = ("spec", self.num_slots, self._spec_budget,
+               self._spec_len, self.config.decode_attention)
+        if key not in self._jit:
+            from .decode import build_spec_verify_fn
+            self._jit[key] = build_spec_verify_fn(
+                spec_len=self._spec_len,
+                decode_attn=self.config.decode_attention,
+                **self._fn_consts())
+        return self._jit[key]
+
+    @property
+    def spec_decode(self) -> bool:
+        """Whether this engine runs speculative multi-token decode
+        (draft → ragged-span verify → block-tail rollback) — the public
+        surface for banners/metrics."""
+        return self._spec
+
+    @property
+    def spec_k(self) -> int:
+        """Max draft tokens per verify span (0 when speculation is
+        off)."""
+        return self._spec_k if self._spec else 0
+
     @property
     def ragged_step(self) -> bool:
         """Whether this engine runs the unified ragged step (one device
@@ -381,7 +465,20 @@ class ContinuousBatchingEngine:
         ``(num_slots, token_budget, n_steps)`` — no matter how request
         sampling params / token budgets / block tables / span mixes
         vary. Dense, paged-two-program and unified engines sharing one
-        jit_cache count only their own programs."""
+        jit_cache count only their own programs. On the speculative
+        engine the verify program IS the decode program — every step,
+        chunk-carrying or not, is one spec-geometry launch — so the
+        count covers the verify geometry too."""
+        if self._spec:
+            # spec_len is CONFIG (spec_k + 1), not a runtime variant
+            # like the ragged key's n_steps — two engines differing
+            # only in spec_k can share a budget (the chunk term of the
+            # max dominates), so it must be part of the identity
+            return sum(fn._cache_size() for key, fn in self._jit.items()
+                       if key[0] == "spec"
+                       and key[1] == self.num_slots
+                       and key[2] == self._spec_budget
+                       and key[3] == self._spec_len)
         if self._ragged:
             return sum(fn._cache_size() for key, fn in self._jit.items()
                        if key[0] == "ragged"
@@ -868,7 +965,9 @@ class ContinuousBatchingEngine:
                         if self.prefix_cache is not None else None)
                     if admitted:
                         self._admit_group(admitted, finished)
-                if self._ragged:
+                if self._spec:
+                    step_tokens, had_chunks = self._spec_step(finished)
+                elif self._ragged:
                     step_tokens, had_chunks = self._unified_step(finished)
                 else:
                     step_tokens, had_chunks = self._two_program_step(
@@ -998,7 +1097,7 @@ class ContinuousBatchingEngine:
         chunk budget derives from."""
         self.stats["last_step_duration_s"] = float(dt)
         self.stats["last_step_tokens"] = int(tokens)
-        if not self._ragged or tokens <= 0 or dt <= 0:
+        if not (self._ragged or self._spec) or tokens <= 0 or dt <= 0:
             return
         a = 0.2
         if had_chunks:
@@ -1094,27 +1193,9 @@ class ContinuousBatchingEngine:
             temps[slot] = self._temps[slot]
             topks[slot] = self._topks[slot]
             cursor += 1
-        chunk_rows = []                     # (slot, seq, n_tokens, final)
-        for seq, ntok in plan:
-            slot, off = seq.slot, seq.prefilled
-            self.cache.ensure_capacity(slot, off + ntok)
-            final = off + ntok == seq.work_len
-            qstart[slot] = cursor
-            qlen[slot] = ntok
-            kvlen[slot] = off + ntok
-            ids[cursor:cursor + ntok] = seq.work[off:off + ntok]
-            seg[cursor:cursor + ntok] = slot
-            pos[cursor:cursor + ntok] = np.arange(off, off + ntok,
-                                                  dtype=np.int32)
-            # chunk rows sample (and advance the PRNG) only on their
-            # FINAL chunk — the same rule as the two-program path, so
-            # streams stay byte-identical to a one-shot prefill
-            keys[slot] = np.asarray(seq.key)
-            if final:
-                temps[slot] = float(seq.request.temperature)
-                topks[slot] = int(seq.request.top_k)
-            chunk_rows.append((slot, seq, ntok, final))
-            cursor += ntok
+        chunk_rows, cursor = self._pack_chunk_rows(
+            plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
+            temps, topks)
         npk, npv, toks, keys_t0, keys_fin = self._ragged_fn(n)(
             self._params, self.cache.pool.k, self.cache.pool.v,
             jnp.asarray(self.cache.tables), jnp.asarray(ids),
@@ -1160,6 +1241,189 @@ class ContinuousBatchingEngine:
                     self._emit(seq, t)
                     self._maybe_finish(seq, finished)
         return cursor + (n - 1) * len(active), bool(chunk_rows)
+
+    def _pack_chunk_rows(self, plan, cursor, ids, seg, pos, qstart, qlen,
+                         kvlen, keys, temps, topks, sample_start=None):
+        """Pack this step's planned prefill chunks into the packed
+        token buffer — the ONE chunk-row assembly shared by the
+        unified and speculative steps, so their packing rules (block
+        growth, span metadata, the final-chunk-only sampling rule)
+        cannot silently diverge. ``sample_start`` is the speculative
+        program's extra metadata: a chunk row samples at its span END
+        (token 0); the unified program derives that position in-program
+        and passes None. Returns ``(chunk_rows, cursor)``."""
+        chunk_rows = []                     # (slot, seq, n_tokens, final)
+        for seq, ntok in plan:
+            slot, off = seq.slot, seq.prefilled
+            self.cache.ensure_capacity(slot, off + ntok)
+            final = off + ntok == seq.work_len
+            qstart[slot] = cursor
+            qlen[slot] = ntok
+            kvlen[slot] = off + ntok
+            if sample_start is not None:
+                sample_start[slot] = cursor + ntok - 1
+            ids[cursor:cursor + ntok] = seq.work[off:off + ntok]
+            seg[cursor:cursor + ntok] = slot
+            pos[cursor:cursor + ntok] = np.arange(off, off + ntok,
+                                                  dtype=np.int32)
+            # chunk rows sample (and advance the PRNG) only on their
+            # FINAL chunk — the same rule as the two-program path, so
+            # streams stay byte-identical to a one-shot prefill
+            keys[slot] = np.asarray(seq.key)
+            if final:
+                temps[slot] = float(seq.request.temperature)
+                topks[slot] = int(seq.request.top_k)
+            chunk_rows.append((slot, seq, ntok, final))
+            cursor += ntok
+        return chunk_rows, cursor
+
+    def _spec_step(self, finished):
+        """ONE device call for everything a speculative step advances
+        (README "Speculative decoding"): every running slot contributes
+        a DRAFT-EXTENDED verify span — ``[last_token, d_1 .. d_k]``,
+        the drafter's guesses appended through the block tables exactly
+        like a prefill chunk — and every planned prefill chunk its
+        span, to the packed buffer of the verify program
+        (``decode.build_spec_verify_fn``). The program samples
+        ``spec_k + 1`` consecutive positions per row under the standard
+        split-per-token PRNG walk; the host accepts the longest draft
+        prefix the target model reproduced, emits those tokens plus the
+        model's own correction at the first mismatch (so every launch
+        yields >= 1 token and acceptance only reorders work — streams
+        are byte-identical to speculation off, greedy AND sampled), and
+        rolls rejected draft K/V back by truncating the slot's private
+        block tail (``PagedKVCache.truncate`` — exact num_free/refcount
+        restoration, donated trie blocks untouched).
+
+        Budget discipline: drafts share the packed buffer's headroom
+        with the chunk grant (``FIFOScheduler.spec_grants`` — a verify
+        span spends ``1 + k`` positions), so chunk-heavy steps throttle
+        speculation instead of overflowing the compile geometry.
+        Returns ``(tokens_processed, had_chunks)`` for the headroom
+        EWMAs."""
+        plan = []
+        if self._chunk and self.scheduler.num_prefilling:
+            plan = self.scheduler.prefill_plan(self._prefill_budget(),
+                                               self.cache.block_size,
+                                               cap=self._chunk)
+        active = [(slot, s) for slot, s in enumerate(self._slots)
+                  if s is not None and s.status == "running"]
+        if not active and not plan:
+            return 0, False
+        R, T = self.num_slots, self._spec_budget
+        lens = self.cache.lengths
+        chunk_spend = sum(n for _, n in plan)
+        # drafter proposals, clipped per row to the verify depth, the
+        # token budget (a verify emits at most k+1 tokens — proposing
+        # past remaining-1 is wasted span), and the KV capacity
+        drafts = []
+        for slot, s in active:
+            cap = min(self._spec_k, s.remaining - 1,
+                      self.max_seq_len - int(lens[slot]) - 1)
+            d = self.drafter.propose(s, cap) if cap > 0 else ()
+            drafts.append(np.asarray(d, np.int32).reshape(-1)[:max(cap, 0)])
+        grants = self.scheduler.spec_grants(
+            [len(d) for d in drafts], T - R - chunk_spend)
+        ids = np.zeros(T, np.int32)
+        seg = np.full(T, R, np.int32)       # sentinel: dead packed rows
+        pos = np.zeros(T, np.int32)
+        qstart = np.zeros(R, np.int32)
+        qlen = np.zeros(R, np.int32)
+        kvlen = np.zeros(R, np.int32)
+        sample_start = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        topks = np.zeros(R, np.int32)
+        keys = np.asarray(self._keys, np.uint32).copy()
+        cursor = 0
+        verify_rows = []                    # (slot, seq, draft, len0)
+        for (slot, s), d, g in zip(active, drafts, grants):
+            d = d[:g]
+            L0 = int(lens[slot])
+            q = 1 + len(d)
+            # the verify span appends draft K/V rows [L0, L0+q) — the
+            # table must cover them pre-call (rejected rows hand their
+            # blocks back through truncate below)
+            self.cache.ensure_capacity(slot, L0 + q)
+            qstart[slot] = cursor
+            qlen[slot] = q
+            kvlen[slot] = L0 + q
+            sample_start[slot] = cursor     # sample EVERY span position
+            ids[cursor] = self._last_tok[slot]
+            if len(d):
+                ids[cursor + 1:cursor + q] = d
+            seg[cursor:cursor + q] = slot
+            pos[cursor:cursor + q] = np.arange(L0, L0 + q, dtype=np.int32)
+            temps[slot] = self._temps[slot]
+            topks[slot] = self._topks[slot]
+            verify_rows.append((slot, s, d, L0))
+            cursor += q
+        chunk_rows, cursor = self._pack_chunk_rows(
+            plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
+            temps, topks, sample_start=sample_start)
+        npk, npv, toks, kwalk = self._spec_fn()(
+            self._params, self.cache.pool.k, self.cache.pool.v,
+            jnp.asarray(self.cache.tables), jnp.asarray(ids),
+            jnp.asarray(seg), jnp.asarray(pos), jnp.asarray(qstart),
+            jnp.asarray(qlen), jnp.asarray(kvlen),
+            jnp.asarray(sample_start), jnp.asarray(keys),
+            jnp.asarray(temps), jnp.asarray(topks))
+        self.cache.update(npk, npv)
+        toks_np = np.asarray(toks)          # [spec_len, R]
+        kwalk_np = np.asarray(kwalk)        # [spec_len, R, 2]
+        self.stats["spec_steps"] += 1
+        # chunk bookkeeping first — mirrors the unified-step order (a
+        # final chunk adopts its walk-step-0 token/key, the same one
+        # split as a one-shot prefill)
+        for slot, seq, ntok, final in chunk_rows:
+            self._advance_chunk(seq, ntok, toks_np[0, slot],
+                                kwalk_np[0, slot], finished)
+        emitted_total = 0
+        accept_lens = []
+        if verify_rows:
+            self.stats["decode_calls"] += 1
+            self.stats["decode_steps"] += 1
+            self.stats["slot_steps"] += self.num_slots
+            # snapshot AFTER chunk bookkeeping: a final chunk's
+            # _install_seq key write must survive the batched update
+            knp = np.asarray(self._keys, np.uint32).copy()
+            for slot, seq, d, L0 in verify_rows:
+                a = 0
+                while a < len(d) and int(toks_np[a, slot]) == int(d[a]):
+                    a += 1
+                req = seq.request
+                emit = []
+                for j in range(a + 1):
+                    t = int(toks_np[j, slot])
+                    emit.append(t)
+                    if req.eos_token_id is not None \
+                            and t == int(req.eos_token_id):
+                        break       # sequential decode would stop here
+                    if len(seq.tokens) + len(emit) \
+                            >= int(req.max_new_tokens):
+                        break
+                m = len(emit)
+                # rollback: rows [L0, L0 + 1 + len(d)) were written;
+                # only [L0, L0 + m) are confirmed — the last emitted
+                # token's own KV is at L0 + m, NOT in the cache, which
+                # preserves the donation invariant
+                self.cache.truncate(slot, L0 + m)
+                self.cache.lengths[slot] = L0 + m
+                self._last_tok[slot] = emit[-1]
+                knp[slot] = kwalk_np[m - 1, slot]
+                self.stats["spec_proposed"] += len(d)
+                self.stats["spec_accepted"] += m - 1
+                self.stats["spec_tokens"] += m
+                accept_lens.append(m)
+                emitted_total += m
+                for t in emit:
+                    seq.tokens.append(t)
+                    self.stats["active_slot_steps"] += 1
+                    self.stats["tokens_generated"] += 1
+                    self._emit(seq, t)
+                self._maybe_finish(seq, finished)
+            self._keys = jnp.asarray(knp)
+        self.stats["spec_last_accept"] = accept_lens
+        return chunk_spend + emitted_total, bool(chunk_rows)
 
     def _two_program_step(self, finished):
         """The PR-5 two-program interleave (``ragged_step=False`` and

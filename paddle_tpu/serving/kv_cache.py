@@ -388,6 +388,41 @@ class PagedKVCache:
             n += 1
         self._n_blocks[slot] = n
 
+    def truncate(self, slot, rows: int):
+        """Roll the slot's table back to cover exactly ``rows`` logical
+        rows: every private tail block past ``ceil(rows / block_size)``
+        is dropped (unref-to-zero → back to the free heap — the exact
+        inverse of :meth:`ensure_capacity`'s growth, so ``num_free`` is
+        restored to what a never-grown slot would show). This is the
+        speculative-decode rollback primitive (README "Speculative
+        decoding"): a verify span appends draft K/V through the table
+        like a prefill chunk, and rejected drafts hand their blocks
+        straight back here.
+
+        Shared/donated prefix blocks are NEVER truncated: the keep
+        count is clamped at the slot's installed-prefix length, so a
+        ``rows`` that would reach into trie-owned blocks only drops the
+        private tail (their trie pins — and every other reader's — are
+        untouched; the engine releases its own read pins separately at
+        retirement). Rows inside kept blocks past ``rows`` hold stale
+        K/V, which the attention programs mask by length and the next
+        append overwrites — same invariant as a freed slot's rows.
+
+        ``lengths[slot]`` is clamped down to ``rows`` when it exceeds
+        it (the engine normally re-sets it to the exact accepted length
+        right after). No device work: the pool arrays are untouched.
+        """
+        keep = max(-(-int(rows) // self.block_size),
+                   int(self._n_shared[slot]))
+        n = int(self._n_blocks[slot])
+        for j in range(keep, n):
+            self.pool.drop(int(self.tables[slot, j]))
+            self.tables[slot, j] = self.sentinel
+        if keep < n:
+            self._n_blocks[slot] = keep
+        if int(self.lengths[slot]) > int(rows):
+            self.lengths[slot] = int(rows)
+
     def slot_block_ids(self, slot):
         """Physical block ids populating the slot's table, in logical
         order — the donation candidates at retirement."""

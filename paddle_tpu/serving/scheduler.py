@@ -136,6 +136,25 @@ class FIFOScheduler:
             self._plan_carry = min(budget, int(align))
         return plan
 
+    def spec_grants(self, wants, budget):
+        """Per-slot DRAFT-token grants for a speculative verify step
+        (README "Speculative decoding"): each running slot's verify
+        span spends ``1 + grant`` positions of the step's packed token
+        buffer, and the drafts share that buffer's headroom with the
+        prefill-chunk grant — ``budget`` is whatever the chunk plan
+        left. Greedy in the given order (the engine passes slot order:
+        deterministic, stable across steps, so acceptance statistics
+        are never reshuffled by admission churn); each grant is capped
+        at its row's request. Returns a list aligned with ``wants``.
+        """
+        b = max(int(budget), 0)
+        grants = []
+        for want in wants:
+            g = min(max(int(want), 0), b)
+            grants.append(g)
+            b -= g
+        return grants
+
     def admissions(self, num_free: int, hit_len_fn=None):
         """Sequences to admit this step (pops up to ``num_free``).
 

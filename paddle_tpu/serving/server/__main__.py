@@ -82,6 +82,16 @@ def main(argv=None):
                          "decode-steps' worth of measured throughput to "
                          "prefill chunks per step (unified step only; "
                          "0 pins the fixed prefill-chunk cap)")
+    ap.add_argument("--spec-decode", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="speculative multi-token decode (paged only): "
+                         "a prompt-lookup n-gram drafter proposes up to "
+                         "--spec-k tokens per slot, one ragged-span "
+                         "verify scores them, rejected KV rolls back by "
+                         "block-tail truncation; streams byte-identical "
+                         "to speculation off")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="max draft tokens per verify span")
     ap.add_argument("--watchdog-deadline", type=float, default=30.0,
                     help="supervised driver: a step slower than this "
                          "(seconds) is classified hung and the engine is "
@@ -107,6 +117,7 @@ def main(argv=None):
         paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
         ragged_step=args.ragged_step,
         headroom_mult=args.headroom_mult or None,
+        spec_decode=args.spec_decode, spec_k=args.spec_k,
         watchdog_deadline_s=args.watchdog_deadline or None,
         max_restarts=args.max_restarts,
         log_fn=None if args.quiet else
@@ -123,6 +134,8 @@ def main(argv=None):
                       # report what actually runs: the dense engine
                       # ignores --ragged-step
                       "ragged_step": server.gateway.engine.ragged_step,
+                      "spec_decode": server.gateway.engine.spec_decode,
+                      "spec_k": server.gateway.engine.spec_k,
                       "watchdog_deadline_s":
                       server.gateway.watchdog_deadline_s,
                       "max_restarts": server.gateway.max_restarts,

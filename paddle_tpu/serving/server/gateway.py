@@ -73,7 +73,8 @@ import weakref
 
 import numpy as np
 
-from ...profiler.metrics import STEP_BUCKETS, TTFT_BUCKETS, MetricsRegistry
+from ...profiler.metrics import (SPEC_ACCEPT_BUCKETS, STEP_BUCKETS,
+                                 TTFT_BUCKETS, MetricsRegistry)
 from ..faults import TransientFault
 
 
@@ -340,6 +341,37 @@ class ServingGateway:
                 "(prefill_chunk is the cap; fixed at it until the "
                 "EWMAs have signal or with adaptivity off).").set_fn(
             lambda: self.engine.stats["headroom"])
+        # speculative-decode surface (README "Speculative decoding"):
+        # registered only on a speculative engine, read THROUGH
+        # self.engine so a recovery rebuild re-binds them (same idiom
+        # as the paged/prefix gauges below). Counters are engine-stat
+        # backed: a rebuild resets them, which Prometheus counter
+        # semantics absorb.
+        self._m_spec_len = None
+        if getattr(self.engine, "spec_decode", False):
+            r.counter("serving_spec_proposed_total",
+                      "Draft tokens submitted to verification."
+                      ).set_fn(lambda: self.engine.stats["spec_proposed"])
+            r.counter("serving_spec_accepted_total",
+                      "Draft tokens accepted (emitted without their own "
+                      "decode launch) — the speculation win.").set_fn(
+                lambda: self.engine.stats["spec_accepted"])
+            self._m_spec_len = r.histogram(
+                "serving_spec_accept_length",
+                "Tokens emitted per verify span (1 = nothing accepted, "
+                "spec_k + 1 = full draft accepted).",
+                buckets=SPEC_ACCEPT_BUCKETS)
+            # numerator is decode_calls, NOT spec_steps: a spec engine
+            # increments decode_calls only for launches that carried
+            # verify rows, while spec_steps also counts chunk-only
+            # launches whose tokens never enter spec_tokens — those
+            # would inflate a ratio defined over decode work
+            r.gauge("serving_spec_launches_per_accepted_token",
+                    "Decode launches per emitted token under "
+                    "speculation (1.0 = no speedup; ~1 / mean "
+                    "acceptance length).").set_fn(
+                lambda: (self.engine.stats["decode_calls"]
+                         / max(self.engine.stats["spec_tokens"], 1)))
         # fault-tolerance surface (README "Fault tolerance & chaos
         # testing"). Gateway-owned counters, NOT engine-stat-backed:
         # engine stats die with a rebuilt engine, and a restart must
@@ -610,6 +642,14 @@ class ServingGateway:
             self.restart_latencies.append(self._clock() - self._fault_at)
             self._fault_at = None
         self._m_step_dur.observe(self.engine.stats["last_step_duration_s"])
+        if self._m_spec_len is not None:
+            # drain the step's per-span acceptance lengths into the
+            # histogram (driver thread is the only reader/writer)
+            lens = self.engine.stats["spec_last_accept"]
+            if lens:
+                for m in lens:
+                    self._m_spec_len.observe(m)
+                self.engine.stats["spec_last_accept"] = []
 
     def _classify(self, exc) -> str:
         if isinstance(exc, WatchdogTimeout):

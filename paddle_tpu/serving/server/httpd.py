@@ -306,7 +306,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           prefix_cache=False, prefix_blocks=None, prefix_block_size=32,
           paged_attn=True, prefill_chunk=512, ragged_step=True,
           headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
-          fault_hook=None, clock=None):
+          fault_hook=None, clock=None, spec_decode=False, spec_k=4,
+          drafter=None):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -352,6 +353,17 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     ``/metrics`` grows ``serving_faults_total{kind}``,
     ``serving_engine_restarts_total``, ``serving_preemptions_total``
     and ``serving_recovered_requests_total``.
+
+    ``spec_decode=True`` (paged only, default OFF) turns on
+    speculative multi-token decode (README "Speculative decoding"):
+    ``spec_k`` bounds the draft length, ``drafter`` overrides the
+    default prompt-lookup :class:`~..drafter.NgramDrafter` (the one
+    instance is shared by every engine rebuild — drafters are
+    stateless policy). Token streams are byte-identical to
+    speculation off; ``/metrics`` grows
+    ``serving_spec_proposed_total`` / ``serving_spec_accepted_total``,
+    the ``serving_spec_accept_length`` histogram and the
+    ``serving_spec_launches_per_accepted_token`` gauge.
     """
     from ..engine import ContinuousBatchingEngine
 
@@ -367,6 +379,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             prefix_block_size=prefix_block_size,
             paged_attn=paged_attn, prefill_chunk=prefill_chunk,
             ragged_step=ragged_step, headroom_mult=headroom_mult,
+            spec_decode=spec_decode, spec_k=spec_k, drafter=drafter,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
     gateway = ServingGateway(

@@ -79,24 +79,24 @@ def _clone(r):
                              seed=r.seed)
 
 
-def _factory(model, s_max):
+def _factory(model, s_max, spec=False):
     from paddle_tpu.serving import ContinuousBatchingEngine
 
     def factory():
         return ContinuousBatchingEngine(
             model, num_slots=NUM_SLOTS, max_seq_len=s_max, decode_chunk=1,
             prefix_cache=True, prefix_block_size=BLOCK_SIZE,
-            prefill_chunk=CHUNK,
+            prefill_chunk=CHUNK, spec_decode=spec,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     return factory
 
 
 def _run_gateway(model, s_max, reqs, plan=None, clock=None,
-                 watchdog_deadline_s=None):
+                 watchdog_deadline_s=None, spec=False):
     """Submit the whole workload, then start the supervised driver and
     drain. Returns (streams, finish_reasons, gateway)."""
     from paddle_tpu.serving.server import ServingGateway
-    factory = _factory(model, s_max)
+    factory = _factory(model, s_max, spec=spec)
     gw = ServingGateway(factory(), engine_factory=factory,
                         max_queue=len(reqs) + 4, fault_hook=plan,
                         clock=clock, watchdog_deadline_s=watchdog_deadline_s,
@@ -180,6 +180,25 @@ def measure_chaos(quick=True):
         "streams_identical": hstreams == base_streams,
         "engine_restarts": hgw.restarts,
     }
+    # ---------------------------------------------- spec-enabled leg
+    # the same fault matrix with speculative decode ON: a fatal fault
+    # lands mid-speculation (unverified draft K/V in the pool) and
+    # recovery must still be byte-identical — restore() recomputes from
+    # ACCEPTED tokens only, and the rebuilt engine's fresh pool never
+    # sees the dead engine's draft rows
+    _run_gateway(model, s_max, reqs, spec=True)   # warm spec programs
+    splan = _chaos_plan()
+    sstreams, sreasons, sgw, _ = _run_gateway(
+        model, s_max, reqs, plan=splan, spec=True)
+    spec_res = {
+        "requests_lost": sum(1 for r in sreasons if r not in
+                             ("stop", "length", "cancelled", "timeout")),
+        "streams_identical": sstreams == base_streams,
+        "engine_restarts": sgw.restarts,
+        # the final engine incarnation's count (stats reset on rebuild)
+        "spec_accepted": sgw.engine.stats["spec_accepted"],
+        "faults_fired": [list(x) for x in splan.log],
+    }
     # ------------------------------------------------------ poison leg
     from paddle_tpu.serving import GenerationRequest
     rngp = np.random.RandomState(99)
@@ -202,20 +221,26 @@ def measure_chaos(quick=True):
         chaos["requests_lost"] == 0 and chaos["streams_identical"]
         and deterministic
         and hung["requests_lost"] == 0 and hung["streams_identical"]
+        and spec_res["requests_lost"] == 0
+        and spec_res["streams_identical"]
         and poison_res["poisoned_failed"] == 1
         and poison_res["poisoned_is_last"]
         and poison_res["bystanders_lost"] == 0
         and poison_res["bystander_streams_identical"])
     return {
-        "chaos": chaos, "hung": hung, "poison": poison_res,
+        "chaos": chaos, "hung": hung, "spec": spec_res,
+        "poison": poison_res,
         "deterministic": bool(deterministic),
         "requests": len(reqs),
         "accepted": accepted,
         "num_slots": NUM_SLOTS, "prefill_chunk": CHUNK,
         "block_size": BLOCK_SIZE,
         "fault_plan": "transient@3, pool@6, fatal@10, nan@15 over the "
-                      "mixed trace; hung@5 (virtual clock) and a "
-                      "request-pinned poison as separate legs",
+                      "mixed trace; hung@5 (virtual clock), the same "
+                      "matrix with spec_decode=True (fatal lands mid-"
+                      "speculation, recovery recomputes from accepted "
+                      "tokens only), and a request-pinned poison as "
+                      "separate legs",
         "clock_model": "streams/counters are deterministic (workload "
                        "submitted before the driver starts, plan-step "
                        "indexed faults); recovery_latency_s is the one "

@@ -133,7 +133,7 @@ def _mk_engine(model, num_slots, s_max, prefill_chunk):
     return ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_block_size=BLOCK_SIZE, prefill_chunk=prefill_chunk,
-        ragged_step=False,
+        ragged_step=False, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
 

@@ -102,7 +102,7 @@ def _mk_engine(model, num_slots, s_max):
     # ragged default must not silently drift the comparison
     return ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
-        ragged_step=False,
+        ragged_step=False, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
 

@@ -82,7 +82,7 @@ def _run(model, reqs, num_slots, s_max, paged):
     eng = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_cache=True, prefix_block_size=BLOCK_SIZE,
-        paged_attn=paged, ragged_step=False,
+        paged_attn=paged, ragged_step=False, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([_clone(r) for r in reqs])

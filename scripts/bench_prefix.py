@@ -72,7 +72,7 @@ def _run(model, reqs, num_slots, s_max, prefix_cache):
         # prefill-work reduction the committed PREFIX_BENCH.json
         # baselined (PR 3), which the paged default would silently
         # replace with the zero-copy hit path (bench_paged.py owns that)
-        paged_attn=False,
+        paged_attn=False, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([_clone(r) for r in reqs])

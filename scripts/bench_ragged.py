@@ -77,7 +77,7 @@ def _mk_engine(model, num_slots, s_max, ragged):
     return ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
         prefix_block_size=BLOCK_SIZE, prefill_chunk=CHUNK,
-        ragged_step=ragged, headroom_mult=None,
+        ragged_step=ragged, headroom_mult=None, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
 

@@ -62,7 +62,7 @@ def _run_direct(model, reqs, num_slots, s_max):
     # two-program engine; gateway overhead must be measured against it
     eng = ContinuousBatchingEngine(
         model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
-        ragged_step=False,
+        ragged_step=False, spec_decode=False,
         jit_cache=model.__dict__.setdefault("_serving_jit", {}))
     t0 = time.perf_counter()
     outs = eng.generate([replace(r) for r in reqs])

@@ -77,6 +77,7 @@ class TestBenchDriverFlow:
         assert art["paged_attn"]["ok"] is False
         assert art["chunked_prefill"]["ok"] is False
         assert art["ragged_step"]["ok"] is False
+        assert art["spec_decode"]["ok"] is False
         assert art["chaos"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
@@ -131,6 +132,13 @@ class TestBenchDriverFlow:
                                       "launches_saved_per_mixed_step": 1.0,
                                       "accepted": True,
                                       "tokens_equal": True}), ""
+            if leg == "--spec":
+                # speculative-decode leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "spec_decode", "ok": True,
+                                      "modeled_tok_s_ratio_repetitive":
+                                          2.3,
+                                      "accepted": True}), ""
             if leg == "--chaos":
                 # fault-tolerance leg: same hang-proof contract
                 assert env == {"JAX_PLATFORMS": "cpu"}
@@ -172,9 +180,10 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:7] == ["--decode-cb", "--serve-http",
+        assert order[:8] == ["--decode-cb", "--serve-http",
                              "--prefix-cache", "--paged-attn",
-                             "--chunked-prefill", "--ragged", "--chaos"]
+                             "--chunked-prefill", "--ragged", "--spec",
+                             "--chaos"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -185,6 +194,8 @@ class TestBenchDriverFlow:
         assert art["chunked_prefill"]["p95_ttft_ratio"] == 4.4
         assert art["ragged_step"]["accepted"] is True
         assert art["ragged_step"]["launches_saved_per_mixed_step"] == 1.0
+        assert art["spec_decode"]["accepted"] is True
+        assert art["spec_decode"]["modeled_tok_s_ratio_repetitive"] == 2.3
         assert art["chaos"]["accepted"] is True
         assert art["chaos"]["chaos"]["requests_lost"] == 0
         # the pallas attempt's forensic trail rides along with the success

@@ -203,6 +203,38 @@ class TestHistogram:
         # CPU steps land mid-ladder, not smeared into +Inf
         assert h.quantile(0.5) <= 0.025
 
+    def test_spec_accept_ladder_strict_parsed_integer_resolved(self):
+        """The serving_spec_accept_length ladder (SPEC_ACCEPT_BUCKETS)
+        — tokens emitted per speculative verify span — gives every
+        practical acceptance count (1 .. spec_k+1 for spec_k <= 5) its
+        own bucket, and a histogram on it renders valid under the
+        strict parser. The engine-level drain into this histogram is
+        pinned in tests/test_spec_decode.py."""
+        from paddle_tpu.profiler.metrics import (SPEC_ACCEPT_BUCKETS,
+                                                 MetricsRegistry)
+        assert SPEC_ACCEPT_BUCKETS[0] == 1.0   # nothing-accepted floor
+        assert list(SPEC_ACCEPT_BUCKETS) == sorted(SPEC_ACCEPT_BUCKETS)
+        assert set(SPEC_ACCEPT_BUCKETS[:6]) == {1, 2, 3, 4, 5, 6}
+        r = MetricsRegistry()
+        h = r.histogram("serving_spec_accept_length",
+                        "Tokens emitted per verify span.",
+                        buckets=SPEC_ACCEPT_BUCKETS)
+        for v in (1, 1, 4, 2):
+            h.observe(v)
+        fams = parse_prometheus(r.render())
+        name = "serving_spec_accept_length"
+        assert fams[name]["type"] == "histogram"
+        assert fams[name]["samples"][(name + "_count", ())] == 4
+        assert fams[name]["samples"][(name + "_sum", ())] == 8
+        bounds = {lbl[1] for key, lbls in fams[name]["samples"]
+                  if key == name + "_bucket" for lbl in lbls
+                  if lbl[0] == "le"}
+        assert len(bounds) == len(SPEC_ACCEPT_BUCKETS) + 1
+        # integer counts resolve exactly: the le="1" bucket holds only
+        # the nothing-accepted spans
+        assert fams[name]["samples"][
+            (name + "_bucket", (("le", "1"),))] == 2
+
     def test_empty_buckets_rejected(self):
         with pytest.raises(ValueError, match="at least one bucket"):
             Histogram("x", buckets=())
