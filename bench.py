@@ -229,10 +229,8 @@ def main_trace(idx):
     jax.profiler.start_trace(d)
     r = _measure_config(name, overrides, iters=4)
     jax.profiler.stop_trace()
-    from paddle_tpu.profiler.xplane import op_statistics
-    rows = op_statistics(d, device_only=True, top=12)
-    if not rows:  # CPU fallback: host plane carries the XLA ops
-        rows = op_statistics(d, device_only=False, top=12)
+    from paddle_tpu.profiler.xplane import op_statistics_with_fallback
+    rows, _ = op_statistics_with_fallback(d, top=12)
     print(json.dumps({"name": name, "mfu": r["mfu"],
                       "top_ops": [{"op": x["name"][:80],
                                    "total_ms": round(x["total_ms"], 3),
@@ -513,6 +511,14 @@ def watchdog():
     ch = _parse_result(rc, out)
     cb_extra["chaos"] = ch if ch is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Tracer-overhead leg: wall cost of the request-lifecycle tracer
+    # disabled (must be free) and enabled (scripts/bench_trace.py) —
+    # same hang-proof contract: CPU-forced, banked up front.
+    rc, out, err = _run([me, "--trace-overhead"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    to = _parse_result(rc, out)
+    cb_extra["trace_overhead"] = to if to is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -688,6 +694,13 @@ if __name__ == "__main__":
         from bench_chaos import measure_chaos
         print(json.dumps({"name": "chaos", "ok": True,
                           **measure_chaos(quick=True)}))
+        sys.exit(0)
+    if "--trace-overhead" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_trace import measure_trace_overhead
+        print(json.dumps({"name": "trace_overhead", "ok": True,
+                          **measure_trace_overhead(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -169,4 +169,6 @@ def load_profiler_result(path):
 
 
 from . import metrics  # noqa: E402
+from . import tracing  # noqa: E402
 from .metrics import MFUMeter  # noqa: E402
+from .tracing import SpanTracer  # noqa: E402

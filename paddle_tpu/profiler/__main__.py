@@ -1,0 +1,67 @@
+"""CLI entry point: ``python -m paddle_tpu.profiler <trace_dir>``.
+
+The XPlane parser (:mod:`paddle_tpu.profiler.xplane`) has existed since
+it started validating bench traces, but had no command-line surface —
+inspecting a ``jax.profiler`` trace directory meant an ad-hoc REPL
+session. This wires ``xplane.op_statistics`` / ``xplane.summarize`` to
+a command:
+
+    python -m paddle_tpu.profiler /tmp/profile_dir            # op table
+    python -m paddle_tpu.profiler /tmp/profile_dir --top 25
+    python -m paddle_tpu.profiler /tmp/profile_dir --json     # machine-readable
+
+Device planes (the XLA op timeline) are summarized by default; when a
+trace has none — CPU-backend traces put the ops on host planes — the
+CLI falls back to all planes automatically and says so (pass
+``--all-planes`` to start there). Exit status: 0 when events were
+parsed, 1 when the directory held no parseable trace (so scripts can
+gate on it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.profiler",
+        description="Per-op time aggregation over a jax.profiler "
+                    "(XPlane) trace directory.")
+    ap.add_argument("trace_dir",
+                    help="directory jax.profiler.start_trace wrote "
+                         "(searched recursively for *.xplane.pb)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows to report (0 = all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the op table as JSON instead of text")
+    ap.add_argument("--all-planes", action="store_true",
+                    help="aggregate host planes too (default: device "
+                         "planes only, with automatic fallback when a "
+                         "trace has none)")
+    args = ap.parse_args(argv)
+
+    from .xplane import op_statistics_with_fallback, summarize
+    device_only = not args.all_planes
+    if args.json:
+        rows, fell_back = op_statistics_with_fallback(
+            args.trace_dir, device_only=device_only, top=args.top)
+        print(json.dumps({"trace_dir": args.trace_dir,
+                          "device_only": device_only and not fell_back,
+                          "rows": rows}, indent=1))
+        return 0 if rows else 1
+    # text path: summarize owns the rendering AND the host-plane
+    # fallback, so the table format lives in exactly one place
+    out = summarize(args.trace_dir, top=args.top,
+                    device_only=device_only)
+    if out == "no device events parsed":
+        print("no events parsed (is this a jax.profiler trace "
+              "directory with *.xplane.pb files?)")
+        return 1
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

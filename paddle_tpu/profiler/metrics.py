@@ -324,6 +324,24 @@ TTFT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 STEP_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
 
+# time-per-output-token ladder (``serving_tpot_seconds``): the
+# steady-state decode cadence one request observes — (finish - first
+# token) / (tokens - 1). Sub-ms resolution at the bottom (a healthy
+# TPOT on real chips is single-digit ms; the CPU tiny models sit at
+# ~1-30 ms), a tail that separates a 100 ms-per-token crawl from a
+# seconds-per-token stall. This histogram is the SLO substrate the
+# multi-tenant scheduler's TPOT targets will read (ROADMAP item b).
+TPOT_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+# queue-wait ladder (``serving_queue_wait_seconds``): submit-to-slot
+# latency — the admission-control half of TTFT (TTFT = queue wait +
+# prefill). Same sub-ms-to-tens-of-seconds span as the TTFT ladder: an
+# uncontended admission is instant, a saturated waiting room is
+# seconds, and the top separates "waited a while" from "starved".
+QUEUE_WAIT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                      0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
 # speculative-decode acceptance-length ladder
 # (``serving_spec_accept_length``): tokens emitted per verify span —
 # integer-valued, 1 = nothing accepted (the guaranteed correction

@@ -160,8 +160,29 @@ def op_statistics(trace_dir: str, device_only: bool = True,
     return rows[:top] if top else rows
 
 
-def summarize(trace_dir: str, top: int = 10) -> str:
-    rows = op_statistics(trace_dir, top=top)
+def op_statistics_with_fallback(trace_dir: str, device_only: bool = True,
+                                top: int = 0):
+    """:func:`op_statistics` plus THE host-plane fallback rule in one
+    place: a device-only aggregation that finds nothing (CPU-backend
+    traces carry the XLA ops on host planes) retries over all planes.
+    Returns ``(rows, fell_back)``; both :func:`summarize` and the
+    ``python -m paddle_tpu.profiler`` CLI's ``--json`` branch call
+    this, so the rule cannot drift between the two outputs."""
+    rows = op_statistics(trace_dir, device_only=device_only, top=top)
+    if rows or not device_only:
+        return rows, False
+    rows = op_statistics(trace_dir, device_only=False, top=top)
+    return rows, bool(rows)
+
+
+def summarize(trace_dir: str, top: int = 10,
+              device_only: bool = True) -> str:
+    """Render the op table as text. Device planes by default, with the
+    shared host-plane fallback announced on the first line."""
+    rows, fell_back = op_statistics_with_fallback(
+        trace_dir, device_only=device_only, top=top)
+    note = "# no device planes in this trace; showing all planes\n" \
+        if fell_back else ""
     if not rows:
         return "no device events parsed"
     width = max(len(r["name"][:60]) for r in rows)
@@ -169,4 +190,4 @@ def summarize(trace_dir: str, top: int = 10) -> str:
     for r in rows:
         lines.append(f"{r['name'][:60]:<{width}}  {r['total_ms']:8.3f}  "
                      f"{r['count']:5d}  {r['avg_us']:7.1f}")
-    return "\n".join(lines)
+    return note + "\n".join(lines)

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..profiler.tracing import NULL_SPAN
 from .decode import build_decode_steps_fn, build_paged_decode_steps_fn, \
     build_paged_suffix_prefill_fn, build_prefill_fn, build_ragged_step_fn, \
     build_suffix_prefill_fn, llama_decode_params
@@ -318,6 +319,13 @@ class ContinuousBatchingEngine:
                                else float(headroom_mult))
         self._clock = step_clock if step_clock is not None \
             else time.perf_counter
+        # the current step's start reading of step_clock: SLO stamps
+        # (t_admitted/t_first_token/t_finish) quantize to it instead of
+        # reading the clock again — step() must read its clock exactly
+        # twice per step (start + end), a contract the deterministic
+        # benches and the injected-tick-clock tests rely on. Step
+        # granularity is exactly the resolution those latencies have.
+        self._stamp_t = None
         # headroom EWMAs (the adaptive chunk budget's inputs): measured
         # unified-step tokens/second, and the duration of decode-only
         # steps (the latency baseline chunk work must not stretch past
@@ -353,6 +361,12 @@ class ContinuousBatchingEngine:
         # Whatever it raises propagates to the driver — except
         # PoolExhausted, which the step loop repairs by preemption.
         self.fault_hook = None
+        # request-lifecycle tracer (profiler/tracing.py, README
+        # "Tracing & debugging"): None in production; the gateway
+        # installs one (and re-installs it on every rebuilt engine).
+        # Every instrumentation site guards on _tr() — one attribute
+        # check when tracing is off, so the hot path pays nothing.
+        self.tracer = None
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
         # moment the host sees it; on_finish(seq) fires exactly once per
@@ -361,6 +375,39 @@ class ContinuousBatchingEngine:
         # thread driving step() — keep them cheap and non-reentrant.
         self.on_token = None
         self.on_finish = None
+
+    # ------------------------------------------------------------- tracing
+    def _tr(self):
+        """The active tracer, or None — THE guard every trace site
+        uses, so a disabled tracer costs one attribute check and no
+        event-arg construction."""
+        t = self.tracer
+        return t if (t is not None and t.enabled) else None
+
+    def _stamp_now(self):
+        """Timestamp for the Sequence SLO stamps: the current step's
+        start reading while inside a step (no extra clock reads — see
+        ``_stamp_t``), a fresh reading outside one (submit/cancel)."""
+        return self._stamp_t if self._stamp_t is not None \
+            else self._clock()
+
+    def _trace_phase_end(self, tr, seq, args=None):
+        """Close the sequence's current lifecycle span (named by its
+        ``trace_phase``: queued|prefill|decode|preempted|recovered)
+        on the request's trace lane and restart the mark."""
+        tr.complete(seq.trace_phase, seq.trace_mark,
+                    tid=tr.req_tid(seq.request_id), args=args)
+        seq.trace_mark = tr.now()
+
+    def _tspan(self, name, args=None):
+        """Engine-lane span context manager, or a shared no-op when
+        tracing is off. Convenience for the prefill paths; the
+        per-step hot sites use explicit ``_tr()`` guards so the
+        disabled path never builds an args dict."""
+        tr = self._tr()
+        if tr is None:
+            return NULL_SPAN
+        return tr.span(name, args=args)
 
     # ------------------------------------------------------------ programs
     def _fn_consts(self):
@@ -537,6 +584,10 @@ class ContinuousBatchingEngine:
                     if request.timeout_s is not None else None)
         seq = Sequence(request, key=self._key_for(request),
                        submit_step=self.stats["steps"], deadline=deadline)
+        seq.t_submit = self._clock()
+        tr = self._tr()
+        if tr is not None:
+            seq.trace_mark = tr.now()
         self.scheduler.submit(seq)
         return seq
 
@@ -589,6 +640,19 @@ class ContinuousBatchingEngine:
         its slot (and zero-copy-installs any matched chain) now, enters
         the PREFILLING state, and the step loop feeds it to the suffix
         program one budgeted chunk at a time."""
+        tr = self._tr()
+        for seq in seqs:
+            if tr is not None:
+                # close each admitted sequence's waiting span (named by
+                # the phase that just ended: queued, or preempted/
+                # recovered for a readmission) on its request lane
+                self._trace_phase_end(
+                    tr, seq,
+                    args={"prefix_hit_tokens": seq.prefix_hit_tokens})
+            # the phase NAME tracks state even with tracing off (one
+            # attr store): a capture window opened mid-flight must
+            # close this request's next span under the right name
+            seq.trace_phase = "prefill"
         cold, hits = [], []
         for seq in seqs:
             # the lookup already ran (and pinned) in _admission_hit_len
@@ -623,6 +687,8 @@ class ContinuousBatchingEngine:
         seq.prefilled = covered
         self.cache.lengths[slot] = covered
         seq.status = "prefilling"
+        if seq.t_admitted is None:      # first claim only: queue wait
+            seq.t_admitted = self._stamp_now()  # kept across restore
         self._slots[slot] = seq
         self.scheduler.enter_prefill(seq)
 
@@ -644,10 +710,12 @@ class ContinuousBatchingEngine:
                 temps[i] = float(seq.request.temperature)
                 topks[i] = int(seq.request.top_k)
                 keys[i] = np.asarray(seq.key)
-            pk, pv, tok0s, keys2 = self._prefill_fn()(
-                self._params, jnp.asarray(ids), lens, jnp.asarray(keys),
-                temps, topks)
-            tok0s = np.asarray(tok0s)
+            with self._tspan("prefill_launch",
+                             args={"bucket": s_pad, "group": G}):
+                pk, pv, tok0s, keys2 = self._prefill_fn()(
+                    self._params, jnp.asarray(ids), lens,
+                    jnp.asarray(keys), temps, topks)
+                tok0s = np.asarray(tok0s)
             for i, seq in enumerate(group):
                 slot = self.cache.alloc()
                 seq.slot = slot   # before the write: a PoolExhausted
@@ -749,12 +817,15 @@ class ContinuousBatchingEngine:
                 topks[i] = int(seq.request.top_k)
         kv = ((self.cache.pool.k, self.cache.pool.v) if self._paged
               else (self.cache.k, self.cache.v))
-        nk, nv, tok0s, keys2 = self._suffix_fn()(
-            self._params, *kv, jnp.asarray(addr),
-            jnp.asarray(prefix_lens), jnp.asarray(ids),
-            jnp.asarray(suf_lens), jnp.asarray(keys), temps, topks)
-        self.cache.update(nk, nv)
-        return np.asarray(tok0s), keys2
+        with self._tspan("prefill_launch",
+                         args={"bucket": s_pad, "group": len(rows)}):
+            nk, nv, tok0s, keys2 = self._suffix_fn()(
+                self._params, *kv, jnp.asarray(addr),
+                jnp.asarray(prefix_lens), jnp.asarray(ids),
+                jnp.asarray(suf_lens), jnp.asarray(keys), temps, topks)
+            self.cache.update(nk, nv)
+            tok0s = np.asarray(tok0s)
+        return tok0s, keys2
 
     def _run_prefill_chunks(self, plan, finished):
         """Run this step's budgeted slice of the chunked-prefill
@@ -795,6 +866,16 @@ class ContinuousBatchingEngine:
         slot, end = seq.slot, seq.prefilled + n
         self.stats["prefill_chunks"] += 1
         self.stats["chunk_tokens"] += n
+        tr = self._tr()
+        if tr is not None:
+            # one lifecycle span per chunk on the request's lane:
+            # prefill_chunk[i] from the previous mark (admission or the
+            # prior chunk) to this chunk's host completion
+            tr.complete(f"prefill_chunk[{seq.trace_chunk_i}]",
+                        seq.trace_mark, tid=tr.req_tid(seq.request_id),
+                        args={"tokens": n, "offset": seq.prefilled})
+            seq.trace_mark = tr.now()
+            seq.trace_chunk_i += 1
         self.cache.lengths[slot] = end
         seq.prefilled = end
         if end == seq.work_len:             # work content complete
@@ -824,6 +905,14 @@ class ContinuousBatchingEngine:
         req = seq.request
         seq.slot = slot
         seq.status = "running"
+        if seq.t_admitted is None:      # first claim only: queue wait
+            seq.t_admitted = self._stamp_now()  # kept across restore
+        tr = self._tr()
+        if tr is not None:
+            self._trace_phase_end(
+                tr, seq, args={"prefix_hit_tokens": seq.prefix_hit_tokens,
+                               "restored": bool(seq.restore_point)})
+        seq.trace_phase = "decode"      # tracked even with tracing off
         self._slots[slot] = seq
         self._temps[slot] = float(req.temperature)
         self._topks[slot] = int(req.top_k)
@@ -856,6 +945,15 @@ class ContinuousBatchingEngine:
             self.scheduler.leave_prefill(seq)
         seq.status = "finished"
         seq.finish_reason = reason
+        seq.t_finish = self._stamp_now()
+        tr = self._tr()
+        if tr is not None:
+            args = {"finish_reason": reason, "tokens": len(seq.tokens)}
+            if seq.trace_accepts:
+                args["accept_lens"] = list(seq.trace_accepts)
+            self._trace_phase_end(tr, seq, args=args)
+            tr.instant("finished", tid=tr.req_tid(seq.request_id),
+                       args={"finish_reason": reason})
         slot = seq.slot
         if slot is not None and self._slots[slot] is seq:
             self._slots[slot] = None
@@ -891,15 +989,17 @@ class ContinuousBatchingEngine:
         cache (it would be appended by the decode tick that never ran),
         and a mid-prefill teardown has only ``prefilled`` valid rows."""
         if self.prefix_cache is not None and self._paged:
-            written = int(self.cache.lengths[slot])
-            content = seq.prompt if not seq.tokens else np.concatenate(
-                [seq.prompt, np.asarray(seq.tokens, np.int32)])
-            donated = self.prefix_cache.publish_donate(
-                content[:written], self.cache.slot_block_ids(slot))
-            self.cache.free(slot, keep=donated)
+            with self._tspan("donate", args={"slot": slot}):
+                written = int(self.cache.lengths[slot])
+                content = seq.prompt if not seq.tokens else np.concatenate(
+                    [seq.prompt, np.asarray(seq.tokens, np.int32)])
+                donated = self.prefix_cache.publish_donate(
+                    content[:written], self.cache.slot_block_ids(slot))
+                self.cache.free(slot, keep=donated)
         elif self.prefix_cache is not None:
-            self.prefix_cache.publish(seq.prompt, slot, self.cache)
-            self.cache.free(slot)
+            with self._tspan("donate", args={"slot": slot}):
+                self.prefix_cache.publish(seq.prompt, slot, self.cache)
+                self.cache.free(slot)
         else:
             self.cache.free(slot)
 
@@ -919,6 +1019,8 @@ class ContinuousBatchingEngine:
             self._finish(seq, "timeout", finished)
 
     def _emit(self, seq, token):
+        if seq.t_first_token is None:
+            seq.t_first_token = self._stamp_now()
         if self.on_token is not None:
             self.on_token(seq, token)
 
@@ -945,6 +1047,9 @@ class ContinuousBatchingEngine:
         ``fault_hook`` raises other than PoolExhausted propagates to
         the driver (the gateway's supervisor)."""
         t0 = self._clock()
+        self._stamp_t = t0
+        tr = self._tr()
+        ts0 = tr.now() if tr is not None else None
         finished = []
         # deadline sweep BEFORE admission: an expired queued request
         # must never claim a slot (and a running one stops paying for
@@ -964,7 +1069,9 @@ class ContinuousBatchingEngine:
                         hit_len_fn=self._admission_hit_len
                         if self.prefix_cache is not None else None)
                     if admitted:
-                        self._admit_group(admitted, finished)
+                        with self._tspan("admit",
+                                         args={"n": len(admitted)}):
+                            self._admit_group(admitted, finished)
                 if self._spec:
                     step_tokens, had_chunks = self._spec_step(finished)
                 elif self._ragged:
@@ -980,7 +1087,8 @@ class ContinuousBatchingEngine:
                 self._abort_admission(admitted)
                 admitted = []
                 if not self._preempt_youngest():
-                    raise
+                    self._stamp_t = None    # leaving the step: stamps
+                    raise                   # must read a fresh clock
             except BaseException:
                 # ANY other failure escaping mid-admission (a real
                 # device/runtime error — the crash class the supervisor
@@ -988,9 +1096,16 @@ class ContinuousBatchingEngine:
                 # sequences in limbo: back to the queue they go, where
                 # crash recovery's snapshot can see them
                 self._abort_admission(admitted)
+                self._stamp_t = None
                 raise
         self.stats["steps"] += 1
         self._record_step(self._clock() - t0, step_tokens, had_chunks)
+        self._stamp_t = None
+        if tr is not None:
+            tr.complete("step", ts0,
+                        args={"step": self.stats["steps"] - 1,
+                              "tokens": step_tokens,
+                              "chunks": bool(had_chunks)})
         return finished
 
     # ----------------------------------------------------- fault recovery
@@ -1003,6 +1118,7 @@ class ContinuousBatchingEngine:
         included — ``free`` drops exactly the owned tail) and its
         prefix pins released, so ``num_free`` and the pool refcounts
         are exactly what they were before the attempt."""
+        tr = self._tr()
         for seq in sorted(seqs, key=lambda s: -s.queue_tick):
             if seq.status != "queued":
                 continue      # installed (running/prefilling) — keep
@@ -1014,6 +1130,15 @@ class ContinuousBatchingEngine:
                 if self._slots[seq.slot] is None:
                     self.cache.free(seq.slot)
                 seq.slot = None
+            if seq.trace_phase == "prefill":
+                # the admission this step ran was unwound: back to a
+                # fresh queued span (the aborted attempt stays visible
+                # as the closed span that preceded it)
+                if tr is not None:
+                    tr.instant("admission_aborted",
+                               tid=tr.req_tid(seq.request_id))
+                seq.trace_phase = "queued"
+                seq.trace_mark = tr.now() if tr is not None else None
             self.scheduler.requeue_front(seq)
 
     def _preempt_youngest(self) -> bool:
@@ -1038,6 +1163,13 @@ class ContinuousBatchingEngine:
         finish — consumers just see a pause."""
         self.stats["preemptions"] += 1
         slot = seq.slot
+        tr = self._tr()
+        if tr is not None:
+            self._trace_phase_end(
+                tr, seq, args={"preempted": True,
+                               "tokens": len(seq.tokens)})
+            tr.instant("preempted", tid=tr.req_tid(seq.request_id),
+                       args={"slot": slot})
         if seq.status == "prefilling":
             self.scheduler.leave_prefill(seq)
         if seq.tokens and seq.status == "running":
@@ -1058,6 +1190,7 @@ class ContinuousBatchingEngine:
             seq.prefix_nodes = []
         seq.slot = None
         self.restore(seq)
+        seq.trace_phase = "preempted"   # restore() named it "recovered"
 
     def restore(self, seq: Sequence) -> bool:
         """Re-enqueue a LIVE sequence for recovery-by-recompute (crash
@@ -1080,6 +1213,13 @@ class ContinuousBatchingEngine:
         seq.prefix_hit_tokens = 0
         seq.prefilled = 0
         seq.restore_point = len(seq.tokens)
+        tr = self._tr()
+        # the wait-until-readmission span: "recovered" (the gateway
+        # restoring onto a rebuilt engine lands here directly);
+        # _preempt renames its own restores to "preempted" right after
+        # this call. The name tracks state even with tracing off.
+        seq.trace_phase = "recovered"
+        seq.trace_mark = tr.now() if tr is not None else None
         if seq.tokens:
             seq.work = np.concatenate(
                 [seq.prompt, np.asarray(seq.tokens[:-1], np.int32)])
@@ -1154,6 +1294,8 @@ class ContinuousBatchingEngine:
         steps still fuse ``choose_num_steps`` ticks (the scan tail of
         the same program). Returns ``(tokens_processed, had_chunks)``
         for the headroom EWMAs."""
+        tr = self._tr()
+        tp0 = tr.now() if tr is not None else None
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._prefill_budget(),
@@ -1196,6 +1338,15 @@ class ContinuousBatchingEngine:
         chunk_rows, cursor = self._pack_chunk_rows(
             plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
             temps, topks)
+        if tr is not None:
+            # plan: admission already ran in step(); this is the chunk
+            # grant + span packing. launch: the one device program +
+            # the host transfer that fences it. host-accept: token/
+            # chunk bookkeeping (donate spans nest inside it).
+            tr.complete("plan", tp0,
+                        args={"rows": len(active), "chunks": len(plan),
+                              "fused_steps": n})
+            tl0 = tr.now()
         npk, npv, toks, keys_t0, keys_fin = self._ragged_fn(n)(
             self._params, self.cache.pool.k, self.cache.pool.v,
             jnp.asarray(self.cache.tables), jnp.asarray(ids),
@@ -1207,6 +1358,10 @@ class ContinuousBatchingEngine:
         toks_np = np.asarray(toks)          # [n, R]
         keys_t0_np = np.asarray(keys_t0)
         self.stats["unified_steps"] += 1
+        if tr is not None:
+            tr.complete("launch", tl0,
+                        args={"packed_tokens": cursor, "fused_steps": n})
+            th0 = tr.now()
         if active:
             # decode rows adopt the post-scan key walk; chunk/idle rows
             # keep their host-side key state (a final chunk adopts its
@@ -1240,6 +1395,10 @@ class ContinuousBatchingEngine:
                     self.stats["tokens_generated"] += 1
                     self._emit(seq, t)
                     self._maybe_finish(seq, finished)
+        if tr is not None:
+            tr.complete("host-accept", th0,
+                        args={"emitted": (n * len(active) if active
+                                          else 0)})
         return cursor + (n - 1) * len(active), bool(chunk_rows)
 
     def _pack_chunk_rows(self, plan, cursor, ids, seg, pos, qstart, qlen,
@@ -1301,6 +1460,8 @@ class ContinuousBatchingEngine:
         speculation instead of overflowing the compile geometry.
         Returns ``(tokens_processed, had_chunks)`` for the headroom
         EWMAs."""
+        tr = self._tr()
+        tp0 = tr.now() if tr is not None else None
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._prefill_budget(),
@@ -1360,6 +1521,11 @@ class ContinuousBatchingEngine:
         chunk_rows, cursor = self._pack_chunk_rows(
             plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
             temps, topks, sample_start=sample_start)
+        if tr is not None:
+            tr.complete("plan", tp0,
+                        args={"rows": len(active), "chunks": len(plan),
+                              "draft_tokens": int(sum(grants))})
+            tl0 = tr.now()
         npk, npv, toks, kwalk = self._spec_fn()(
             self._params, self.cache.pool.k, self.cache.pool.v,
             jnp.asarray(self.cache.tables), jnp.asarray(ids),
@@ -1371,6 +1537,10 @@ class ContinuousBatchingEngine:
         toks_np = np.asarray(toks)          # [spec_len, R]
         kwalk_np = np.asarray(kwalk)        # [spec_len, R, 2]
         self.stats["spec_steps"] += 1
+        if tr is not None:
+            tr.complete("launch", tl0,
+                        args={"packed_tokens": cursor})
+            th0 = tr.now()
         # chunk bookkeeping first — mirrors the unified-step order (a
         # final chunk adopts its walk-step-0 token/key, the same one
         # split as a one-shot prefill)
@@ -1414,6 +1584,11 @@ class ContinuousBatchingEngine:
                 self.stats["spec_accepted"] += m - 1
                 self.stats["spec_tokens"] += m
                 accept_lens.append(m)
+                if tr is not None and len(seq.trace_accepts) < 512:
+                    # per-request acceptance history, surfaced as the
+                    # decode span's args at retirement (bounded so a
+                    # very long decode cannot grow an unbounded list)
+                    seq.trace_accepts.append(m)
                 emitted_total += m
                 for t in emit:
                     seq.tokens.append(t)
@@ -1423,6 +1598,14 @@ class ContinuousBatchingEngine:
                 self._maybe_finish(seq, finished)
             self._keys = jnp.asarray(knp)
         self.stats["spec_last_accept"] = accept_lens
+        if tr is not None:
+            if verify_rows:
+                tr.instant("spec_accept",
+                           args={"accept_lens": list(accept_lens),
+                                 "proposed": [len(d) for _, _, d, _
+                                              in verify_rows]})
+            tr.complete("host-accept", th0,
+                        args={"emitted": emitted_total})
         return chunk_spend + emitted_total, bool(chunk_rows)
 
     def _two_program_step(self, finished):
@@ -1430,6 +1613,8 @@ class ContinuousBatchingEngine:
         the dense engine): at most one budgeted chunk call, then one
         fused decode call. Kept intact as the A/B baseline the unified
         step is pinned byte-identical against."""
+        tr = self._tr()
+        tp0 = tr.now() if tr is not None else None
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._chunk,
@@ -1443,6 +1628,17 @@ class ContinuousBatchingEngine:
                   if s is not None and s.status == "running"]
         if active:
             n = self.scheduler.choose_num_steps(active)
+        if tr is not None:
+            # emitted whether or not a decode call follows: a
+            # chunks-only step must still show its plan phase (the
+            # unified/spec paths emit plan unconditionally too). On
+            # this two-program path the span covers the chunk device
+            # calls as well — they ARE this engine's prefill plan.
+            tr.complete("plan", tp0,
+                        args={"rows": len(active), "chunks": len(plan),
+                              "fused_steps": n})
+            tl0 = tr.now()
+        if active:
             if self._paged:
                 # append-block on decode growth: a fused chunk of n
                 # ticks writes rows [len, len+n) per slot, so the table
@@ -1487,6 +1683,9 @@ class ContinuousBatchingEngine:
             self.cache.update(nk, nv)
             self._keys = keys
             toks_np = np.asarray(toks)  # [n, num_slots]
+            if tr is not None:
+                tr.complete("launch", tl0, args={"fused_steps": n})
+                th0 = tr.now()
             self.stats["decode_calls"] += 1
             self.stats["decode_steps"] += n
             self.stats["slot_steps"] += n * self.num_slots
@@ -1504,6 +1703,9 @@ class ContinuousBatchingEngine:
                     self.stats["tokens_generated"] += 1
                     self._emit(seq, t)
                     self._maybe_finish(seq, finished)
+            if tr is not None:
+                tr.complete("host-accept", th0,
+                            args={"emitted": n * len(active)})
         return chunk_tokens + n * len(active), bool(plan)
 
     def has_work(self) -> bool:

@@ -72,7 +72,10 @@ class Sequence:
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
                  "finish_reason", "slot", "key", "submit_step", "deadline",
                  "prefix_nodes", "prefix_hit_tokens", "prefilled",
-                 "work", "restore_point", "queue_tick")
+                 "work", "restore_point", "queue_tick",
+                 "t_submit", "t_admitted", "t_first_token", "t_finish",
+                 "trace_mark", "trace_phase", "trace_chunk_i",
+                 "trace_accepts")
 
     def __init__(self, request: GenerationRequest, key, submit_step=0,
                  deadline=None):
@@ -111,6 +114,54 @@ class Sequence:
         # admitted batch is suffix-sorted, so arrival order cannot be
         # reconstructed from it)
         self.queue_tick = None
+        # SLO latency stamps (engine step_clock basis — injectable, so
+        # chaos tests pin them deterministically): submit, FIRST slot
+        # claim (kept across preemption/recovery — queue wait measures
+        # the original admission), first streamed token, retirement.
+        # The derived ttft_s/tpot_s/queue_wait_s properties feed the
+        # gateway's serving_tpot_seconds / serving_queue_wait_seconds
+        # histograms and the /debug/requests table.
+        self.t_submit = None
+        self.t_admitted = None
+        self.t_first_token = None
+        self.t_finish = None
+        # request-lifecycle tracing state (profiler/tracing.py): the
+        # clock mark the current phase started at, the phase's span
+        # name (queued|prefill|decode|preempted|recovered), the chunk
+        # index for prefill_chunk[i] spans, and the per-verify-span
+        # acceptance lengths a speculative engine collects for the
+        # decode span's args. All None/0 cost when tracing is off.
+        self.trace_mark = None
+        self.trace_phase = "queued"
+        self.trace_chunk_i = 0
+        self.trace_accepts = []
+
+    # ------------------------------------------------------- SLO latencies
+    @property
+    def ttft_s(self):
+        """Submit-to-first-token seconds (None until the first token)."""
+        if self.t_submit is None or self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def queue_wait_s(self):
+        """Submit-to-slot-claim seconds (None until admitted)."""
+        if self.t_submit is None or self.t_admitted is None:
+            return None
+        return self.t_admitted - self.t_submit
+
+    @property
+    def tpot_s(self):
+        """Time-per-output-token: (finish - first token) / (n - 1),
+        the steady-state decode cadence this request observed. None
+        until finished, or with fewer than two tokens (a one-token
+        request has no inter-token gap)."""
+        if self.t_first_token is None or self.t_finish is None \
+                or len(self.tokens) < 2:
+            return None
+        return (self.t_finish - self.t_first_token) \
+            / (len(self.tokens) - 1)
 
     @property
     def done(self) -> bool:

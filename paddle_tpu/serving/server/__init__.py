@@ -9,16 +9,20 @@ Two layers (both stdlib-only):
   bounded-queue admission control, and graceful drain;
 - :mod:`.httpd` — :class:`ServingHTTPServer` / :func:`serve`, the
   OpenAI-style HTTP surface (``POST /v1/completions`` blocking + SSE,
-  ``GET /healthz``, ``GET /metrics`` in Prometheus text format).
+  ``GET /healthz``, ``GET /metrics`` in Prometheus text format, and
+  the debug surface: ``GET /debug/trace?steps=N`` Chrome-trace
+  capture + ``GET /debug/requests`` live request table — README
+  "Tracing & debugging").
 
 Run one with ``python -m paddle_tpu.serving.server`` (or
 ``scripts/serve.py``).
 """
 from .gateway import (GatewayClosedError, QueueFullError, ServingGateway,
-                      TokenStream, WatchdogTimeout)
+                      TokenStream, TraceBusyError, WatchdogTimeout)
 from .httpd import ServingHTTPServer, serve
 
 __all__ = [
     "ServingGateway", "TokenStream", "QueueFullError",
-    "GatewayClosedError", "WatchdogTimeout", "ServingHTTPServer", "serve",
+    "GatewayClosedError", "WatchdogTimeout", "TraceBusyError",
+    "ServingHTTPServer", "serve",
 ]

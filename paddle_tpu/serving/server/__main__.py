@@ -92,6 +92,16 @@ def main(argv=None):
                          "to speculation off")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="max draft tokens per verify span")
+    ap.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="record request-lifecycle/step-phase tracing "
+                         "from startup into the ring buffer (read it "
+                         "back with GET /debug/trace?steps=0); off = "
+                         "zero-cost until /debug/trace?steps=N opens a "
+                         "capture window")
+    ap.add_argument("--trace-buffer", type=int, default=65536,
+                    help="trace ring-buffer capacity in events (oldest "
+                         "dropped past it)")
     ap.add_argument("--watchdog-deadline", type=float, default=30.0,
                     help="supervised driver: a step slower than this "
                          "(seconds) is classified hung and the engine is "
@@ -118,6 +128,7 @@ def main(argv=None):
         ragged_step=args.ragged_step,
         headroom_mult=args.headroom_mult or None,
         spec_decode=args.spec_decode, spec_k=args.spec_k,
+        trace=args.trace, trace_buffer=args.trace_buffer,
         watchdog_deadline_s=args.watchdog_deadline or None,
         max_restarts=args.max_restarts,
         log_fn=None if args.quiet else
@@ -136,11 +147,17 @@ def main(argv=None):
                       "ragged_step": server.gateway.engine.ragged_step,
                       "spec_decode": server.gateway.engine.spec_decode,
                       "spec_k": server.gateway.engine.spec_k,
+                      # report what actually runs: whether the tracer
+                      # is RECORDING now (the persistent --trace mode)
+                      # and the effective ring capacity
+                      "trace": server.gateway.tracer.enabled,
+                      "trace_buffer": server.gateway.tracer.capacity,
                       "watchdog_deadline_s":
                       server.gateway.watchdog_deadline_s,
                       "max_restarts": server.gateway.max_restarts,
                       "endpoints": ["/v1/completions", "/healthz",
-                                    "/metrics"]}), flush=True)
+                                    "/metrics", "/debug/trace",
+                                    "/debug/requests"]}), flush=True)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

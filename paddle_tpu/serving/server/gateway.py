@@ -73,13 +73,21 @@ import weakref
 
 import numpy as np
 
-from ...profiler.metrics import (SPEC_ACCEPT_BUCKETS, STEP_BUCKETS,
-                                 TTFT_BUCKETS, MetricsRegistry)
+from ...profiler.metrics import (QUEUE_WAIT_BUCKETS, SPEC_ACCEPT_BUCKETS,
+                                 STEP_BUCKETS, TPOT_BUCKETS, TTFT_BUCKETS,
+                                 MetricsRegistry)
+from ...profiler.tracing import TID_GATEWAY, SpanTracer
 from ..faults import TransientFault
 
 
 class QueueFullError(RuntimeError):
     """Waiting room at capacity — shed load (HTTP 429)."""
+
+
+class TraceBusyError(RuntimeError):
+    """A step-bounded trace capture is already in progress (HTTP 409) —
+    captures serialize so two debuggers cannot clear each other's
+    buffer mid-window."""
 
 
 class GatewayClosedError(RuntimeError):
@@ -214,7 +222,8 @@ class ServingGateway:
                  watchdog_deadline_s=None, max_transient_retries=3,
                  retry_backoff_s=0.02, max_restarts=8,
                  transient_types=(TransientFault,), clock=None,
-                 fault_hook=None):
+                 fault_hook=None, tracer=None, trace=False,
+                 trace_buffer=65536):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.idle_wait_s = float(idle_wait_s)
@@ -250,6 +259,24 @@ class ServingGateway:
         self._probation = set()   # ids readmitted by the last recovery
         self._suspect_ids = None  # active bisection half (None = off)
         self._parked = []         # Sequences held out of the engine
+        # ------------------------------------------------ tracing state
+        # (README "Tracing & debugging") the gateway OWNS the tracer so
+        # one timeline survives engine rebuilds; it is installed on
+        # every engine incarnation. trace=True records from startup
+        # (the --trace flag); otherwise the tracer sits disabled —
+        # zero-cost — until /debug/trace?steps=N opens a capture
+        # window via capture_trace().
+        self.tracer = tracer if tracer is not None else \
+            SpanTracer(capacity=trace_buffer, clock=self._clock)
+        #: public: whether tracing records continuously (``--trace``) —
+        #: the HTTP layer keys its /debug/trace default on it (a
+        #: parameterless GET must SNAPSHOT a persistent buffer, never
+        #: clear hours of history)
+        self.trace_persistent = bool(trace)
+        if self.trace_persistent:
+            self.tracer.enable()
+        self._capture = None        # {"remaining": n, "done": Event}
+        engine.tracer = self.tracer
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         if fault_hook is not None:
@@ -298,6 +325,24 @@ class ServingGateway:
         self._m_latency = r.histogram(
             "serving_request_latency_seconds",
             "Submit-to-finish latency per request.")
+        # SLO substrate (ROADMAP multi-tenant item b): per-request
+        # latency decomposition the TTFT/TPOT-target scheduler will
+        # consume. Both are gateway-owned and read the Sequence's
+        # engine-clock stamps at retirement, so they survive engine
+        # rebuilds and keep accumulating across restarts.
+        self._m_tpot = r.histogram(
+            "serving_tpot_seconds",
+            "Per-request time-per-output-token: (finish - first token)"
+            " / (tokens - 1), the steady-state decode cadence one "
+            "request observed (engine clock; requests with a single "
+            "token have no inter-token gap and are not observed).",
+            buckets=TPOT_BUCKETS)
+        self._m_queue_wait = r.histogram(
+            "serving_queue_wait_seconds",
+            "Per-request submit-to-slot-claim wait (engine clock) — "
+            "the admission-control half of TTFT. Never-admitted "
+            "requests (queued timeout/cancel) are not observed.",
+            buckets=QUEUE_WAIT_BUCKETS)
         self._rate = _RateWindow()
         r.gauge("serving_queue_depth",
                 "Requests waiting for a slot (intake + scheduler queue)."
@@ -506,6 +551,15 @@ class ServingGateway:
         owed its terminal event."""
         stream = self._live.pop(seq.request_id, None)
         self._m_finished.inc(reason=seq.finish_reason)
+        # SLO decomposition from the Sequence's engine-clock stamps
+        # (None-guarded: a queued timeout was never admitted, a
+        # one-token request has no TPOT)
+        qw = seq.queue_wait_s
+        if qw is not None:
+            self._m_queue_wait.observe(qw)
+        tp = seq.tpot_s
+        if tp is not None:
+            self._m_tpot.observe(tp)
         # quarantine bookkeeping: any terminal outcome clears suspicion
         self._probation.discard(seq.request_id)
         if self._suspect_ids is not None:
@@ -575,6 +629,7 @@ class ServingGateway:
     def _run(self):
         try:
             while True:
+                self._arm_capture()
                 self._admit_intake()
                 self._apply_cancels()
                 self._sweep_parked_deadlines()
@@ -637,6 +692,7 @@ class ServingGateway:
             return
         self._last_step_done = self._clock()
         self._transient_streak = 0
+        self._tick_capture()
         if self._fault_at is not None:
             # first completed step on the rebuilt engine: recovery done
             self.restart_latencies.append(self._clock() - self._fault_at)
@@ -661,6 +717,11 @@ class ServingGateway:
     def _on_fault(self, exc):
         kind = self._classify(exc)
         self._m_faults.inc(kind=kind)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "fault", tid=TID_GATEWAY,
+                args={"kind": kind, "error": type(exc).__name__,
+                      "message": str(exc)[:200]})
         if self._fault_at is None:
             self._fault_at = self._clock()
         if kind == "transient":
@@ -683,6 +744,8 @@ class ServingGateway:
         decides who re-enters now, who parks, and (once isolated) who
         is failed as the culprit."""
         self._recovering = True
+        tr = self.tracer if self.tracer.enabled else None
+        tr0 = tr.now() if tr is not None else None
         old = self.engine
         self._preempt_base += old.stats["preemptions"]
         # best-effort PRNG-walk snapshot: per-slot current keys, so
@@ -703,16 +766,26 @@ class ServingGateway:
         new = self.engine_factory()
         new.on_token = self._on_token
         new.on_finish = self._on_finish
+        new.tracer = self.tracer     # one timeline across incarnations
         if self._fault_hook is not None:
             new.fault_hook = self._fault_hook
         self.engine = new
         self._restarts += 1
         self._m_restarts.inc()
         readmit, culprit = self._quarantine_plan(live)
+        recovered = 0
         for s in readmit + queued:
             if new.restore(s):
                 self._m_recovered.inc()
+                recovered += 1
         self._probation = {s.request_id for s in readmit + queued}
+        if tr is not None:
+            tr.complete("rebuild", tr0, tid=TID_GATEWAY,
+                        args={"restarts": self._restarts,
+                              "live": len(live), "queued": len(queued)})
+            tr.instant("recovery", tid=TID_GATEWAY,
+                       args={"recovered": recovered,
+                             "parked": len(self._parked)})
         if culprit is not None:
             self._fail_poisoned(culprit)
         self._recovering = False
@@ -751,6 +824,11 @@ class ServingGateway:
         active, benched = suspects[:half], suspects[half:]
         self._parked.extend(benched)
         self._suspect_ids = {s.request_id for s in active}
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "bisection", tid=TID_GATEWAY,
+                args={"verdict": "halved", "active": len(active),
+                      "parked": len(benched)})
         return bystanders + active, None
 
     def _advance_bisection(self):
@@ -768,6 +846,11 @@ class ServingGateway:
         half = (len(self._parked) + 1) // 2
         batch, self._parked = self._parked[:half], self._parked[half:]
         batch = [s for s in batch if not s.done]
+        if batch and self.tracer.enabled:
+            self.tracer.instant(
+                "bisection", tid=TID_GATEWAY,
+                args={"verdict": "reenter", "reentered": len(batch),
+                      "parked": len(self._parked)})
         for s in batch:
             if self.engine.restore(s):
                 self._m_recovered.inc()
@@ -781,13 +864,173 @@ class ServingGateway:
         a terminal error event, blocking a JSON 500."""
         seq.status = "finished"
         seq.finish_reason = "error"
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "bisection", tid=TID_GATEWAY,
+                args={"verdict": "poisoned",
+                      "request_tid": self.tracer.req_tid(seq.request_id)})
+            self.tracer.instant("finished",
+                                tid=self.tracer.req_tid(seq.request_id),
+                                args={"finish_reason": "error"})
         stream = self._finish_teardown(seq)
         if stream is not None:
             stream._push_error(
                 "poisoned request: engine fault recurred pinned to this "
                 "request; bystanders recovered")
 
+    # ----------------------------------------------------- trace capture
+    def _arm_capture(self):
+        """Driver-side capture start: a pending window opens at a STEP
+        BOUNDARY (top of the driver loop), never mid-step — so every
+        step the countdown charges was recorded from its first event
+        and the capture holds exactly the asked-for step spans. Arming
+        runs under the gateway lock so it cannot race the handler's
+        timeout cleanup — an orphaned window must never enable the
+        tracer with nobody left to read or stop it."""
+        if self._capture is None:       # lock-free fast path
+            return
+        with self._lock:
+            cap = self._capture
+            if cap is None or cap["armed"]:
+                return
+            self.tracer.clear()
+            self.tracer.enable()
+            cap["armed"] = True
+
+    def _tick_capture(self):
+        """Driver-side capture countdown: called after every completed
+        supervised step. When the requested window closes, recording
+        stops (unless tracing is persistent) so the capture holds
+        exactly the asked-for steps, and the waiting handler wakes.
+        Locked for the same reason as :meth:`_arm_capture`; the
+        no-capture fast path stays one attribute check."""
+        if self._capture is None:       # lock-free fast path
+            return
+        with self._lock:
+            cap = self._capture
+            if cap is None or not cap["armed"]:
+                return
+            cap["remaining"] -= 1
+            if cap["remaining"] <= 0:
+                if not self.trace_persistent:
+                    self.tracer.disable()
+                cap["done"].set()
+
+    def capture_trace(self, steps=32, timeout_s=30.0):
+        """Capture ``steps`` engine steps of trace and return the
+        Chrome trace document (the ``GET /debug/trace`` body).
+
+        ``steps <= 0`` snapshots the current buffer without touching
+        recording state — the natural read when tracing is persistent
+        (``trace=True`` / ``--trace``). Otherwise the buffer is
+        cleared, recording turns on, and the call blocks until the
+        driver completes ``steps`` steps or ``timeout_s`` elapses (an
+        idle engine steps nothing — the timeout returns whatever was
+        captured, e.g. only gateway events). Captures serialize:
+        a second concurrent capture raises :class:`TraceBusyError`.
+        Safe from any thread; the driver's arming/countdown and this
+        teardown all run under the gateway lock."""
+        tr = self.tracer
+        if steps <= 0:
+            return tr.export()
+        # clamp: Event.wait overflows on absurd timeouts, and a capture
+        # that outlives any plausible debugging session is a leak
+        timeout_s = min(max(float(timeout_s), 0.0), 3600.0)
+        with self._lock:
+            if self._capture is not None:
+                raise TraceBusyError(
+                    "a trace capture is already in progress")
+            done = threading.Event()
+            self._capture = {"remaining": int(steps), "done": done,
+                             "armed": False}
+        try:
+            self._wake.set()
+            done.wait(timeout_s)
+        finally:
+            # unconditional teardown: an exception here must not leave
+            # an orphaned window 409-ing every later capture (or the
+            # tracer recording with nobody left to stop it)
+            with self._lock:
+                cap, self._capture = self._capture, None
+                if cap is not None and cap["armed"] \
+                        and not self.trace_persistent:
+                    tr.disable()
+        return tr.export()
+
+    # ------------------------------------------------------ debug surface
+    def request_table(self) -> list:
+        """Live request table (the ``GET /debug/requests`` body): one
+        row per in-flight request — state, slot, token progress,
+        queue-wait, TTFT, TPOT-so-far and KV footprint. Reads host
+        bookkeeping the driver thread writes (ints/short lists under
+        the GIL — same discipline as the scrape-time gauges)."""
+        eng = self.engine
+        now = eng._clock()
+        with self._lock:
+            pending = list(self._intake)
+            live = list(self._live.values())
+        parked_ids = {id(p) for p in self._parked}
+        rows = []
+        wall = time.monotonic()
+        for st in pending:
+            rows.append({"id": st.id, "state": "pending", "slot": None,
+                         "prompt_tokens": len(st.request.prompt),
+                         "generated_tokens": 0,
+                         "max_new_tokens": int(st.request.max_new_tokens),
+                         # wait-so-far on the gateway wall clock (the
+                         # engine has not seen this request yet, so no
+                         # engine-clock stamp exists) — the longest
+                         # waiters are exactly the rows an operator
+                         # inspecting a saturated server looks for
+                         "queue_wait_s": round(wall - st.submit_time, 6),
+                         "ttft_s": None,
+                         "tpot_s": None, "kv_tokens": 0,
+                         "kv_blocks": None})
+        for st in live:
+            seq = st.seq
+            slot = seq.slot
+            qw = seq.queue_wait_s
+            if qw is None and seq.t_submit is not None:
+                qw = now - seq.t_submit          # still waiting: so far
+            tpot = seq.tpot_s
+            if tpot is None and seq.t_first_token is not None \
+                    and len(seq.tokens) > 1:
+                tpot = (now - seq.t_first_token) / (len(seq.tokens) - 1)
+            kv_tokens, kv_blocks = 0, None
+            if slot is not None:
+                kv_tokens = int(eng.cache.lengths[slot])
+                if getattr(eng, "_paged", False):
+                    kv_blocks = len(eng.cache.slot_block_ids(slot))
+            rows.append({
+                "id": st.id,
+                "state": ("parked" if id(seq) in parked_ids
+                          else seq.status),
+                "slot": slot,
+                "prompt_tokens": seq.prompt_len,
+                "generated_tokens": len(seq.tokens),
+                "max_new_tokens": int(seq.request.max_new_tokens),
+                "queue_wait_s": None if qw is None else round(qw, 6),
+                "ttft_s": (None if seq.ttft_s is None
+                           else round(seq.ttft_s, 6)),
+                "tpot_s": None if tpot is None else round(tpot, 6),
+                "kv_tokens": kv_tokens,
+                "kv_blocks": kv_blocks,
+            })
+        return rows
+
     # ------------------------------------------------------ health surface
+    @property
+    def running_slots(self) -> int:
+        """Slots actively decoding (the ``/healthz`` saturation view)."""
+        return sum(1 for s in self.engine._slots
+                   if s is not None and s.status == "running")
+
+    @property
+    def prefilling_slots(self) -> int:
+        """Slots held by mid-chunked-prefill sequences."""
+        return sum(1 for s in self.engine._slots
+                   if s is not None and s.status == "prefilling")
+
     @property
     def restarts(self) -> int:
         return self._restarts

@@ -13,9 +13,19 @@ Endpoints:
   This framework ships no tokenizer, so prompts and completions are
   token-id arrays — the ``choices[].token_ids`` field stands in for
   OpenAI's ``text``.
-- ``GET /healthz`` — liveness + drain state + slot/queue occupancy.
+- ``GET /healthz`` — liveness + drain state + slot/queue occupancy,
+  including the saturation view (running/prefilling slot counts and
+  waiting-room occupancy vs capacity) so an orchestrator can make
+  scale-out decisions without parsing ``/metrics``.
 - ``GET /metrics`` — Prometheus text exposition
   (``profiler.metrics.MetricsRegistry``).
+- ``GET /debug/trace?steps=N`` — capture ``N`` engine steps of
+  request-lifecycle/step-phase trace and return Chrome trace-event
+  JSON (load in Perfetto; README "Tracing & debugging").
+  ``steps=0`` snapshots the current buffer (the persistent ``--trace``
+  mode's read); a concurrent capture gets 409.
+- ``GET /debug/requests`` — live request table: per-request state,
+  slot, token progress, queue-wait/TTFT/TPOT-so-far, KV footprint.
 
 Load shedding maps gateway signals onto status codes: full waiting
 room → 429 (with Retry-After), draining gateway → 503, validation →
@@ -31,9 +41,11 @@ import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from ..request import GenerationRequest
-from .gateway import GatewayClosedError, QueueFullError, ServingGateway
+from .gateway import (GatewayClosedError, QueueFullError, ServingGateway,
+                      TraceBusyError)
 
 SSE_HEADERS = (("Content-Type", "text/event-stream"),
                ("Cache-Control", "no-cache"),
@@ -89,7 +101,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ----------------------------------------------------------------- GET
     def do_GET(self):
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/healthz":
             gw = self.gateway
             st = gw.health_state    # ok|degraded|recovering|draining
@@ -97,11 +109,49 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": st,
                 "active_slots": gw.engine.num_active,
                 "num_slots": gw.engine.num_slots,
+                # saturation view: how the held slots split between
+                # decode and chunked prefill, and how full the bounded
+                # waiting room is — enough for an orchestrator to see
+                # "at capacity and queueing" without scraping /metrics
+                "running_slots": gw.running_slots,
+                "prefilling_slots": gw.prefilling_slots,
                 "queue_depth": gw.queue_depth,
+                "waiting_room_occupancy": gw.queue_depth,
+                "waiting_room_capacity": gw.max_queue,
                 # the supervisor's watchdog, externally visible: a step
                 # that never returns can only be seen from out here
                 "last_step_age_s": round(gw.last_step_age(), 3),
                 "engine_restarts": gw.restarts,
+            })
+        elif path == "/debug/trace":
+            qs = parse_qs(query)
+            # persistent (--trace) servers default to a SNAPSHOT: a
+            # parameterless probe must never clear hours of recorded
+            # history — opening a fresh window there takes an explicit
+            # steps=N
+            default_steps = "0" if self.gateway.trace_persistent \
+                else "32"
+            try:
+                steps = int(qs.get("steps", [default_steps])[0])
+                timeout_s = float(qs.get("timeout_s", ["30"])[0])
+            except ValueError as e:
+                self._error(400, f"bad query parameter: {e}",
+                            "invalid_request")
+                return
+            try:
+                doc = self.gateway.capture_trace(steps=steps,
+                                                 timeout_s=timeout_s)
+            except TraceBusyError as e:
+                self._error(409, str(e), "conflict")
+                return
+            self._send_json(200, doc)
+        elif path == "/debug/requests":
+            gw = self.gateway
+            self._send_json(200, {
+                "requests": gw.request_table(),
+                "num_slots": gw.engine.num_slots,
+                "queue_depth": gw.queue_depth,
+                "tracing": gw.tracer.enabled,
             })
         elif path == "/metrics":
             body = self.gateway.registry.render().encode()
@@ -307,7 +357,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           paged_attn=True, prefill_chunk=512, ragged_step=True,
           headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
-          drafter=None):
+          drafter=None, trace=False, trace_buffer=65536):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -364,6 +414,17 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     ``serving_spec_proposed_total`` / ``serving_spec_accepted_total``,
     the ``serving_spec_accept_length`` histogram and the
     ``serving_spec_launches_per_accepted_token`` gauge.
+
+    Tracing (README "Tracing & debugging"): the gateway always carries
+    a :class:`~paddle_tpu.profiler.tracing.SpanTracer` with a
+    ``trace_buffer``-event ring; ``trace=True`` records from startup
+    (request-lifecycle spans, engine step phases, supervisor fault/
+    rebuild instants), otherwise the tracer sits disabled at zero cost
+    until ``GET /debug/trace?steps=N`` opens a capture window.
+    ``GET /debug/requests`` serves the live request table either way,
+    and the per-request TTFT/TPOT/queue-wait decomposition lands on
+    ``/metrics`` as ``serving_tpot_seconds`` /
+    ``serving_queue_wait_seconds``.
     """
     from ..engine import ContinuousBatchingEngine
 
@@ -386,7 +447,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
         engine_factory(), max_queue=max_queue, registry=registry,
         engine_factory=engine_factory,
         watchdog_deadline_s=watchdog_deadline_s,
-        max_restarts=max_restarts, fault_hook=fault_hook, clock=clock)
+        max_restarts=max_restarts, fault_hook=fault_hook, clock=clock,
+        trace=trace, trace_buffer=trace_buffer)
     server = ServingHTTPServer(
         gateway, host=host, port=port,
         model_name=model_name or type(model).__name__, log_fn=log_fn)

@@ -1,0 +1,146 @@
+"""Tracer overhead benchmark (README "Tracing & debugging").
+
+Question answered: what does the request-lifecycle span tracer
+(``profiler/tracing.py``) cost the serving engine — (a) when it is
+merely INSTALLED but disabled (the production default: every
+instrumentation site must reduce to one attribute check), and (b) when
+it is recording?
+
+Three legs drive the SAME engine configuration, kernel, and seeded
+request set through ``engine.generate()`` in-process (the
+``bench_serve`` direct leg's methodology — same model, same
+two-program baseline configuration as the banked SERVE_BENCH.json, so
+the numbers are comparable to that bank):
+
+- **baseline** — no tracer installed (``engine.tracer is None``);
+- **disabled** — a tracer installed, not recording. The acceptance
+  gate: ≤ 1% wall overhead vs baseline;
+- **enabled** — recording everything into a ring sized to hold the
+  full run; reported openly (lifecycle spans + step phases are built
+  per step, so this is the real cost of ``--trace``).
+
+Legs are interleaved and each is scored by its BEST wall over
+``repeats`` rounds (identical code modulo the tracer, so best-of
+converges to the same floor when the tracer truly costs nothing).
+Token streams are asserted identical across all legs — tracing must
+observe, never perturb.
+
+Usage:
+  python scripts/bench_trace.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_decode import _models  # noqa: E402  (same model as bench_serve)
+from bench_serve import _requests  # noqa: E402
+
+SERVE_BENCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "SERVE_BENCH.json")
+
+
+def _run_leg(model, reqs, num_slots, s_max, tracer):
+    """One timed pass of the whole request set through a fresh engine
+    (shared jit cache — compile cost excluded), with ``tracer`` as the
+    engine's tracer (None = baseline)."""
+    from dataclasses import replace
+
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        ragged_step=False, spec_decode=False,
+        jit_cache=model.__dict__.setdefault("_serving_jit", {}))
+    eng.tracer = tracer
+    t0 = time.perf_counter()
+    outs = eng.generate([replace(r) for r in reqs])
+    dt = time.perf_counter() - t0
+    return dt, [o.tolist() for o in outs]
+
+
+def measure_trace_overhead(quick=True, n_requests=8, max_new=None,
+                           num_slots=4, repeats=5):
+    from paddle_tpu.profiler.tracing import SpanTracer
+    max_new = max_new or (24 if quick else 64)
+    s_max = 128 if quick else 256
+    model = _models(quick)["jnp"]
+    reqs = _requests(n_requests, max_new, model.config.vocab_size)
+    # a ring big enough that the enabled leg never drops (drop
+    # bookkeeping is cheap, but the measured leg should be the
+    # everything-retained worst case)
+    tr_off = SpanTracer(capacity=1 << 16)
+    tr_on = SpanTracer(capacity=1 << 16).enable()
+    # warm every program shape once (shared jit cache)
+    _run_leg(model, reqs[:2], num_slots, s_max, None)
+    best = {"baseline": None, "disabled": None, "enabled": None}
+    toks = {}
+    for _ in range(repeats):    # interleave; best wall per leg
+        for name, tracer in (("baseline", None), ("disabled", tr_off),
+                             ("enabled", tr_on)):
+            if tracer is tr_on:
+                tr_on.clear()
+                tr_on.enable()
+            dt, out = _run_leg(model, reqs, num_slots, s_max, tracer)
+            toks[name] = out
+            if best[name] is None or dt < best[name]:
+                best[name] = dt
+    tokens = sum(len(o) for o in toks["baseline"])
+    tokens_equal = (toks["baseline"] == toks["disabled"]
+                    == toks["enabled"])
+    events = len(tr_on.events())
+    disabled_ratio = best["disabled"] / best["baseline"]
+    enabled_ratio = best["enabled"] / best["baseline"]
+    # context: the banked HTTP serve bench this engine config mirrors
+    banked = None
+    try:
+        with open(SERVE_BENCH_PATH) as f:
+            banked = json.load(f)["serve_http"]["direct"]
+    except (OSError, ValueError, KeyError):
+        pass
+    return {
+        "baseline_wall_s": round(best["baseline"], 4),
+        "disabled_wall_s": round(best["disabled"], 4),
+        "enabled_wall_s": round(best["enabled"], 4),
+        "disabled_overhead_ratio": round(disabled_ratio, 4),
+        "enabled_overhead_ratio": round(enabled_ratio, 4),
+        "enabled_events_captured": events,
+        "enabled_us_per_event": round(
+            max(best["enabled"] - best["baseline"], 0.0)
+            / max(events, 1) * 1e6, 3),
+        "tokens": tokens,
+        "tokens_equal": tokens_equal,
+        "repeats": repeats,
+        "n_requests": n_requests, "max_new": max_new,
+        "num_slots": num_slots,
+        "banked_serve_direct": banked,
+        # the acceptance gate: a disabled tracer must be free (<= 1%),
+        # and tracing must never change a token
+        "accepted": bool(tokens_equal and disabled_ratio <= 1.01),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "trace_overhead": measure_trace_overhead(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0 if res["trace_overhead"]["accepted"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,7 @@ class TestBenchDriverFlow:
         assert art["ragged_step"]["ok"] is False
         assert art["spec_decode"]["ok"] is False
         assert art["chaos"]["ok"] is False
+        assert art["trace_overhead"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -146,6 +147,14 @@ class TestBenchDriverFlow:
                                       "accepted": True,
                                       "chaos": {"requests_lost": 0},
                                       "deterministic": True}), ""
+            if leg == "--trace-overhead":
+                # tracer-overhead leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps({"name": "trace_overhead",
+                                      "ok": True,
+                                      "disabled_overhead_ratio": 1.002,
+                                      "accepted": True,
+                                      "tokens_equal": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -180,10 +189,10 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:8] == ["--decode-cb", "--serve-http",
+        assert order[:9] == ["--decode-cb", "--serve-http",
                              "--prefix-cache", "--paged-attn",
                              "--chunked-prefill", "--ragged", "--spec",
-                             "--chaos"]
+                             "--chaos", "--trace-overhead"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -198,6 +207,8 @@ class TestBenchDriverFlow:
         assert art["spec_decode"]["modeled_tok_s_ratio_repetitive"] == 2.3
         assert art["chaos"]["accepted"] is True
         assert art["chaos"]["chaos"]["requests_lost"] == 0
+        assert art["trace_overhead"]["accepted"] is True
+        assert art["trace_overhead"]["disabled_overhead_ratio"] == 1.002
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124
