@@ -519,6 +519,15 @@ def watchdog():
     to = _parse_result(rc, out)
     cb_extra["trace_overhead"] = to if to is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Dispatch-cost leg: device launches + boundary bytes per decoded
+    # token by engine config (scripts/bench_dispatch.py) — the banked
+    # mega-kernel baseline. Same hang-proof contract: exact counters,
+    # CPU-forced, banked up front.
+    rc, out, err = _run([me, "--dispatch"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    dp = _parse_result(rc, out)
+    cb_extra["dispatch"] = dp if dp is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -701,6 +710,13 @@ if __name__ == "__main__":
         from bench_trace import measure_trace_overhead
         print(json.dumps({"name": "trace_overhead", "ok": True,
                           **measure_trace_overhead(quick=True)}))
+        sys.exit(0)
+    if "--dispatch" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_dispatch import measure_dispatch_cost
+        print(json.dumps({"name": "dispatch", "ok": True,
+                          **measure_dispatch_cost(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -168,7 +168,9 @@ def load_profiler_result(path):
     raise NotImplementedError("open XPlane traces with TensorBoard/xprof")
 
 
+from . import cost  # noqa: E402
 from . import metrics  # noqa: E402
 from . import tracing  # noqa: E402
+from .cost import CostObservatory  # noqa: E402
 from .metrics import MFUMeter  # noqa: E402
 from .tracing import SpanTracer  # noqa: E402

@@ -1,46 +1,80 @@
-"""CLI entry point: ``python -m paddle_tpu.profiler <trace_dir>``.
+"""CLI entry point: ``python -m paddle_tpu.profiler <trace>``.
 
-The XPlane parser (:mod:`paddle_tpu.profiler.xplane`) has existed since
-it started validating bench traces, but had no command-line surface —
-inspecting a ``jax.profiler`` trace directory meant an ad-hoc REPL
-session. This wires ``xplane.op_statistics`` / ``xplane.summarize`` to
-a command:
+Two trace formats, auto-detected by what the argument is:
+
+- a DIRECTORY: a ``jax.profiler`` (XPlane) trace dir — per-op time
+  aggregation through :mod:`paddle_tpu.profiler.xplane`;
+- a FILE: Chrome trace-event JSON, exactly what ``GET /debug/trace``
+  serves (README "Tracing & debugging") — per-lane span SELF-time
+  summary through :mod:`paddle_tpu.profiler.chrometrace`, so a saved
+  serving capture answers "where did the step go" without Perfetto.
 
     python -m paddle_tpu.profiler /tmp/profile_dir            # op table
-    python -m paddle_tpu.profiler /tmp/profile_dir --top 25
-    python -m paddle_tpu.profiler /tmp/profile_dir --json     # machine-readable
+    python -m paddle_tpu.profiler trace.json --top 25         # span table
+    python -m paddle_tpu.profiler trace.json --json           # machine-readable
 
-Device planes (the XLA op timeline) are summarized by default; when a
-trace has none — CPU-backend traces put the ops on host planes — the
-CLI falls back to all planes automatically and says so (pass
-``--all-planes`` to start there). Exit status: 0 when events were
-parsed, 1 when the directory held no parseable trace (so scripts can
-gate on it).
+Device planes (the XLA op timeline) are summarized by default on the
+XPlane path; when a trace has none — CPU-backend traces put the ops on
+host planes — the CLI falls back to all planes automatically and says
+so (pass ``--all-planes`` to start there). Exit status: 0 when events
+were parsed, 1 on unparseable input (no *.xplane.pb, bad JSON, no
+traceEvents) so scripts can gate on it.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+
+def _main_chrome(args):
+    from .chrometrace import load_chrome_trace, span_self_times, \
+        summarize_chrome
+    if args.json:
+        try:
+            rows = span_self_times(load_chrome_trace(args.trace_dir))
+        except ValueError as e:
+            print(json.dumps({"error": str(e)}))
+            return 1
+        if args.top:
+            rows = rows[:args.top]
+        print(json.dumps({"trace": args.trace_dir, "rows": rows},
+                         indent=1))
+        return 0 if rows else 1
+    try:
+        out = summarize_chrome(args.trace_dir, top=args.top)
+    except ValueError as e:
+        print(f"unparseable trace: {e}")
+        return 1
+    print(out)
+    return 0 if out != "no spans parsed" else 1
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.profiler",
         description="Per-op time aggregation over a jax.profiler "
-                    "(XPlane) trace directory.")
-    ap.add_argument("trace_dir",
+                    "(XPlane) trace directory, or per-lane span "
+                    "self-time over a Chrome trace-event JSON file "
+                    "(as served by GET /debug/trace).")
+    ap.add_argument("trace_dir", metavar="trace",
                     help="directory jax.profiler.start_trace wrote "
-                         "(searched recursively for *.xplane.pb)")
+                         "(searched recursively for *.xplane.pb), or a "
+                         "Chrome trace-event JSON file")
     ap.add_argument("--top", type=int, default=10,
                     help="rows to report (0 = all)")
     ap.add_argument("--json", action="store_true",
                     help="emit the op table as JSON instead of text")
     ap.add_argument("--all-planes", action="store_true",
-                    help="aggregate host planes too (default: device "
-                         "planes only, with automatic fallback when a "
-                         "trace has none)")
+                    help="aggregate host planes too (XPlane dirs only; "
+                         "default: device planes, with automatic "
+                         "fallback when a trace has none)")
     args = ap.parse_args(argv)
+
+    if os.path.isfile(args.trace_dir):
+        # a file is the Chrome-trace path; directories stay XPlane
+        return _main_chrome(args)
 
     from .xplane import op_statistics_with_fallback, summarize
     device_only = not args.all_planes

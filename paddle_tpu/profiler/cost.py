@@ -1,0 +1,281 @@
+"""Device-boundary cost observatory for the serving stack (README
+"Cost attribution & /debug/profile").
+
+PR 9's span tracer says *where wall-time goes*; this module says *what
+crosses the host↔device boundary* — the quantity the ROADMAP's
+mega-kernel item is gated on ("measured dispatch count per decoded
+token drops ≥5×" needs an exact baseline before any optimisation PR can
+claim the win, MPK / PAPERS.md). A :class:`CostObservatory` wraps every
+jitted program the engine hands out of its shared jit-cache in a
+counting facade (:class:`_CountedProgram`) and records, per program
+key:
+
+- **dispatches** — exact execution counts (one per facade call; the
+  facade IS the call, so the count cannot drift from reality);
+- **host→device bytes** — the abstract byte size of every *host-
+  resident* argument leaf (numpy arrays / scalars: exactly the leaves
+  the runtime must copy to device at dispatch; device-resident
+  ``jax.Array`` leaves — weights, the KV pool, carried key state —
+  pass by reference and are correctly not charged);
+- **device→host bytes** — the abstract byte size of the result leaves
+  the engine actually fetches to host (declared per program via
+  ``host_out`` at wrap time: the sampled tokens, the tick-0 keys of
+  the unified step, the spec key walk — never the functionally-updated
+  pool arrays, which are re-adopted device-side);
+- **compile events** — ``_cache_size()`` deltas around each call, so a
+  retrace is attributed to the program (and the step) that paid it;
+- **wall EWMA / total** — per-call wall time on an injectable clock
+  (the fault harness's ``VirtualClock`` slots in, making a chaos
+  replay's exported accounting byte-identical).
+
+All sizes come from abstract ``shape``/``dtype`` — **no device sync,
+no ``.block_until_ready()``, no value reads** — so observing costs
+nothing the program wasn't already paying.
+
+Discipline mirrors the tracer's: the observatory is a host-side dict
+updated by the single engine-driver thread; scrape-time readers
+(``/metrics`` gauges, ``/debug/profile``) read ints under the GIL.
+Disabled, every engine instrumentation site reduces to the one
+``_co()`` attribute guard — the ≤1.01× property the dispatch bench
+pins (DISPATCH_BENCH.json, ``scripts/bench_dispatch.py``).
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+#: every program kind the serving engine's jit-cache can hand out —
+#: the fixed label set of ``serving_dispatches_total{program=...}``
+#: (values scrape as 0 until a kind first runs).
+PROGRAM_KINDS = ("prefill", "suffix", "psuffix", "decode", "pdecode",
+                 "ragged", "spec")
+
+
+def _nbytes(leaf) -> int:
+    """Abstract byte size of one pytree leaf — shape × itemsize, no
+    device sync (works on jax Arrays, numpy arrays and scalars)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * np.dtype(dtype).itemsize
+    try:
+        return np.dtype(type(leaf)).itemsize
+    except TypeError:
+        return 8          # opaque python scalar: one word, by convention
+
+
+def _label(key) -> str:
+    """Stable per-program label from a jit-cache key tuple:
+    ``("ragged", 8, 72, 1, "jnp")`` → ``"ragged[8,72,1,jnp]"``."""
+    if len(key) == 1:
+        return str(key[0])
+    return f"{key[0]}[{','.join(str(k) for k in key[1:])}]"
+
+
+class CostObservatory:
+    """Exact per-program dispatch / transfer / compile accounting.
+
+    One observatory is OWNED BY THE GATEWAY and installed on every
+    engine incarnation (``engine.cost``), so its counts are monotonic
+    across crash-recovery rebuilds — the same ownership rule as the
+    tracer and the ``serving_preemptions_total`` base. ``clock`` is any
+    zero-arg monotonic-seconds callable (default ``time.perf_counter``;
+    tests and the chaos bench pass a
+    :class:`~paddle_tpu.serving.faults.VirtualClock`, under which the
+    exported accounting replays byte-identically).
+
+    The engine guards every touch on :attr:`enabled` through its
+    ``_co()`` helper — one attribute check when disabled, the same
+    discipline as the tracer's ``_tr()``.
+    """
+
+    def __init__(self, clock=None, ewma_alpha=0.2):
+        self.clock = clock if clock is not None else time.perf_counter
+        self.enabled = True
+        self.ewma_alpha = float(ewma_alpha)
+        # label -> per-program record (insertion-ordered: deterministic
+        # under a deterministic workload, so export() is byte-stable)
+        self.programs = {}
+        # step-phase attribution (the engine names the current phase:
+        # admit | plan | launch | host-accept): where dispatches land
+        self.phases = {}
+        self._phase = None
+        self.totals = {"dispatches": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+                       "compiles": 0, "wall_s": 0.0}
+
+    # ------------------------------------------------------------- control
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def set_phase(self, phase):
+        """Name the step phase subsequent dispatches are attributed to
+        (None between steps)."""
+        self._phase = phase
+
+    # ------------------------------------------------------------ recording
+    def wrap(self, key, fn, host_out=()):
+        """Counting facade over one jitted program handed out of the
+        jit-cache. ``key`` is the cache key (its first element is the
+        program kind); ``host_out`` names the result indices the engine
+        fetches to host — the exact device→host surface."""
+        return _CountedProgram(self, _label(key), str(key[0]), fn,
+                               tuple(host_out))
+
+    def _record(self, label, kind, args, out, host_out, compiles, dt):
+        h2d = sum(_nbytes(leaf)
+                  for leaf in jax.tree_util.tree_leaves(args)
+                  if not isinstance(leaf, jax.Array))
+        d2h = sum(_nbytes(leaf) for i in host_out
+                  for leaf in jax.tree_util.tree_leaves(out[i]))
+        rec = self.programs.get(label)
+        if rec is None:
+            rec = {"kind": kind, "calls": 0, "h2d_bytes": 0,
+                   "d2h_bytes": 0, "compiles": 0, "wall_s": 0.0,
+                   "wall_ewma_s": None}
+            self.programs[label] = rec
+        rec["calls"] += 1
+        rec["h2d_bytes"] += h2d
+        rec["d2h_bytes"] += d2h
+        rec["compiles"] += compiles
+        rec["wall_s"] += dt
+        rec["wall_ewma_s"] = dt if rec["wall_ewma_s"] is None else \
+            (1 - self.ewma_alpha) * rec["wall_ewma_s"] + self.ewma_alpha * dt
+        t = self.totals
+        t["dispatches"] += 1
+        t["h2d_bytes"] += h2d
+        t["d2h_bytes"] += d2h
+        t["compiles"] += compiles
+        t["wall_s"] += dt
+        ph = self.phases.get(self._phase)
+        if ph is None:
+            ph = {"dispatches": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+                  "wall_s": 0.0}
+            self.phases[self._phase] = ph
+        ph["dispatches"] += 1
+        ph["h2d_bytes"] += h2d
+        ph["d2h_bytes"] += d2h
+        ph["wall_s"] += dt
+
+    # -------------------------------------------------------------- reading
+    def kind_calls(self, kind) -> int:
+        """Total dispatches of one program kind (the
+        ``serving_dispatches_total{program}`` series). ``list()``
+        snapshots the dict before iterating: scrapes run on HTTP
+        handler threads while the driver may be inserting a new
+        program label, and bare dict iteration would raise
+        "changed size during iteration"."""
+        return sum(rec["calls"] for rec in list(self.programs.values())
+                   if rec["kind"] == kind)
+
+    def snapshot(self) -> dict:
+        """Cheap totals copy — the engine's per-step delta base."""
+        return dict(self.totals)
+
+    def delta(self, base) -> dict:
+        """Totals accrued since ``base`` (a prior :meth:`snapshot`)."""
+        return {k: self.totals[k] - base[k]
+                for k in ("dispatches", "h2d_bytes", "d2h_bytes",
+                          "compiles")}
+
+    def snapshot_full(self) -> dict:
+        """Deep copy of the whole accounting — the base (or frozen end)
+        of a step-bounded ``/debug/profile`` capture window. ``list()``
+        snapshots each dict before iterating (see :meth:`kind_calls`);
+        concurrent driver updates can tear a single in-flight record,
+        never crash."""
+        return {"programs": {k: dict(v)
+                             for k, v in list(self.programs.items())},
+                "phases": {k: dict(v)
+                           for k, v in list(self.phases.items())},
+                "totals": dict(self.totals)}
+
+    def export(self, base=None, at=None) -> dict:
+        """The cost-attribution document: aggregate, the delta since
+        ``base``, or the ``base``→``at`` window (both prior
+        :meth:`snapshot_full` snapshots — ``at`` is how a step-bounded
+        capture freezes its END at the exact step boundary instead of
+        leaking later steps into the window). Deterministic for a
+        deterministic workload: insertion-ordered programs, rounded
+        floats, no wall-clock reads."""
+        state = at if at is not None else self.snapshot_full()
+        base_p = (base or {}).get("programs", {})
+        base_t = (base or {}).get("totals", {})
+        base_ph = (base or {}).get("phases", {})
+        wall_total = state["totals"]["wall_s"] - base_t.get("wall_s", 0.0)
+        programs = []
+        for label, rec in state["programs"].items():
+            b = base_p.get(label, {})
+            calls = rec["calls"] - b.get("calls", 0)
+            if calls <= 0:
+                continue
+            wall = rec["wall_s"] - b.get("wall_s", 0.0)
+            programs.append({
+                "program": label, "kind": rec["kind"], "calls": calls,
+                "h2d_bytes": rec["h2d_bytes"] - b.get("h2d_bytes", 0),
+                "d2h_bytes": rec["d2h_bytes"] - b.get("d2h_bytes", 0),
+                "compiles": rec["compiles"] - b.get("compiles", 0),
+                "wall_s": round(wall, 9),
+                "wall_ewma_s": round(rec["wall_ewma_s"] or 0.0, 9),
+                "share_of_wall": round(wall / wall_total, 6)
+                if wall_total > 0 else 0.0,
+            })
+        programs.sort(key=lambda r: (-r["wall_s"], -r["calls"],
+                                     r["program"]))
+        phases = {}
+        for name, rec in state["phases"].items():
+            b = base_ph.get(name, {})
+            d = rec["dispatches"] - b.get("dispatches", 0)
+            if d <= 0:
+                continue
+            phases[str(name)] = {
+                "dispatches": d,
+                "h2d_bytes": rec["h2d_bytes"] - b.get("h2d_bytes", 0),
+                "d2h_bytes": rec["d2h_bytes"] - b.get("d2h_bytes", 0),
+                "wall_s": round(rec["wall_s"] - b.get("wall_s", 0.0), 9),
+            }
+        totals = {k: state["totals"][k] - base_t.get(k, 0)
+                  for k in ("dispatches", "h2d_bytes", "d2h_bytes",
+                            "compiles")}
+        totals["wall_s"] = round(wall_total, 9)
+        return {"programs": programs, "phases": phases, "totals": totals}
+
+
+class _CountedProgram:
+    """The counting facade: calls the wrapped jitted program and
+    records exact dispatch/byte/compile/wall accounting. Handed out
+    fresh per accessor call (the jit-cache keeps the RAW jitted fn, so
+    ``decode_compilations()`` / shared-cache semantics are
+    untouched)."""
+
+    __slots__ = ("_co", "_label", "_kind", "_fn", "_host_out")
+
+    def __init__(self, co, label, kind, fn, host_out):
+        self._co = co
+        self._label = label
+        self._kind = kind
+        self._fn = fn
+        self._host_out = host_out
+
+    def _cache_size(self):
+        # transparent to compile-count assertions made on a handout
+        return self._fn._cache_size()
+
+    def __call__(self, *args):
+        co = self._co
+        fn = self._fn
+        t0 = co.clock()
+        c0 = fn._cache_size()
+        out = fn(*args)
+        co._record(self._label, self._kind, args, out, self._host_out,
+                   fn._cache_size() - c0, co.clock() - t0)
+        return out

@@ -35,10 +35,15 @@ Design constraints, in order:
 Event vocabulary (Chrome trace phases): spans are COMPLETE events
 (``ph="X"`` with ``ts``/``dur`` in microseconds) — simpler to validate
 than begin/end pairs and immune to unbalanced nesting when the ring
-drops events; instants are ``ph="i"`` with thread scope. Every event
-carries ``name/ph/ts/pid/tid`` (the schema tests pin exactly this);
-``args`` holds the payload (prefix-hit tokens, accepted-draft lengths,
-fault kinds, finish reasons).
+drops events; instants are ``ph="i"`` with thread scope; counter
+tracks are ``ph="C"`` events whose ``args`` carry one sample per
+series — Perfetto renders them as stacked graphs alongside the spans,
+which is how the cost observatory's dispatches/step, transfer
+bytes/step and KV-pool occupancy ride the same timeline as PR 9's
+phases. Every event carries ``name/ph/ts/pid/tid`` (the schema tests
+pin exactly this); ``args`` holds the payload (prefix-hit tokens,
+accepted-draft lengths, fault kinds, finish reasons, counter
+samples).
 
 Thread model: the engine-driver thread is the only writer during
 serving; HTTP handler threads only snapshot (``export``). Both paths
@@ -182,6 +187,18 @@ class SpanTracer:
         if args:
             ev["args"] = args
         self._append(ev)
+
+    def counter(self, name, values, tid=TID_ENGINE, t=None):
+        """One counter-track sample (``ph="C"``): ``values`` is a dict
+        of series-name → number, graphed by Perfetto as a stacked
+        counter under ``name`` on the lane's timeline (the cost
+        observatory's dispatches/step, transfer-bytes/step and KV-pool
+        occupancy tracks)."""
+        if not self._enabled:
+            return
+        self._append({"name": name, "ph": "C",
+                      "ts": self._ts(self.clock() if t is None else t),
+                      "pid": PID, "tid": int(tid), "args": dict(values)})
 
     def complete(self, name, t0, tid=TID_ENGINE, args=None, t1=None):
         """One complete span (``ph="X"``) from ``t0`` (a prior

@@ -79,6 +79,15 @@ class BlockManager:
         readers) — the ``kv_blocks_shared`` gauge on ``/metrics``."""
         return int((self._ref >= 2).sum())
 
+    @property
+    def block_nbytes(self) -> int:
+        """HBM bytes one block holds across all layers, K and V — the
+        unit of the ``/debug/requests`` per-request KV-bytes column and
+        the cost observatory's occupancy-to-bytes conversion. Abstract
+        (shape × itemsize): no device sync."""
+        per = self.k.size * np.dtype(self.k.dtype).itemsize
+        return 2 * per // self.num_blocks
+
     def alloc(self):
         """Claim a free block (lowest id first, deterministic); None when
         the pool is exhausted (the caller evicts or skips publishing)."""

@@ -367,6 +367,13 @@ class ContinuousBatchingEngine:
         # Every instrumentation site guards on _tr() — one attribute
         # check when tracing is off, so the hot path pays nothing.
         self.tracer = None
+        # device-boundary cost observatory (profiler/cost.py, README
+        # "Cost attribution & /debug/profile"): None in production
+        # engines built bare; the gateway installs ONE observatory
+        # across every engine incarnation, so its dispatch/transfer/
+        # compile counts stay monotonic across rebuilds. Every touch
+        # guards on _co() — the tracer's one-attribute discipline.
+        self.cost = None
         # streaming hooks (the gateway's wire into the step loop):
         # on_token(seq, token_id) fires for EVERY generated token the
         # moment the host sees it; on_finish(seq) fires exactly once per
@@ -383,6 +390,25 @@ class ContinuousBatchingEngine:
         event-arg construction."""
         t = self.tracer
         return t if (t is not None and t.enabled) else None
+
+    def _co(self):
+        """The active cost observatory, or None — THE guard every cost
+        site uses (``_tr()``'s twin), so a disabled/absent observatory
+        costs one attribute check and no accounting work."""
+        c = self.cost
+        return c if (c is not None and c.enabled) else None
+
+    def _wrap_prog(self, key, fn, host_out):
+        """The jit-cache hand-out chokepoint: every program accessor
+        routes through here, so with the observatory on, EVERY device
+        program the engine can launch is counted — exactly once per
+        call, no site-by-site bookkeeping to drift. ``host_out`` names
+        the result indices the engine fetches to host (the program's
+        true device→host surface)."""
+        co = self._co()
+        if co is None:
+            return fn
+        return co.wrap(key, fn, host_out=host_out)
 
     def _stamp_now(self):
         """Timestamp for the Sequence SLO stamps: the current step's
@@ -420,7 +446,9 @@ class ContinuousBatchingEngine:
         key = ("prefill",)
         if key not in self._jit:
             self._jit[key] = build_prefill_fn(**self._fn_consts())
-        return self._jit[key]
+        # host_out: the engine fetches tok0 (result 2); pk/pv feed the
+        # cache writer device-side and keys stay device state
+        return self._wrap_prog(key, self._jit[key], host_out=(2,))
 
     def _suffix_fn(self):
         # paged and dense suffix programs are distinct (table-indirect
@@ -431,7 +459,7 @@ class ContinuousBatchingEngine:
             build = (build_paged_suffix_prefill_fn if self._paged
                      else build_suffix_prefill_fn)
             self._jit[key] = build(**self._fn_consts())
-        return self._jit[key]
+        return self._wrap_prog(key, self._jit[key], host_out=(2,))
 
     def _decode_fn(self, n_steps):
         kind = "pdecode" if self._paged else "decode"
@@ -443,7 +471,7 @@ class ContinuousBatchingEngine:
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
                 **self._fn_consts())
-        return self._jit[key]
+        return self._wrap_prog(key, self._jit[key], host_out=(0,))
 
     def _ragged_fn(self, n_steps):
         # the full packed-buffer geometry — num_slots AND token budget,
@@ -459,7 +487,9 @@ class ContinuousBatchingEngine:
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
                 **self._fn_consts())
-        return self._jit[key]
+        # host reads the sampled tokens and the tick-0 keys (chunk
+        # installs); keys_fin is adopted device-side via jnp.where
+        return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
 
     def _spec_fn(self):
         # like the ragged key: the full packed geometry (num_slots AND
@@ -473,7 +503,9 @@ class ContinuousBatchingEngine:
                 spec_len=self._spec_len,
                 decode_attn=self.config.decode_attention,
                 **self._fn_consts())
-        return self._jit[key]
+        # host reads the sampled walk tokens AND the key walk (both are
+        # np.asarray'd for acceptance)
+        return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
 
     @property
     def spec_decode(self) -> bool:
@@ -712,11 +744,14 @@ class ContinuousBatchingEngine:
                 keys[i] = np.asarray(seq.key)
             with self._tspan("prefill_launch",
                              args={"bucket": s_pad, "group": G}):
+                # host arrays pass uncoerced: jit device_puts them
+                # identically, and the cost facade then counts the
+                # REAL host→device upload bytes of the call
                 pk, pv, tok0s, keys2 = self._prefill_fn()(
-                    self._params, jnp.asarray(ids), lens,
-                    jnp.asarray(keys), temps, topks)
+                    self._params, ids, lens, keys, temps, topks)
                 tok0s = np.asarray(tok0s)
             for i, seq in enumerate(group):
+                seq.launches += 1       # rode this bucket's prefill
                 slot = self.cache.alloc()
                 seq.slot = slot   # before the write: a PoolExhausted
                 # raised inside write_prefill's block growth must leave
@@ -770,6 +805,7 @@ class ContinuousBatchingEngine:
                 rows.append((seq, covered, seq.work_len - covered, True))
             tok0s, keys2 = self._suffix_call(s_pad, rows)
             for i, (seq, matched) in enumerate(group):
+                seq.launches += 1       # rode this bucket's suffix call
                 slot = seq.slot
                 self.cache.lengths[slot] = seq.work_len
                 self.stats["prefill_tokens_saved"] += seq.prefix_hit_tokens
@@ -819,10 +855,11 @@ class ContinuousBatchingEngine:
               else (self.cache.k, self.cache.v))
         with self._tspan("prefill_launch",
                          args={"bucket": s_pad, "group": len(rows)}):
+            # host arrays pass uncoerced (see _admit_cold): the cost
+            # facade counts the call's real host→device upload bytes
             nk, nv, tok0s, keys2 = self._suffix_fn()(
-                self._params, *kv, jnp.asarray(addr),
-                jnp.asarray(prefix_lens), jnp.asarray(ids),
-                jnp.asarray(suf_lens), jnp.asarray(keys), temps, topks)
+                self._params, *kv, addr, prefix_lens, ids, suf_lens,
+                keys, temps, topks)
             self.cache.update(nk, nv)
             tok0s = np.asarray(tok0s)
         return tok0s, keys2
@@ -864,6 +901,7 @@ class ContinuousBatchingEngine:
         row's sampled token + advanced key, consumed only when this
         chunk completes the prompt."""
         slot, end = seq.slot, seq.prefilled + n
+        seq.launches += 1               # rode this chunk's device call
         self.stats["prefill_chunks"] += 1
         self.stats["chunk_tokens"] += n
         tr = self._tr()
@@ -1050,6 +1088,8 @@ class ContinuousBatchingEngine:
         self._stamp_t = t0
         tr = self._tr()
         ts0 = tr.now() if tr is not None else None
+        co = self._co()
+        cost0 = co.snapshot() if co is not None else None
         finished = []
         # deadline sweep BEFORE admission: an expired queued request
         # must never claim a slot (and a running one stops paying for
@@ -1069,6 +1109,8 @@ class ContinuousBatchingEngine:
                         hit_len_fn=self._admission_hit_len
                         if self.prefix_cache is not None else None)
                     if admitted:
+                        if co is not None:
+                            co.set_phase("admit")
                         with self._tspan("admit",
                                          args={"n": len(admitted)}):
                             self._admit_group(admitted, finished)
@@ -1101,11 +1143,29 @@ class ContinuousBatchingEngine:
         self.stats["steps"] += 1
         self._record_step(self._clock() - t0, step_tokens, had_chunks)
         self._stamp_t = None
+        if co is not None:
+            co.set_phase(None)
         if tr is not None:
             tr.complete("step", ts0,
                         args={"step": self.stats["steps"] - 1,
                               "tokens": step_tokens,
                               "chunks": bool(had_chunks)})
+            # counter tracks (ph:"C") on the same timeline as the step
+            # spans, so Perfetto graphs cost alongside the phases:
+            # KV-pool occupancy + table pressure, and (with the cost
+            # observatory on) this step's dispatch/transfer deltas
+            if self._paged:
+                tr.counter("kv_blocks", self.cache.occupancy())
+                tr.counter("block_table_fill",
+                           {"fill": round(self.cache.table_fill(), 6)})
+            if co is not None:
+                d = co.delta(cost0)
+                tr.counter("dispatches",
+                           {"per_step": d["dispatches"],
+                            "compiles": d["compiles"]})
+                tr.counter("transfer_bytes",
+                           {"h2d": d["h2d_bytes"],
+                            "d2h": d["d2h_bytes"]})
         return finished
 
     # ----------------------------------------------------- fault recovery
@@ -1296,6 +1356,9 @@ class ContinuousBatchingEngine:
         for the headroom EWMAs."""
         tr = self._tr()
         tp0 = tr.now() if tr is not None else None
+        co = self._co()
+        if co is not None:
+            co.set_phase("plan")
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._prefill_budget(),
@@ -1347,17 +1410,18 @@ class ContinuousBatchingEngine:
                         args={"rows": len(active), "chunks": len(plan),
                               "fused_steps": n})
             tl0 = tr.now()
+        if co is not None:
+            co.set_phase("launch")
         npk, npv, toks, keys_t0, keys_fin = self._ragged_fn(n)(
             self._params, self.cache.pool.k, self.cache.pool.v,
-            jnp.asarray(self.cache.tables), jnp.asarray(ids),
-            jnp.asarray(seg), jnp.asarray(pos), jnp.asarray(qstart),
-            jnp.asarray(qlen), jnp.asarray(kvlen),
-            jnp.asarray(dec_mask), jnp.asarray(keys), jnp.asarray(temps),
-            jnp.asarray(topks))
+            self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
+            dec_mask, keys, temps, topks)
         self.cache.update(npk, npv)
         toks_np = np.asarray(toks)          # [n, R]
         keys_t0_np = np.asarray(keys_t0)
         self.stats["unified_steps"] += 1
+        if co is not None:
+            co.set_phase("host-accept")
         if tr is not None:
             tr.complete("launch", tl0,
                         args={"packed_tokens": cursor, "fused_steps": n})
@@ -1378,6 +1442,10 @@ class ContinuousBatchingEngine:
             self.stats["decode_calls"] += 1
             self.stats["decode_steps"] += n
             self.stats["slot_steps"] += n * self.num_slots
+            for slot in range(self.num_slots):
+                s = self._slots[slot]
+                if s is not None and dec_mask[slot]:
+                    s.launches += 1     # rode this step's one program
             for i in range(n):
                 for slot in range(self.num_slots):
                     seq = self._slots[slot]
@@ -1462,6 +1530,9 @@ class ContinuousBatchingEngine:
         EWMAs."""
         tr = self._tr()
         tp0 = tr.now() if tr is not None else None
+        co = self._co()
+        if co is not None:
+            co.set_phase("plan")
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._prefill_budget(),
@@ -1526,17 +1597,18 @@ class ContinuousBatchingEngine:
                         args={"rows": len(active), "chunks": len(plan),
                               "draft_tokens": int(sum(grants))})
             tl0 = tr.now()
+        if co is not None:
+            co.set_phase("launch")
         npk, npv, toks, kwalk = self._spec_fn()(
             self._params, self.cache.pool.k, self.cache.pool.v,
-            jnp.asarray(self.cache.tables), jnp.asarray(ids),
-            jnp.asarray(seg), jnp.asarray(pos), jnp.asarray(qstart),
-            jnp.asarray(qlen), jnp.asarray(kvlen),
-            jnp.asarray(sample_start), jnp.asarray(keys),
-            jnp.asarray(temps), jnp.asarray(topks))
+            self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
+            sample_start, keys, temps, topks)
         self.cache.update(npk, npv)
         toks_np = np.asarray(toks)          # [spec_len, R]
         kwalk_np = np.asarray(kwalk)        # [spec_len, R, 2]
         self.stats["spec_steps"] += 1
+        if co is not None:
+            co.set_phase("host-accept")
         if tr is not None:
             tr.complete("launch", tl0,
                         args={"packed_tokens": cursor})
@@ -1557,6 +1629,7 @@ class ContinuousBatchingEngine:
             # _install_seq key write must survive the batched update
             knp = np.asarray(self._keys, np.uint32).copy()
             for slot, seq, d, L0 in verify_rows:
+                seq.launches += 1       # rode this step's one verify
                 a = 0
                 while a < len(d) and int(toks_np[a, slot]) == int(d[a]):
                     a += 1
@@ -1615,6 +1688,11 @@ class ContinuousBatchingEngine:
         step is pinned byte-identical against."""
         tr = self._tr()
         tp0 = tr.now() if tr is not None else None
+        co = self._co()
+        if co is not None:
+            # the chunk device calls below are this engine's prefill
+            # plan — they attribute to the plan phase, same as the span
+            co.set_phase("plan")
         plan = []
         if self._chunk and self.scheduler.num_prefilling:
             plan = self.scheduler.prefill_plan(self._chunk,
@@ -1639,6 +1717,8 @@ class ContinuousBatchingEngine:
                               "fused_steps": n})
             tl0 = tr.now()
         if active:
+            if co is not None:
+                co.set_phase("launch")
             if self._paged:
                 # append-block on decode growth: a fused chunk of n
                 # ticks writes rows [len, len+n) per slot, so the table
@@ -1669,26 +1749,26 @@ class ContinuousBatchingEngine:
                             self.cache.block_size
                 toks, nk, nv, keys = self._decode_fn(n)(
                     self._params, self.cache.pool.k, self.cache.pool.v,
-                    jnp.asarray(self.cache.tables),
-                    jnp.asarray(self._last_tok),
-                    jnp.asarray(lens), self._keys,
-                    jnp.asarray(self._temps), jnp.asarray(self._topks))
+                    self.cache.tables, self._last_tok, lens, self._keys,
+                    self._temps, self._topks)
             else:
                 toks, nk, nv, keys = self._decode_fn(n)(
                     self._params, self.cache.k, self.cache.v,
-                    jnp.asarray(self._last_tok),
-                    jnp.asarray(self.cache.lengths),
-                    self._keys, jnp.asarray(self._temps),
-                    jnp.asarray(self._topks))
+                    self._last_tok, self.cache.lengths, self._keys,
+                    self._temps, self._topks)
             self.cache.update(nk, nv)
             self._keys = keys
             toks_np = np.asarray(toks)  # [n, num_slots]
+            if co is not None:
+                co.set_phase("host-accept")
             if tr is not None:
                 tr.complete("launch", tl0, args={"fused_steps": n})
                 th0 = tr.now()
             self.stats["decode_calls"] += 1
             self.stats["decode_steps"] += n
             self.stats["slot_steps"] += n * self.num_slots
+            for s in active:
+                s.launches += 1         # rode this one decode call
             for i in range(n):
                 for slot in range(self.num_slots):
                     seq = self._slots[slot]

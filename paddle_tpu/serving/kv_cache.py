@@ -224,6 +224,14 @@ class SlotKVCache:
         """Adopt the decode step's functionally-updated cache arrays."""
         self.k, self.v = new_k, new_v
 
+    def slot_kv_bytes(self, slot) -> int:
+        """HBM bytes of the slot's valid rows (rows × per-row bytes) —
+        the dense twin of :meth:`PagedKVCache.slot_kv_bytes` for the
+        ``/debug/requests`` cost column."""
+        per_row = (2 * self.k.size * np.dtype(self.k.dtype).itemsize
+                   // (self.num_slots * self.max_seq_len))
+        return int(self.lengths[slot]) * per_row
+
     # ------------------------------------------------------ block copies
     def copy_block_in(self, slot, row0, pool, block_id):
         """Install pool block ``block_id`` into rows [row0, row0+bs) of
@@ -433,6 +441,28 @@ class PagedKVCache:
         the ``kv_block_table_fill`` gauge."""
         return float(self._n_blocks.sum()) / float(
             self.num_slots * self.max_blocks)
+
+    def occupancy(self) -> dict:
+        """Pool occupancy split for the step-timeline counter tracks
+        (``kv_blocks`` on the Chrome trace, README "Cost attribution &
+        /debug/profile"): ``live`` = distinct physical blocks some live
+        slot table references (shared blocks count once), ``trie`` =
+        allocated blocks no live table references (trie-only
+        residency), ``free`` = the pool's free heap. Host bookkeeping
+        only — deterministic and sync-free."""
+        refd = set()
+        for slot in range(self.num_slots):
+            n = int(self._n_blocks[slot])
+            refd.update(int(b) for b in self.tables[slot, :n])
+        live = len(refd)
+        return {"live": live,
+                "trie": max(self.pool.num_used - live, 0),
+                "free": self.pool.num_free}
+
+    def slot_kv_bytes(self, slot) -> int:
+        """HBM bytes the slot's table currently holds (blocks × block
+        bytes) — the ``/debug/requests`` cost column."""
+        return int(self._n_blocks[slot]) * self.pool.block_nbytes
 
     # ------------------------------------------------------------ writes
     def write_prefill(self, slot, pk, pv, prompt_len):

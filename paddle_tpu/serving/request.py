@@ -72,7 +72,7 @@ class Sequence:
     __slots__ = ("request", "request_id", "prompt", "tokens", "status",
                  "finish_reason", "slot", "key", "submit_step", "deadline",
                  "prefix_nodes", "prefix_hit_tokens", "prefilled",
-                 "work", "restore_point", "queue_tick",
+                 "work", "restore_point", "queue_tick", "launches",
                  "t_submit", "t_admitted", "t_first_token", "t_finish",
                  "trace_mark", "trace_phase", "trace_chunk_i",
                  "trace_accepts")
@@ -114,6 +114,14 @@ class Sequence:
         # admitted batch is suffix-sorted, so arrival order cannot be
         # reconstructed from it)
         self.queue_tick = None
+        # device launches this request has ridden so far (cost
+        # attribution, README "Cost attribution & /debug/profile"):
+        # +1 per prefill/suffix/chunk/decode/verify device call whose
+        # packed rows or slot included this sequence — a shared launch
+        # counts once per participating request. Survives preemption
+        # and recovery (the recompute launches are real cost, and they
+        # are charged too).
+        self.launches = 0
         # SLO latency stamps (engine step_clock basis — injectable, so
         # chaos tests pin them deterministically): submit, FIRST slot
         # claim (kept across preemption/recovery — queue wait measures

@@ -102,6 +102,14 @@ def main(argv=None):
     ap.add_argument("--trace-buffer", type=int, default=65536,
                     help="trace ring-buffer capacity in events (oldest "
                          "dropped past it)")
+    ap.add_argument("--cost", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="device-boundary cost observatory (exact "
+                         "dispatch/transfer/compile accounting behind "
+                         "GET /debug/profile and the "
+                         "serving_dispatches_total metrics); --no-cost "
+                         "reduces every cost site to one attribute "
+                         "check")
     ap.add_argument("--watchdog-deadline", type=float, default=30.0,
                     help="supervised driver: a step slower than this "
                          "(seconds) is classified hung and the engine is "
@@ -129,6 +137,7 @@ def main(argv=None):
         headroom_mult=args.headroom_mult or None,
         spec_decode=args.spec_decode, spec_k=args.spec_k,
         trace=args.trace, trace_buffer=args.trace_buffer,
+        cost=args.cost,
         watchdog_deadline_s=args.watchdog_deadline or None,
         max_restarts=args.max_restarts,
         log_fn=None if args.quiet else
@@ -152,12 +161,16 @@ def main(argv=None):
                       # and the effective ring capacity
                       "trace": server.gateway.tracer.enabled,
                       "trace_buffer": server.gateway.tracer.capacity,
+                      # effective-value idiom: whether the cost
+                      # observatory is actually accounting
+                      "cost": server.gateway.cost is not None,
                       "watchdog_deadline_s":
                       server.gateway.watchdog_deadline_s,
                       "max_restarts": server.gateway.max_restarts,
                       "endpoints": ["/v1/completions", "/healthz",
                                     "/metrics", "/debug/trace",
-                                    "/debug/requests"]}), flush=True)
+                                    "/debug/requests",
+                                    "/debug/profile"]}), flush=True)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -73,11 +73,27 @@ import weakref
 
 import numpy as np
 
+from ...profiler.cost import PROGRAM_KINDS, CostObservatory
 from ...profiler.metrics import (QUEUE_WAIT_BUCKETS, SPEC_ACCEPT_BUCKETS,
                                  STEP_BUCKETS, TPOT_BUCKETS, TTFT_BUCKETS,
                                  MetricsRegistry)
 from ...profiler.tracing import TID_GATEWAY, SpanTracer
 from ..faults import TransientFault
+
+#: engine ``stats`` counters whose /metrics series must stay monotonic
+#: across crash-recovery rebuilds: a rebuilt engine starts its stats at
+#: zero, so the gateway carries each dead incarnation's final count as a
+#: base (the ``serving_preemptions_total`` pattern, generalized) and
+#: every scrape reads base + live. Only true counters belong here —
+#: gauges (headroom, last_step_*) must NOT be summed across engines.
+CARRIED_ENGINE_STATS = (
+    "preemptions", "prefill_copy_dispatches", "prefill_chunks",
+    "prefill_tokens_saved", "spec_proposed", "spec_accepted",
+    "spec_tokens", "decode_calls", "tokens_generated")
+
+#: same carry for the prefix cache's own stats dict (a rebuild builds a
+#: fresh trie, zeroing hits/misses/evictions).
+CARRIED_PREFIX_STATS = ("hits", "misses", "evictions")
 
 
 class QueueFullError(RuntimeError):
@@ -223,7 +239,7 @@ class ServingGateway:
                  retry_backoff_s=0.02, max_restarts=8,
                  transient_types=(TransientFault,), clock=None,
                  fault_hook=None, tracer=None, trace=False,
-                 trace_buffer=65536):
+                 trace_buffer=65536, cost=True):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.idle_wait_s = float(idle_wait_s)
@@ -250,7 +266,18 @@ class ServingGateway:
         self._fault_hook = fault_hook        # re-installed on every rebuild
         self._transient_streak = 0
         self._restarts = 0
-        self._preempt_base = 0               # dead engines' preemption sum
+        # dead engine incarnations' summed counter stats (see
+        # CARRIED_ENGINE_STATS): every /metrics series derived from
+        # engine (or prefix-cache) stats reads through _stat()/
+        # _pc_stat(), so a crash-recovery rebuild can never scrape as a
+        # counter going backwards — pinned under the fault matrix by
+        # tests/test_cost_observatory.py. The (base, pc_base, engine)
+        # triple swaps in ONE attribute store: a scrape mid-rebuild
+        # must never pair the new base with the old engine's stats
+        # (double count, then a backwards step at the engine swap).
+        self._counter_state = (dict.fromkeys(CARRIED_ENGINE_STATS, 0),
+                               dict.fromkeys(CARRIED_PREFIX_STATS, 0),
+                               engine)
         self._last_step_done = self._clock()
         self._recovering = False
         self._fault_at = None                # clock() of the fault being
@@ -276,7 +303,16 @@ class ServingGateway:
         if self.trace_persistent:
             self.tracer.enable()
         self._capture = None        # {"remaining": n, "done": Event}
+        # ---------------------------------------------- cost observatory
+        # (README "Cost attribution & /debug/profile") gateway-owned
+        # like the tracer, so dispatch/transfer/compile accounting is
+        # monotonic across engine rebuilds; ON by default (host-side
+        # dict updates, a handful per step) — ``cost=False`` reduces
+        # every engine cost site to the one _co() attribute check.
+        self.cost = CostObservatory(clock=self._clock) if cost else None
+        self._pcapture = None       # /debug/profile capture window
         engine.tracer = self.tracer
+        engine.cost = self.cost
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         if fault_hook is not None:
@@ -303,6 +339,34 @@ class ServingGateway:
         if not self._thread.is_alive():
             self._thread.start()
         return self
+
+    # ------------------------------------------------------------- helpers
+    def _tr(self):
+        """The tracer when recording, else None — the gateway's guard
+        for its own instrumentation sites (the engine's ``_tr()``
+        discipline; the guard-discipline static test pins that every
+        recording site in ``serving/`` routes through one of these)."""
+        t = self.tracer
+        return t if t.enabled else None
+
+    @property
+    def _stat_base(self) -> dict:
+        """Dead incarnations' summed engine-stat counters."""
+        return self._counter_state[0]
+
+    def _stat(self, key) -> int:
+        """A monotonic engine-stat counter: dead incarnations' carried
+        base + the live engine's count (CARRIED_ENGINE_STATS). Reads
+        base and engine from ONE snapshot so a mid-rebuild scrape
+        cannot mix epochs."""
+        base, _, eng = self._counter_state
+        return base[key] + eng.stats[key]
+
+    def _pc_stat(self, key) -> int:
+        """Same carry for prefix-cache stats (zero with no trie)."""
+        _, pc_base, eng = self._counter_state
+        pc = eng.prefix_cache
+        return pc_base[key] + (pc.stats[key] if pc is not None else 0)
 
     # ------------------------------------------------------------- metrics
     def _init_metrics(self, registry):
@@ -362,14 +426,15 @@ class ServingGateway:
         r.counter("serving_prefill_copy_dispatches_total",
                   "Block copy-in dispatches spent installing prefix "
                   "hits (dense engine only; the paged path pins this "
-                  "at 0 — hits install by reference).").set_fn(
-            lambda: self.engine.stats["prefill_copy_dispatches"])
+                  "at 0 — hits install by reference). Monotonic "
+                  "across engine rebuilds.").set_fn(
+            lambda: self._stat("prefill_copy_dispatches"))
         r.counter("serving_prefill_chunks_total",
                   "Chunked-prefill device chunks run (one per sequence "
                   "per step while a long cold prompt is interleaved "
                   "with decode; 0 with chunking off or on the dense "
-                  "engine).").set_fn(
-            lambda: self.engine.stats["prefill_chunks"])
+                  "engine). Monotonic across engine rebuilds.").set_fn(
+            lambda: self._stat("prefill_chunks"))
         # per-step telemetry: the SAME duration/token measurements the
         # engine's headroom EWMAs (adaptive chunk budget) read — the
         # driver observes them after every step() it pumps
@@ -389,18 +454,19 @@ class ServingGateway:
         # speculative-decode surface (README "Speculative decoding"):
         # registered only on a speculative engine, read THROUGH
         # self.engine so a recovery rebuild re-binds them (same idiom
-        # as the paged/prefix gauges below). Counters are engine-stat
-        # backed: a rebuild resets them, which Prometheus counter
-        # semantics absorb.
+        # as the paged/prefix gauges below). Counters read through the
+        # _stat() carry, so a rebuild never scrapes as a reset.
         self._m_spec_len = None
         if getattr(self.engine, "spec_decode", False):
             r.counter("serving_spec_proposed_total",
-                      "Draft tokens submitted to verification."
-                      ).set_fn(lambda: self.engine.stats["spec_proposed"])
+                      "Draft tokens submitted to verification. "
+                      "Monotonic across engine rebuilds."
+                      ).set_fn(lambda: self._stat("spec_proposed"))
             r.counter("serving_spec_accepted_total",
                       "Draft tokens accepted (emitted without their own "
-                      "decode launch) — the speculation win.").set_fn(
-                lambda: self.engine.stats["spec_accepted"])
+                      "decode launch) — the speculation win. Monotonic "
+                      "across engine rebuilds.").set_fn(
+                lambda: self._stat("spec_accepted"))
             self._m_spec_len = r.histogram(
                 "serving_spec_accept_length",
                 "Tokens emitted per verify span (1 = nothing accepted, "
@@ -415,8 +481,8 @@ class ServingGateway:
                     "Decode launches per emitted token under "
                     "speculation (1.0 = no speedup; ~1 / mean "
                     "acceptance length).").set_fn(
-                lambda: (self.engine.stats["decode_calls"]
-                         / max(self.engine.stats["spec_tokens"], 1)))
+                lambda: (self._stat("decode_calls")
+                         / max(self._stat("spec_tokens"), 1)))
         # fault-tolerance surface (README "Fault tolerance & chaos
         # testing"). Gateway-owned counters, NOT engine-stat-backed:
         # engine stats die with a rebuilt engine, and a restart must
@@ -439,8 +505,7 @@ class ServingGateway:
                   "Sequences preempted by recompute under KV pool "
                   "pressure (PoolExhausted: chain donated to the trie, "
                   "request re-queued). Monotonic across engine rebuilds."
-                  ).set_fn(lambda: self._preempt_base
-                           + self.engine.stats["preemptions"])
+                  ).set_fn(lambda: self._stat("preemptions"))
         r.gauge("serving_watchdog_last_step_age_seconds",
                 "Seconds since the last completed engine step (the "
                 "supervisor's hung-step signal; an orchestrator's "
@@ -464,27 +529,28 @@ class ServingGateway:
                     "table grid populated by live sequences."
                     ).set_fn(lambda: self.engine.cache.table_fill())
         if getattr(self.engine, "prefix_cache", None) is not None:
-            # scrape-time counters backed by the cache's own monotonic
-            # stats (the driver thread is the only writer; a scrape reads
-            # one int — no sync needed beyond the GIL). A rebuild starts
-            # a fresh trie: these reset, which Prometheus counter
-            # semantics absorb (rate() handles resets).
+            # scrape-time counters backed by the cache's own stats plus
+            # the gateway's carried base (the driver thread is the only
+            # writer; a scrape reads one int — no sync needed beyond
+            # the GIL). A rebuild starts a fresh trie, but the base
+            # keeps the series monotonic across it.
             r.counter("serving_prefix_cache_hits_total",
-                      "Admissions that matched a cached prefix chain."
-                      ).set_fn(
-                lambda: self.engine.prefix_cache.stats["hits"])
+                      "Admissions that matched a cached prefix chain. "
+                      "Monotonic across engine rebuilds.").set_fn(
+                lambda: self._pc_stat("hits"))
             r.counter("serving_prefix_cache_misses_total",
-                      "Admissions with no cached prefix.").set_fn(
-                lambda: self.engine.prefix_cache.stats["misses"])
+                      "Admissions with no cached prefix. Monotonic "
+                      "across engine rebuilds.").set_fn(
+                lambda: self._pc_stat("misses"))
             r.counter("serving_prefix_cache_evictions_total",
-                      "Cached blocks evicted under pool pressure."
-                      ).set_fn(
-                lambda: self.engine.prefix_cache.stats["evictions"])
+                      "Cached blocks evicted under pool pressure. "
+                      "Monotonic across engine rebuilds.").set_fn(
+                lambda: self._pc_stat("evictions"))
             r.counter("serving_prefill_tokens_saved_total",
                       "Prompt tokens served from cached KV blocks "
-                      "instead of device prefill."
-                      ).set_fn(lambda: self.engine.stats[
-                          "prefill_tokens_saved"])
+                      "instead of device prefill. Monotonic across "
+                      "engine rebuilds.").set_fn(
+                lambda: self._stat("prefill_tokens_saved"))
             r.gauge("kv_prefix_blocks",
                     "Prefix-cache pool blocks in use (published + "
                     "pinned).").set_fn(
@@ -492,6 +558,43 @@ class ServingGateway:
             r.gauge("kv_prefix_blocks_capacity",
                     "Prefix-cache pool size in blocks.").set_fn(
                 lambda: self.engine.prefix_cache.pool.num_blocks)
+        # device-boundary cost surface (README "Cost attribution &
+        # /debug/profile"): observatory-owned, so every series is
+        # monotonic across engine rebuilds by construction. One series
+        # per program kind is registered up front — unused kinds scrape
+        # as 0 rather than appearing mid-flight.
+        if self.cost is not None:
+            co = self.cost
+            disp = r.counter(
+                "serving_dispatches_total",
+                "Device program launches by program kind — the exact "
+                "host->device dispatch count the mega-kernel work is "
+                "measured against. Monotonic across engine rebuilds.")
+            for kind in PROGRAM_KINDS:
+                disp.set_fn((lambda k: lambda: co.kind_calls(k))(kind),
+                            program=kind)
+            xfer = r.counter(
+                "serving_transfer_bytes_total",
+                "Host<->device boundary bytes from abstract shapes "
+                "(h2d: host-resident argument leaves uploaded at "
+                "dispatch; d2h: result leaves the engine fetches to "
+                "host). No device sync; monotonic across rebuilds.")
+            xfer.set_fn(lambda: co.totals["h2d_bytes"], direction="h2d")
+            xfer.set_fn(lambda: co.totals["d2h_bytes"], direction="d2h")
+            r.counter("serving_program_compiles_total",
+                      "Program compile (trace) events observed at the "
+                      "jit-cache chokepoint — stays flat once warm "
+                      "(the compile-once contract, including across "
+                      "rebuilds).").set_fn(
+                lambda: co.totals["compiles"])
+            r.gauge("serving_dispatches_per_decoded_token",
+                    "Device program launches per generated token "
+                    "(all program kinds / all tokens since start) — "
+                    "the ROADMAP mega-kernel item's headline; its "
+                    "banked baseline lives in DISPATCH_BENCH.json."
+                    ).set_fn(
+                lambda: (co.totals["dispatches"]
+                         / max(self._stat("tokens_generated"), 1)))
 
     # ---------------------------------------------------------- front door
     def submit(self, request) -> TokenStream:
@@ -717,8 +820,9 @@ class ServingGateway:
     def _on_fault(self, exc):
         kind = self._classify(exc)
         self._m_faults.inc(kind=kind)
-        if self.tracer.enabled:
-            self.tracer.instant(
+        tr = self._tr()
+        if tr is not None:
+            tr.instant(
                 "fault", tid=TID_GATEWAY,
                 args={"kind": kind, "error": type(exc).__name__,
                       "message": str(exc)[:200]})
@@ -744,10 +848,17 @@ class ServingGateway:
         decides who re-enters now, who parks, and (once isolated) who
         is failed as the culprit."""
         self._recovering = True
-        tr = self.tracer if self.tracer.enabled else None
+        tr = self._tr()
         tr0 = tr.now() if tr is not None else None
         old = self.engine
-        self._preempt_base += old.stats["preemptions"]
+        # bank the dead incarnation's counter stats so every derived
+        # /metrics series stays monotonic (CARRIED_ENGINE_STATS). Built
+        # aside and swapped in below WITH the new engine — one store —
+        # so concurrent scrapes never see base and engine from
+        # different epochs.
+        base, pc_base, _ = self._counter_state
+        new_base = {k: base[k] + old.stats[k]
+                    for k in CARRIED_ENGINE_STATS}
         # best-effort PRNG-walk snapshot: per-slot current keys, so
         # sampled continuations restart mid-walk. Unreadable device
         # state (real crashes can corrupt it) only costs sampled-stream
@@ -767,9 +878,21 @@ class ServingGateway:
         new.on_token = self._on_token
         new.on_finish = self._on_finish
         new.tracer = self.tracer     # one timeline across incarnations
+        new.cost = self.cost         # one cost account, monotonic too
         if self._fault_hook is not None:
             new.fault_hook = self._fault_hook
+        new_pc = dict(pc_base)
+        if old.prefix_cache is not None \
+                and new.prefix_cache is not old.prefix_cache:
+            # bank the dead trie's stats ONLY when the factory built a
+            # fresh one (its stats restart at zero). An adopted SHARED
+            # PrefixCache instance rides into the new engine with its
+            # counts intact — banking those too would double them on
+            # every restart.
+            for k in CARRIED_PREFIX_STATS:
+                new_pc[k] += old.prefix_cache.stats[k]
         self.engine = new
+        self._counter_state = (new_base, new_pc, new)   # atomic swap
         self._restarts += 1
         self._m_restarts.inc()
         readmit, culprit = self._quarantine_plan(live)
@@ -824,8 +947,9 @@ class ServingGateway:
         active, benched = suspects[:half], suspects[half:]
         self._parked.extend(benched)
         self._suspect_ids = {s.request_id for s in active}
-        if self.tracer.enabled:
-            self.tracer.instant(
+        tr = self._tr()
+        if tr is not None:
+            tr.instant(
                 "bisection", tid=TID_GATEWAY,
                 args={"verdict": "halved", "active": len(active),
                       "parked": len(benched)})
@@ -846,8 +970,9 @@ class ServingGateway:
         half = (len(self._parked) + 1) // 2
         batch, self._parked = self._parked[:half], self._parked[half:]
         batch = [s for s in batch if not s.done]
-        if batch and self.tracer.enabled:
-            self.tracer.instant(
+        tr = self._tr()
+        if batch and tr is not None:
+            tr.instant(
                 "bisection", tid=TID_GATEWAY,
                 args={"verdict": "reenter", "reentered": len(batch),
                       "parked": len(self._parked)})
@@ -864,14 +989,14 @@ class ServingGateway:
         a terminal error event, blocking a JSON 500."""
         seq.status = "finished"
         seq.finish_reason = "error"
-        if self.tracer.enabled:
-            self.tracer.instant(
+        tr = self._tr()
+        if tr is not None:
+            tr.instant(
                 "bisection", tid=TID_GATEWAY,
                 args={"verdict": "poisoned",
-                      "request_tid": self.tracer.req_tid(seq.request_id)})
-            self.tracer.instant("finished",
-                                tid=self.tracer.req_tid(seq.request_id),
-                                args={"finish_reason": "error"})
+                      "request_tid": tr.req_tid(seq.request_id)})
+            tr.instant("finished", tid=tr.req_tid(seq.request_id),
+                       args={"finish_reason": "error"})
         stream = self._finish_teardown(seq)
         if stream is not None:
             stream._push_error(
@@ -887,15 +1012,22 @@ class ServingGateway:
         runs under the gateway lock so it cannot race the handler's
         timeout cleanup — an orphaned window must never enable the
         tracer with nobody left to read or stop it."""
-        if self._capture is None:       # lock-free fast path
-            return
+        if self._capture is None and self._pcapture is None:
+            return                      # lock-free fast path
         with self._lock:
             cap = self._capture
-            if cap is None or cap["armed"]:
-                return
-            self.tracer.clear()
-            self.tracer.enable()
-            cap["armed"] = True
+            if cap is not None and not cap["armed"]:
+                self.tracer.clear()
+                self.tracer.enable()
+                cap["armed"] = True
+            pc = self._pcapture
+            if pc is not None and not pc["armed"] \
+                    and self.cost is not None:
+                # profile window base: the accounting as of this step
+                # boundary — the returned table is exactly the next
+                # ``steps`` steps' worth of cost
+                pc["base"] = self._profile_snapshot()
+                pc["armed"] = True
 
     def _tick_capture(self):
         """Driver-side capture countdown: called after every completed
@@ -904,17 +1036,26 @@ class ServingGateway:
         exactly the asked-for steps, and the waiting handler wakes.
         Locked for the same reason as :meth:`_arm_capture`; the
         no-capture fast path stays one attribute check."""
-        if self._capture is None:       # lock-free fast path
-            return
+        if self._capture is None and self._pcapture is None:
+            return                      # lock-free fast path
         with self._lock:
             cap = self._capture
-            if cap is None or not cap["armed"]:
-                return
-            cap["remaining"] -= 1
-            if cap["remaining"] <= 0:
-                if not self.trace_persistent:
-                    self.tracer.disable()
-                cap["done"].set()
+            if cap is not None and cap["armed"]:
+                cap["remaining"] -= 1
+                if cap["remaining"] <= 0:
+                    if not self.trace_persistent:
+                        self.tracer.disable()
+                    cap["done"].set()
+            pc = self._pcapture
+            if pc is not None and pc["armed"]:
+                pc["remaining"] -= 1
+                if pc["remaining"] <= 0 and pc["end"] is None:
+                    # freeze the window's END at this exact step
+                    # boundary: the driver keeps stepping while the
+                    # waiting handler wakes, and those later steps
+                    # must not leak into the N-step document
+                    pc["end"] = self._profile_snapshot()
+                    pc["done"].set()
 
     def capture_trace(self, steps=32, timeout_s=30.0):
         """Capture ``steps`` engine steps of trace and return the
@@ -957,6 +1098,91 @@ class ServingGateway:
                     tr.disable()
         return tr.export()
 
+    # ------------------------------------------------------ cost profile
+    def _profile_snapshot(self) -> dict:
+        """One consistent reading of the accounting + token count (the
+        base or frozen end of a step-bounded window)."""
+        return {"cost": self.cost.snapshot_full(),
+                "tokens": self._stat("tokens_generated")}
+
+    def profile_doc(self, base=None, window_steps=None, at=None) -> dict:
+        """The cost-attribution document (the ``GET /debug/profile``
+        body): per-program calls / transfer bytes / compile events /
+        wall EWMA / share of the window's wall, phase attribution, and
+        the per-decoded-token rates the mega-kernel work is gated on.
+        ``base``/``at`` bound the window (prior
+        :meth:`_profile_snapshot` readings; None = gateway start /
+        now)."""
+        co = self.cost
+        if co is None:
+            raise RuntimeError(
+                "cost observatory disabled (gateway built with "
+                "cost=False)")
+        doc = co.export(base=(base or {}).get("cost"),
+                        at=(at or {}).get("cost"))
+        tokens = ((at["tokens"] if at is not None
+                   else self._stat("tokens_generated"))
+                  - (base or {}).get("tokens", 0))
+        t = doc["totals"]
+        t["decoded_tokens"] = tokens
+        t["dispatches_per_decoded_token"] = round(
+            t["dispatches"] / max(tokens, 1), 6)
+        t["h2d_bytes_per_decoded_token"] = round(
+            t["h2d_bytes"] / max(tokens, 1), 3)
+        t["d2h_bytes_per_decoded_token"] = round(
+            t["d2h_bytes"] / max(tokens, 1), 3)
+        doc["window_steps"] = window_steps
+        return doc
+
+    def capture_profile(self, steps=0, timeout_s=30.0) -> dict:
+        """Aggregate cost attribution (``steps <= 0``: everything since
+        gateway start), or a STEP-BOUNDED window: block until the
+        driver completes ``steps`` engine steps and return only that
+        window's costs — the same arm-at-a-step-boundary /
+        count-completed-steps machinery as :meth:`capture_trace`, and
+        the same serialization rule (a second concurrent window raises
+        :class:`TraceBusyError` → HTTP 409)."""
+        if self.cost is None:
+            raise RuntimeError(
+                "cost observatory disabled (gateway built with "
+                "cost=False)")
+        if steps <= 0:
+            return self.profile_doc()
+        timeout_s = min(max(float(timeout_s), 0.0), 3600.0)
+        with self._lock:
+            if self._pcapture is not None:
+                raise TraceBusyError(
+                    "a profile capture is already in progress")
+            done = threading.Event()
+            self._pcapture = {"remaining": int(steps), "done": done,
+                              "armed": False, "base": None,
+                              "end": None, "steps": int(steps)}
+        try:
+            self._wake.set()
+            done.wait(timeout_s)
+        finally:
+            with self._lock:
+                pc, self._pcapture = self._pcapture, None
+                if pc["end"] is None:
+                    # timed out mid-window: freeze the end NOW, under
+                    # the lock, so it is consistent with `remaining`
+                    pc["end"] = self._profile_snapshot()
+        # report the steps the window actually captured, not the ask: a
+        # timed-out capture (slow engine, or a window that never armed
+        # because the driver is idle/dead) must not label lifetime or
+        # partial totals as an N-step window — per-step rates derived
+        # from the document would be silently off. A never-armed window
+        # captured NOTHING: its base is its end (empty deltas), never
+        # the lifetime aggregate with a 0-step label.
+        armed = pc["base"] is not None
+        completed = (min(pc["steps"] - max(pc["remaining"], 0),
+                         pc["steps"]) if armed else 0)
+        doc = self.profile_doc(base=pc["base"] if armed else pc["end"],
+                               window_steps=completed, at=pc["end"])
+        doc["window_steps_requested"] = pc["steps"]
+        doc["window_truncated"] = completed < pc["steps"]
+        return doc
+
     # ------------------------------------------------------ debug surface
     def request_table(self) -> list:
         """Live request table (the ``GET /debug/requests`` body): one
@@ -985,7 +1211,8 @@ class ServingGateway:
                          "queue_wait_s": round(wall - st.submit_time, 6),
                          "ttft_s": None,
                          "tpot_s": None, "kv_tokens": 0,
-                         "kv_blocks": None})
+                         "kv_blocks": None,
+                         "launches": 0, "kv_bytes": 0})
         for st in live:
             seq = st.seq
             slot = seq.slot
@@ -996,9 +1223,10 @@ class ServingGateway:
             if tpot is None and seq.t_first_token is not None \
                     and len(seq.tokens) > 1:
                 tpot = (now - seq.t_first_token) / (len(seq.tokens) - 1)
-            kv_tokens, kv_blocks = 0, None
+            kv_tokens, kv_blocks, kv_bytes = 0, None, 0
             if slot is not None:
                 kv_tokens = int(eng.cache.lengths[slot])
+                kv_bytes = eng.cache.slot_kv_bytes(slot)
                 if getattr(eng, "_paged", False):
                     kv_blocks = len(eng.cache.slot_block_ids(slot))
             rows.append({
@@ -1015,6 +1243,13 @@ class ServingGateway:
                 "tpot_s": None if tpot is None else round(tpot, 6),
                 "kv_tokens": kv_tokens,
                 "kv_blocks": kv_blocks,
+                # cost columns (README "Cost attribution &
+                # /debug/profile"): device launches this request has
+                # ridden so far, and the HBM bytes its KV currently
+                # holds (paged: blocks x block bytes; dense: rows x
+                # row bytes)
+                "launches": seq.launches,
+                "kv_bytes": kv_bytes,
             })
         return rows
 

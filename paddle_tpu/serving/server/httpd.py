@@ -25,7 +25,14 @@ Endpoints:
   ``steps=0`` snapshots the current buffer (the persistent ``--trace``
   mode's read); a concurrent capture gets 409.
 - ``GET /debug/requests`` — live request table: per-request state,
-  slot, token progress, queue-wait/TTFT/TPOT-so-far, KV footprint.
+  slot, token progress, queue-wait/TTFT/TPOT-so-far, KV footprint plus
+  the cost columns (device launches ridden, KV bytes held).
+- ``GET /debug/profile`` — the cost observatory's aggregated
+  cost-attribution table (per-program dispatches, host<->device bytes,
+  compile events, wall EWMA / share of wall, per-decoded-token rates;
+  README "Cost attribution & /debug/profile"). ``steps=N`` bounds the
+  window to the next N engine steps like ``/debug/trace``; a
+  concurrent window gets 409.
 
 Load shedding maps gateway signals onto status codes: full waiting
 room → 429 (with Retry-After), draining gateway → 503, validation →
@@ -143,6 +150,25 @@ class _Handler(BaseHTTPRequestHandler):
                                                  timeout_s=timeout_s)
             except TraceBusyError as e:
                 self._error(409, str(e), "conflict")
+                return
+            self._send_json(200, doc)
+        elif path == "/debug/profile":
+            qs = parse_qs(query)
+            try:
+                steps = int(qs.get("steps", ["0"])[0])
+                timeout_s = float(qs.get("timeout_s", ["30"])[0])
+            except ValueError as e:
+                self._error(400, f"bad query parameter: {e}",
+                            "invalid_request")
+                return
+            try:
+                doc = self.gateway.capture_profile(steps=steps,
+                                                   timeout_s=timeout_s)
+            except TraceBusyError as e:
+                self._error(409, str(e), "conflict")
+                return
+            except RuntimeError as e:   # cost observatory disabled
+                self._error(404, str(e), "unavailable")
                 return
             self._send_json(200, doc)
         elif path == "/debug/requests":
@@ -357,7 +383,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           paged_attn=True, prefill_chunk=512, ragged_step=True,
           headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
-          drafter=None, trace=False, trace_buffer=65536):
+          drafter=None, trace=False, trace_buffer=65536, cost=True):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -448,7 +474,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
         engine_factory=engine_factory,
         watchdog_deadline_s=watchdog_deadline_s,
         max_restarts=max_restarts, fault_hook=fault_hook, clock=clock,
-        trace=trace, trace_buffer=trace_buffer)
+        trace=trace, trace_buffer=trace_buffer, cost=cost)
     server = ServingHTTPServer(
         gateway, host=host, port=port,
         model_name=model_name or type(model).__name__, log_fn=log_fn)

@@ -142,7 +142,7 @@ def measure_chaos(quick=True):
     streams, reasons, gw, wall = _run_gateway(model, s_max, reqs, plan=plan)
     lost = sum(1 for r in reasons if r not in
                ("stop", "length", "cancelled", "timeout"))
-    preemptions = gw._preempt_base + gw.engine.stats["preemptions"]
+    preemptions = gw._stat("preemptions")
     lat = list(gw.restart_latencies)
     chaos = {
         "requests_lost": lost,

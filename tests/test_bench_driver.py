@@ -80,6 +80,7 @@ class TestBenchDriverFlow:
         assert art["spec_decode"]["ok"] is False
         assert art["chaos"]["ok"] is False
         assert art["trace_overhead"]["ok"] is False
+        assert art["dispatch"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -155,6 +156,14 @@ class TestBenchDriverFlow:
                                       "disabled_overhead_ratio": 1.002,
                                       "accepted": True,
                                       "tokens_equal": True}), ""
+            if leg == "--dispatch":
+                # dispatch-cost leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps(
+                    {"name": "dispatch", "ok": True,
+                     "baseline_dispatches_per_decoded_token": 0.32,
+                     "exact_vs_program_accessors": True,
+                     "accepted": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -189,10 +198,11 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:9] == ["--decode-cb", "--serve-http",
-                             "--prefix-cache", "--paged-attn",
-                             "--chunked-prefill", "--ragged", "--spec",
-                             "--chaos", "--trace-overhead"]
+        assert order[:10] == ["--decode-cb", "--serve-http",
+                              "--prefix-cache", "--paged-attn",
+                              "--chunked-prefill", "--ragged", "--spec",
+                              "--chaos", "--trace-overhead",
+                              "--dispatch"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -209,6 +219,8 @@ class TestBenchDriverFlow:
         assert art["chaos"]["chaos"]["requests_lost"] == 0
         assert art["trace_overhead"]["accepted"] is True
         assert art["trace_overhead"]["disabled_overhead_ratio"] == 1.002
+        assert art["dispatch"]["accepted"] is True
+        assert art["dispatch"]["exact_vs_program_accessors"] is True
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

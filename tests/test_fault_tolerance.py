@@ -544,7 +544,7 @@ class TestHealthAndMetrics:
         age = fams["serving_watchdog_last_step_age_seconds"]
         assert age["type"] == "gauge"
         # preemptions stay monotonic across the rebuild (base carried)
-        assert gw._preempt_base == 1
+        assert gw._stat_base["preemptions"] == 1
         gw.shutdown(drain=True, timeout=30)
 
     def test_healthz_reports_watchdog_and_restarts(self, model):

@@ -89,7 +89,10 @@ def validate_chrome_trace(doc, require_events=True):
         assert evs, "empty trace"
     for e in evs:
         assert {"name", "ph", "ts", "pid", "tid"} <= set(e), e
-        assert e["ph"] in ("X", "i"), e
+        assert e["ph"] in ("X", "i", "C"), e
+        if e["ph"] == "C":      # counter samples carry numeric series
+            assert e["args"] and all(
+                isinstance(v, (int, float)) for v in e["args"].values())
         assert e["ts"] >= 0
         assert isinstance(e["tid"], int) and isinstance(e["pid"], int)
         if e["ph"] == "X":
