@@ -47,6 +47,12 @@ byte-identically; :mod:`.faults` is the deterministic fault-injection
 harness (:class:`FaultPlan` / :class:`VirtualClock`) the chaos tests
 and ``scripts/bench_chaos.py`` drive.
 
+Scale-out: :mod:`paddle_tpu.serving.fleet` (README "Engine fleet")
+replicates the whole stack — N shared-nothing supervised engines
+behind one routed front door with prefix-affinity routing,
+failover-to-sibling on replica death, and live request migration
+built on :meth:`ContinuousBatchingEngine.evict` + ``restore()``.
+
 The HTTP layer on top lives in :mod:`paddle_tpu.serving.server`
 (imported lazily — the engine has no HTTP dependency).
 """

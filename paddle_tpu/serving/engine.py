@@ -1212,33 +1212,29 @@ class ContinuousBatchingEngine:
         self._preempt(max(victims, key=lambda s: s.request_id))
         return True
 
-    def _preempt(self, seq):
-        """Preemption-by-recompute: free the sequence's slot NOW —
-        donating its written chain (prompt + generated blocks) to the
-        prefix trie when one is on, exactly like retirement — and
-        re-queue it via :meth:`restore`. Because the chain was just
-        donated, the recompute prefill is typically a zero-copy trie
-        hit; the PRNG walk snapshot keeps the continuation
-        byte-identical. Nothing is emitted and the sequence does not
-        finish — consumers just see a pause."""
-        self.stats["preemptions"] += 1
+    def _displace(self, seq, reason):
+        """Slot teardown shared by preemption (:meth:`_preempt`) and
+        cross-engine eviction (:meth:`evict`) — free the sequence's
+        slot NOW, donating its written chain (prompt + generated
+        blocks) to the prefix trie when one is on, exactly like
+        retirement, and snapshot the slot's CURRENT PRNG key — what the
+        next decode tick would have sampled with — so the recomputed
+        continuation resumes the identical walk. A mid-recompute
+        (prefilling, ``restore_point > 0``) sequence keeps the snapshot
+        it already carries: its key was never installed into the slot
+        array. Leaves the sequence slotless and un-queued; the caller
+        decides which engine's :meth:`restore` re-admits it."""
         slot = seq.slot
         tr = self._tr()
         if tr is not None:
             self._trace_phase_end(
-                tr, seq, args={"preempted": True,
+                tr, seq, args={reason: True,
                                "tokens": len(seq.tokens)})
-            tr.instant("preempted", tid=tr.req_tid(seq.request_id),
+            tr.instant(reason, tid=tr.req_tid(seq.request_id),
                        args={"slot": slot})
         if seq.status == "prefilling":
             self.scheduler.leave_prefill(seq)
         if seq.tokens and seq.status == "running":
-            # the slot's CURRENT key state — what the next decode tick
-            # would have sampled with — so the recomputed continuation
-            # resumes the identical PRNG walk. A mid-recompute
-            # (prefilling, restore_point > 0) sequence keeps the
-            # snapshot it already carries: its key was never installed
-            # into the slot array.
             seq.key = np.asarray(self._keys, np.uint32)[slot].copy()
         self._slots[slot] = None
         self._temps[slot] = 0.0
@@ -1249,8 +1245,41 @@ class ContinuousBatchingEngine:
             self.prefix_cache.release(seq.prefix_nodes)
             seq.prefix_nodes = []
         seq.slot = None
+
+    def _preempt(self, seq):
+        """Preemption-by-recompute: displace the sequence
+        (:meth:`_displace` — chain donated, PRNG snapshotted) and
+        re-queue it HERE via :meth:`restore`. Because the chain was
+        just donated, the recompute prefill is typically a zero-copy
+        trie hit; the PRNG walk snapshot keeps the continuation
+        byte-identical. Nothing is emitted and the sequence does not
+        finish — consumers just see a pause."""
+        self.stats["preemptions"] += 1
+        self._displace(seq, "preempted")
         self.restore(seq)
         seq.trace_phase = "preempted"   # restore() named it "recovered"
+
+    def evict(self, seq: Sequence) -> bool:
+        """Remove a LIVE sequence from this engine for cross-engine
+        migration (the fleet's live request migration / drain path):
+        same displacement as preemption — chain donated to THIS
+        engine's trie, PRNG walk snapshotted — but ownership leaves
+        the engine: the caller re-admits via a SIBLING engine's
+        :meth:`restore`, which rebuilds KV by recompute so the
+        continuation is byte-identical on the new engine. A
+        still-queued sequence is simply removed from the scheduler
+        (nothing to displace). Must be called from the thread driving
+        :meth:`step`. Returns False for a finished sequence or one
+        this engine does not hold."""
+        if seq.done:
+            return False
+        if seq.status == "queued":
+            return self.scheduler.remove(seq)
+        if seq.slot is None or self._slots[seq.slot] is not seq:
+            return False
+        self._displace(seq, "evicted")
+        seq.status = "queued"   # slotless, awaiting the target restore
+        return True
 
     def restore(self, seq: Sequence) -> bool:
         """Re-enqueue a LIVE sequence for recovery-by-recompute (crash

@@ -1,0 +1,32 @@
+"""Engine fleet: replicated serving with prefix-affinity routing,
+failover-to-sibling, and live request migration (README "Engine
+fleet"; the ROADMAP multi-tenant scale-out item, step a).
+
+Public surface:
+
+- :class:`EngineFleet` — N supervised engine replicas (each a PR-7
+  gateway: own paged pool, prefix trie, scheduler, supervisor) behind
+  one routing front door, with compiled programs shared per pool
+  geometry, one ``replica``-labeled metrics registry, failover of a
+  dead replica's live requests to siblings, and live migration /
+  drain / rebalance built on ``engine.evict()`` + ``restore()``;
+- :class:`FleetReplica` — one replica's fleet-side handle (router
+  signals + the ``/debug/fleet`` row);
+- :class:`Router` / :class:`RoundRobinRouter` /
+  :class:`LeastLoadedRouter` / :class:`PrefixAffinityRouter` /
+  :func:`make_router` — the pluggable routing policies.
+
+The HTTP surface (``--replicas N`` / ``serve_fleet()``: routed
+``/v1/completions``, ``GET /debug/fleet``, ``POST /fleet/drain`` and
+``POST /fleet/rebalance``) lives in
+:mod:`paddle_tpu.serving.server.httpd`.
+"""
+from .fleet import EngineFleet
+from .replica import FleetReplica
+from .router import (LeastLoadedRouter, PrefixAffinityRouter,
+                     RoundRobinRouter, Router, make_router)
+
+__all__ = [
+    "EngineFleet", "FleetReplica", "Router", "RoundRobinRouter",
+    "LeastLoadedRouter", "PrefixAffinityRouter", "make_router",
+]

@@ -15,14 +15,18 @@ Two layers (both stdlib-only):
   "Tracing & debugging").
 
 Run one with ``python -m paddle_tpu.serving.server`` (or
-``scripts/serve.py``).
+``scripts/serve.py``). ``--replicas N`` / :func:`serve_fleet` fronts N
+shared-nothing engine replicas behind the same surface (README "Engine
+fleet"): routed admissions, ``replica``-labeled metrics,
+``GET /debug/fleet``, ``POST /fleet/drain|rebalance``, and
+failover-to-sibling on replica death.
 """
 from .gateway import (GatewayClosedError, QueueFullError, ServingGateway,
                       TokenStream, TraceBusyError, WatchdogTimeout)
-from .httpd import ServingHTTPServer, serve
+from .httpd import ServingHTTPServer, serve, serve_fleet
 
 __all__ = [
     "ServingGateway", "TokenStream", "QueueFullError",
     "GatewayClosedError", "WatchdogTimeout", "TraceBusyError",
-    "ServingHTTPServer", "serve",
+    "ServingHTTPServer", "serve", "serve_fleet",
 ]

@@ -46,7 +46,33 @@ def main(argv=None):
     ap.add_argument("--decode-attention", choices=("pallas", "jnp"),
                     default="jnp",
                     help="ragged Pallas decode kernel or the jnp oracle")
-    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine fleet size (README 'Engine fleet'): "
+                         ">1 fronts N shared-nothing engine replicas "
+                         "behind one routed gateway — per-replica "
+                         "paged pool/prefix trie/supervisor, compiled "
+                         "programs shared per pool geometry, "
+                         "replica-labeled /metrics, /debug/fleet, "
+                         "POST /fleet/drain|rebalance, and failover-"
+                         "to-sibling on replica death")
+    ap.add_argument("--router",
+                    choices=("round-robin", "least-loaded", "affinity"),
+                    default="affinity",
+                    help="fleet routing policy (--replicas > 1): "
+                         "round-robin, least-loaded (live KV blocks + "
+                         "queue depth), or affinity (longest cached-"
+                         "prefix match within a load band; the "
+                         "default)")
+    ap.add_argument("--affinity-band", type=int, default=16,
+                    help="affinity router's load band (KV blocks + "
+                         "queued requests): replicas loaded more than "
+                         "this past the minimum are skipped no matter "
+                         "how warm their trie is")
+    ap.add_argument("--num-slots", default="8",
+                    help="KV slots per engine; with --replicas > 1 a "
+                         "comma list gives each replica its own value "
+                         "(e.g. 8,4 — differing pool geometries keep "
+                         "isolated jit caches)")
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--decode-chunk", type=int, default=1,
                     help=">1 fuses decode ticks (adds streaming latency)")
@@ -124,10 +150,73 @@ def main(argv=None):
                     help="suppress per-request access logs")
     args = ap.parse_args(argv)
 
-    from .httpd import serve
+    from .httpd import serve, serve_fleet
+    try:
+        slots = [int(s) for s in str(args.num_slots).split(",")
+                 if s.strip()]
+    except ValueError:
+        ap.error(f"--num-slots must be an int or a comma list of ints, "
+                 f"got {args.num_slots!r}")
+    if not slots:
+        ap.error(f"--num-slots must name at least one value, "
+                 f"got {args.num_slots!r}")
+    if len(slots) > 1 and args.replicas <= 1:
+        ap.error("--num-slots with a comma list needs --replicas > 1 "
+                 "(one value per replica)")
+    if len(slots) > 1 and len(slots) != args.replicas:
+        ap.error(f"--num-slots names {len(slots)} values for "
+                 f"--replicas {args.replicas}")
     model = build_model(args.preset, args.decode_attention, args.seed)
+    if args.replicas > 1:
+        num_slots = slots if len(slots) > 1 else slots[0]
+        server = serve_fleet(
+            model, replicas=args.replicas, router=args.router,
+            affinity_band=args.affinity_band,
+            host=args.host, port=args.port, num_slots=num_slots,
+            max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
+            max_queue=args.max_queue, model_name=f"llama-{args.preset}",
+            prefix_cache=args.prefix_cache,
+            prefix_blocks=args.prefix_blocks,
+            prefix_block_size=args.prefix_block_size,
+            paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
+            ragged_step=args.ragged_step,
+            headroom_mult=args.headroom_mult or None,
+            spec_decode=args.spec_decode, spec_k=args.spec_k,
+            trace=args.trace, trace_buffer=args.trace_buffer,
+            cost=args.cost,
+            watchdog_deadline_s=args.watchdog_deadline or None,
+            max_restarts=args.max_restarts,
+            log_fn=None if args.quiet else
+            (lambda m: print(m, file=sys.stderr)))
+        fleet = server.fleet
+        print(json.dumps({
+            "listening": server.url, "preset": args.preset,
+            "replicas": len(fleet.replicas),
+            "router": fleet.router.name,
+            "num_slots": [r.gateway.engine.num_slots
+                          for r in fleet.replicas],
+            "prefix_cache": bool(args.prefix_cache),
+            "paged_attn": bool(args.paged_attn),
+            "prefill_chunk": [r.gateway.engine.prefill_chunk
+                              for r in fleet.replicas],
+            "spec_decode": fleet.replicas[0].gateway.engine.spec_decode,
+            "trace": fleet.tracer.enabled,
+            "cost": fleet.replicas[0].gateway.cost is not None,
+            "endpoints": ["/v1/completions", "/healthz", "/metrics",
+                          "/debug/trace", "/debug/requests",
+                          "/debug/profile", "/debug/fleet",
+                          "/fleet/drain", "/fleet/rebalance"]}),
+            flush=True)
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+        stop.wait()
+        print("# draining fleet...", file=sys.stderr)
+        server.shutdown(drain=True, timeout=60)
+        print("# stopped", file=sys.stderr)
+        return 0
     server = serve(
-        model, host=args.host, port=args.port, num_slots=args.num_slots,
+        model, host=args.host, port=args.port, num_slots=slots[0],
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
         max_queue=args.max_queue, model_name=f"llama-{args.preset}",
         prefix_cache=args.prefix_cache, prefix_blocks=args.prefix_blocks,
@@ -143,7 +232,7 @@ def main(argv=None):
         log_fn=None if args.quiet else
         (lambda m: print(m, file=sys.stderr)))
     print(json.dumps({"listening": server.url, "preset": args.preset,
-                      "num_slots": args.num_slots,
+                      "num_slots": slots[0],
                       "prefix_cache": bool(args.prefix_cache),
                       "paged_attn": bool(args.paged_attn),
                       # report what actually runs: the engine's

@@ -86,6 +86,13 @@ from ..faults import TransientFault
 #: base (the ``serving_preemptions_total`` pattern, generalized) and
 #: every scrape reads base + live. Only true counters belong here —
 #: gauges (headroom, last_step_*) must NOT be summed across engines.
+#: MULTI-ENGINE scrapes (the fleet): the carry is PER GATEWAY — each
+#: replica's gateway owns its own ``(base, engine)`` snapshot and
+#: registers its series through a ``registry.labeled(replica=...)``
+#: view, so N replicas share one /metrics document, every series is
+#: distinguished by its ``replica`` label, and any SINGLE replica
+#: rebuilding re-bases only its own series (the others never move) —
+#: a fleet scrape can never observe a counter going backwards.
 CARRIED_ENGINE_STATS = (
     "preemptions", "prefill_copy_dispatches", "prefill_chunks",
     "prefill_tokens_saved", "spec_proposed", "spec_accepted",
@@ -239,7 +246,8 @@ class ServingGateway:
                  retry_backoff_s=0.02, max_restarts=8,
                  transient_types=(TransientFault,), clock=None,
                  fault_hook=None, tracer=None, trace=False,
-                 trace_buffer=65536, cost=True):
+                 trace_buffer=65536, cost=True, on_fatal=None,
+                 stream_id_prefix="cmpl"):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.idle_wait_s = float(idle_wait_s)
@@ -251,6 +259,11 @@ class ServingGateway:
         self._closed = False
         self._drain = True
         self._ids = itertools.count(1)
+        # stream-id namespace: the fleet gives each replica's gateway
+        # its own prefix so ids stay unique across the whole fleet
+        # (completion ids are client-visible and land in the router
+        # decision log)
+        self._id_prefix = str(stream_id_prefix)
         # ----------------------------------------------- supervision state
         # engine_factory() -> a fresh engine with the SAME config and the
         # SAME shared jit_cache (so recovery never re-traces); None
@@ -264,8 +277,16 @@ class ServingGateway:
         self.transient_types = tuple(transient_types)
         self._clock = clock if clock is not None else time.monotonic
         self._fault_hook = fault_hook        # re-installed on every rebuild
+        # fleet failover hook: called (gateway, [(stream, seq|None)])
+        # from the dying driver thread when supervision is exhausted,
+        # BEFORE the streams are stranded with errors; returning True
+        # means the callee (the fleet) took ownership — it re-admits
+        # each live sequence on a sibling replica via restore() — and
+        # the handed-off streams get no error event here.
+        self.on_fatal = on_fatal
         self._transient_streak = 0
         self._restarts = 0
+        self.last_restart_at = None          # clock() of the last rebuild
         # dead engine incarnations' summed counter stats (see
         # CARRIED_ENGINE_STATS): every /metrics series derived from
         # engine (or prefix-cache) stats reads through _stat()/
@@ -286,6 +307,14 @@ class ServingGateway:
         self._probation = set()   # ids readmitted by the last recovery
         self._suspect_ids = None  # active bisection half (None = off)
         self._parked = []         # Sequences held out of the engine
+        # live-migration intake/outtake (the fleet's request-migration
+        # plane): adopt() enqueues (stream, seq) pairs arriving FROM a
+        # sibling (seq None = never engine-admitted, submit fresh);
+        # request_migration() enqueues (stream, handoff) pairs leaving
+        # for one. Both are drained by the driver between steps — the
+        # engine mutation (restore/evict) happens only on its thread.
+        self._migrate_in = collections.deque()
+        self._migrate_out = collections.deque()
         # ------------------------------------------------ tracing state
         # (README "Tracing & debugging") the gateway OWNS the tracer so
         # one timeline survives engine rebuilds; it is installed on
@@ -501,6 +530,13 @@ class ServingGateway:
             "Live requests re-enqueued for recompute after an engine "
             "rebuild (each readmission counts, including bisection "
             "re-entries).")
+        # zero-seed the label-free incremented counters so every
+        # gateway's series exists from the first scrape — a fleet
+        # replica that never restarted must scrape as an explicit 0,
+        # not an absent series (dashboards diff replicas)
+        for m in (self._m_requests, self._m_rejected, self._m_tokens,
+                  self._m_restarts, self._m_recovered):
+            m.inc(0)
         r.counter("serving_preemptions_total",
                   "Sequences preempted by recompute under KV pool "
                   "pressure (PoolExhausted: chain donated to the trie, "
@@ -612,11 +648,43 @@ class ServingGateway:
                 raise QueueFullError(
                     f"waiting room full ({self.max_queue} requests)")
             self._backlog += 1
-            stream = TokenStream(self, request, f"cmpl-{next(self._ids)}")
+            stream = TokenStream(self, request,
+                                 f"{self._id_prefix}-{next(self._ids)}")
             self._intake.append(stream)
         self._m_requests.inc()
         self._wake.set()
         return stream
+
+    def adopt(self, stream, seq=None):
+        """Take over a live request from a sibling gateway (fleet
+        failover / live migration). Thread-safe: enqueues the pair; the
+        driver re-admits between steps — ``seq`` (the sibling's evicted
+        / crash-snapshotted Sequence, PRNG walk included) re-enters via
+        ``engine.restore`` so its stream continues byte-identically,
+        while ``seq=None`` (a request the sibling never engine-
+        admitted) submits fresh. The stream is re-pointed at THIS
+        gateway, so cancellation and token delivery follow it over."""
+        with self._lock:
+            if self._closed:
+                raise GatewayClosedError("gateway is draining")
+            stream.gateway = self
+            if stream._waiting:
+                # the waiting-room seat moves with the stream (the
+                # source decremented its own count at handoff)
+                self._backlog += 1
+            self._migrate_in.append((stream, seq))
+        self._wake.set()
+
+    def request_migration(self, stream, handoff):
+        """Ask the driver to evict ``stream``'s live sequence from this
+        engine between steps and call ``handoff(stream, seq)`` — on the
+        driver thread — once it is displaced (chain donated, PRNG
+        snapshotted; ``seq`` is None when the request never reached the
+        engine). The fleet's handoff adopts the pair on a sibling.
+        Thread-safe; a no-op for streams that finish first."""
+        with self._lock:
+            self._migrate_out.append((stream, handoff))
+        self._wake.set()
 
     @property
     def queue_depth(self):
@@ -699,6 +767,117 @@ class ServingGateway:
             stream.seq = seq
             self._live[seq.request_id] = stream
 
+    def _admit_migrations(self):
+        """Driver-side intake of requests adopted from a sibling
+        gateway (fleet failover / live migration): a carried Sequence
+        re-enters via ``engine.restore`` — recompute from host token
+        state + the PRNG snapshot, so the stream continues
+        byte-identically — and a bare request (never engine-admitted on
+        the source) submits fresh. Cancellation that raced the
+        migration is honored here, exactly like the intake path."""
+        while True:
+            with self._lock:
+                if not self._migrate_in:
+                    return
+                stream, seq = self._migrate_in.popleft()
+            if stream._cancel:
+                if seq is not None and not seq.done:
+                    seq.status = "finished"
+                    seq.finish_reason = "cancelled"
+                self._leave_waiting_room(stream)
+                self._m_finished.inc(reason="cancelled")
+                stream._push_finish("cancelled")
+                continue
+            if seq is None:
+                try:
+                    seq = self.engine.submit(stream.request)
+                except Exception as e:
+                    self._leave_waiting_room(stream)
+                    stream._push_error(e)
+                    continue
+            elif seq.done:
+                # finished in flight between gateways (shouldn't
+                # happen — eviction only hands off live sequences —
+                # but a terminal event beats a stranded consumer)
+                self._leave_waiting_room(stream)
+                self._m_finished.inc(reason=seq.finish_reason)
+                stream._push_finish(seq.finish_reason)
+                continue
+            elif (seq.prompt_len + int(seq.request.max_new_tokens)
+                    > self.engine.max_seq_len):
+                # belt + braces under the fleet's can_hold selection:
+                # an adoption this engine cannot hold to completion
+                # must terminate cleanly, never crash the driver
+                # mid-recompute (which would count as a fatal fault
+                # and cascade a fresh failover of the same sequence)
+                self._leave_waiting_room(stream)
+                stream._push_error(
+                    f"migrated sequence needs "
+                    f"{seq.prompt_len + int(seq.request.max_new_tokens)}"
+                    f" KV rows; this engine holds "
+                    f"{self.engine.max_seq_len}")
+                continue
+            elif self.engine.restore(seq):
+                self._m_recovered.inc()
+            stream.seq = seq
+            self._live[seq.request_id] = stream
+
+    def _apply_migrate_out(self):
+        """Driver-side eviction for live migration: displace each
+        requested stream's sequence from this engine (chain donated,
+        PRNG snapshotted — ``engine.evict``) and hand the pair to the
+        fleet's ``handoff`` on this thread. A failed handoff (sibling
+        draining) restores the sequence locally — a migration may be
+        refused, but it may never lose a request."""
+        while True:
+            with self._lock:
+                if not self._migrate_out:
+                    return
+                stream, handoff = self._migrate_out.popleft()
+            seq = stream.seq
+            if seq is None:
+                # still in this gateway's intake (not yet admitted):
+                # hand the bare request over instead
+                with self._lock:
+                    try:
+                        self._intake.remove(stream)
+                    except ValueError:
+                        continue        # finished/cancelled/raced away
+                    if stream._waiting:
+                        self._backlog -= 1
+                try:
+                    handoff(stream, None)
+                except Exception:
+                    with self._lock:
+                        if stream._waiting:
+                            self._backlog += 1
+                        self._intake.append(stream)
+                continue
+            if seq.done or self._live.get(seq.request_id) is not stream:
+                continue                # finished, or already handed off
+            if any(p is seq for p in self._parked) or (
+                    self._suspect_ids
+                    and seq.request_id in self._suspect_ids):
+                continue                # mid-bisection: not migratable
+            if not self.engine.evict(seq):
+                continue
+            del self._live[seq.request_id]
+            self._probation.discard(seq.request_id)
+            if stream._waiting:
+                with self._lock:
+                    self._backlog -= 1
+            try:
+                handoff(stream, seq)
+            except Exception:
+                # refused by the target: re-admit HERE by recompute —
+                # the request stays live either way
+                if stream._waiting:
+                    with self._lock:
+                        self._backlog += 1
+                if self.engine.restore(seq):
+                    self._m_recovered.inc()
+                    self._live[seq.request_id] = stream
+
     def _apply_cancels(self):
         for stream in [s for s in self._live.values() if s._cancel]:
             seq = stream.seq
@@ -733,8 +912,10 @@ class ServingGateway:
         try:
             while True:
                 self._arm_capture()
+                self._admit_migrations()
                 self._admit_intake()
                 self._apply_cancels()
+                self._apply_migrate_out()
                 self._sweep_parked_deadlines()
                 self._advance_bisection()
                 if self.engine.has_work():
@@ -742,7 +923,8 @@ class ServingGateway:
                     continue
                 with self._lock:
                     drained = (not self._intake and not self._live
-                               and not self._parked)
+                               and not self._parked
+                               and not self._migrate_in)
                     if self._closed and drained:
                         return
                 # idle is provably not hung: refresh the watchdog
@@ -754,16 +936,24 @@ class ServingGateway:
                 self._wake.clear()
         except BaseException as e:
             # supervision exhausted (max_restarts, no factory, or a
-            # non-Exception): the driver is the only thread that can
-            # unblock consumers — it must not strand them mid-result()
+            # non-Exception). FLEET FAILOVER first: offer every live
+            # request — snapshotted exactly like a rebuild's recovery —
+            # to the on_fatal hook, which re-admits them on a sibling
+            # replica; only requests nobody adopted are stranded. The
+            # driver is the only thread that can unblock consumers — it
+            # must not strand them mid-result().
+            handed = self._failover_handoff()
             with self._lock:
                 self._closed = True
-                stranded = (list(self._intake) + list(self._live.values()))
+                stranded = (list(self._intake) + list(self._live.values())
+                            + [st for st, _ in self._migrate_in])
                 self._intake.clear()
                 self._live.clear()
                 self._parked.clear()
+                self._migrate_in.clear()
             for s in stranded:
-                s._push_error(f"engine driver died: {e!r}")
+                if id(s) not in handed:
+                    s._push_error(f"engine driver died: {e!r}")
             raise
 
     # ---------------------------------------------------------- supervisor
@@ -842,6 +1032,73 @@ class ServingGateway:
             raise exc
         self._rebuild_and_recover()
 
+    @staticmethod
+    def _snapshot_live(engine):
+        """The recovery snapshot shared by crash-recovery rebuilds and
+        fleet failover: every live slot-holder (arrival order) with a
+        best-effort PRNG-walk snapshot — per-slot current keys, so
+        sampled continuations restart mid-walk; unreadable device state
+        (real crashes can corrupt it) only costs sampled-stream
+        identity, recovery itself runs on host token state — plus the
+        still-queued sequences. Returns ``(live, queued)``."""
+        try:
+            keys = np.asarray(engine._keys, np.uint32)
+        except Exception:
+            keys = None
+        live = [s for s in engine._slots if s is not None and not s.done]
+        live.sort(key=lambda s: s.request_id)   # arrival order
+        for s in live:
+            if keys is not None and s.tokens and s.status == "running" \
+                    and s.slot is not None:
+                s.key = keys[s.slot].copy()
+        queued = [s for s in engine.scheduler.queue if not s.done]
+        return live, queued
+
+    def _failover_handoff(self) -> frozenset:
+        """The dying driver's last act (fleet failover-to-sibling):
+        snapshot every live request exactly like a rebuild's recovery
+        would and offer the (stream, sequence) pairs to ``on_fatal``.
+        The hook returning True means the fleet adopted them onto a
+        sibling replica — those streams must NOT be stranded with
+        errors. Returns the ids of handed-off streams (empty without a
+        hook, on refusal, or if the handoff itself fails — stranding
+        is the unchanged fallback)."""
+        if self.on_fatal is None:
+            return frozenset()
+        try:
+            live, queued = self._snapshot_live(self.engine)
+            seqs = live + queued + [p for p in self._parked
+                                    if not p.done]
+            pairs, seen = [], set()
+            for seq in seqs:
+                st = self._live.get(seq.request_id)
+                if st is not None and st.finish_reason is None \
+                        and not st._cancel:
+                    pairs.append((st, seq))
+                    seen.add(id(st))
+            with self._lock:
+                pending = list(self._intake)
+                migrating = list(self._migrate_in)
+            for st in pending:
+                if id(st) not in seen and st.finish_reason is None \
+                        and not st._cancel:
+                    pairs.append((st, None))
+                    seen.add(id(st))
+            for st, sq in migrating:
+                if id(st) not in seen and st.finish_reason is None \
+                        and not st._cancel:
+                    pairs.append((st, sq))
+                    seen.add(id(st))
+            if pairs:
+                res = self.on_fatal(self, pairs)
+                if res is True:
+                    return frozenset(id(st) for st, _ in pairs)
+                if res:     # iterable of the streams actually adopted
+                    return frozenset(id(st) for st in res)
+        except Exception:
+            pass        # failover is best-effort; stranding still works
+        return frozenset()
+
     def _rebuild_and_recover(self):
         """Fatal-fault recovery: rebuild the engine and re-enqueue every
         live request by recompute — modulo the poison quarantine, which
@@ -859,21 +1116,7 @@ class ServingGateway:
         base, pc_base, _ = self._counter_state
         new_base = {k: base[k] + old.stats[k]
                     for k in CARRIED_ENGINE_STATS}
-        # best-effort PRNG-walk snapshot: per-slot current keys, so
-        # sampled continuations restart mid-walk. Unreadable device
-        # state (real crashes can corrupt it) only costs sampled-stream
-        # identity — recovery itself runs on host token state.
-        try:
-            keys = np.asarray(old._keys, np.uint32)
-        except Exception:
-            keys = None
-        live = [s for s in old._slots if s is not None and not s.done]
-        live.sort(key=lambda s: s.request_id)   # arrival order
-        for s in live:
-            if keys is not None and s.tokens and s.status == "running" \
-                    and s.slot is not None:
-                s.key = keys[s.slot].copy()
-        queued = [s for s in old.scheduler.queue if not s.done]
+        live, queued = self._snapshot_live(old)
         new = self.engine_factory()
         new.on_token = self._on_token
         new.on_finish = self._on_finish
@@ -894,6 +1137,7 @@ class ServingGateway:
         self.engine = new
         self._counter_state = (new_base, new_pc, new)   # atomic swap
         self._restarts += 1
+        self.last_restart_at = self._clock()    # the /debug/fleet column
         self._m_restarts.inc()
         readmit, culprit = self._quarantine_plan(live)
         recovered = 0

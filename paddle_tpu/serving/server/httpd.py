@@ -88,6 +88,13 @@ class _Handler(BaseHTTPRequestHandler):
     def gateway(self) -> ServingGateway:
         return self.server.gateway
 
+    @property
+    def fleet(self):
+        """The engine fleet when this server fronts one (README
+        "Engine fleet"), else None — single-engine servers keep the
+        exact pre-fleet surface."""
+        return getattr(self.server, "fleet", None)
+
     def log_message(self, fmt, *args):  # route through the server hook
         if self.server.log_fn is not None:
             self.server.log_fn(fmt % args)
@@ -109,6 +116,9 @@ class _Handler(BaseHTTPRequestHandler):
     # ----------------------------------------------------------------- GET
     def do_GET(self):
         path, _, query = self.path.partition("?")
+        if self.fleet is not None:
+            self._do_get_fleet(path, query)
+            return
         if path == "/healthz":
             gw = self.gateway
             st = gw.health_state    # ok|degraded|recovering|draining
@@ -190,9 +200,73 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(404, f"no route for GET {path}", "invalid_request")
 
+    # ----------------------------------------------------------- GET/fleet
+    def _do_get_fleet(self, path, query):
+        """The fleet server's GET surface (README "Engine fleet"):
+        ``/healthz`` aggregates replica states, ``/metrics`` renders
+        the ONE shared registry (every series ``replica``-labeled),
+        ``/debug/fleet`` is the per-replica operations table,
+        ``/debug/requests`` merges the replica tables with a
+        ``replica`` column, ``/debug/trace`` snapshots the merged
+        fleet+replica timeline (step-bounded windows are a
+        single-engine feature — the N drivers share no step counter),
+        and ``/debug/profile`` returns per-replica cost attribution
+        plus fleet totals."""
+        fl = self.fleet
+        if path == "/healthz":
+            st = fl.health_state
+            self._send_json(503 if st == "draining" else 200, {
+                "status": st,
+                "replicas": [{
+                    "replica": r.index, "state": r.state,
+                    "active_slots": r.gateway.engine.num_active,
+                    "num_slots": r.gateway.engine.num_slots,
+                    "queue_depth": r.gateway.queue_depth,
+                    "last_step_age_s":
+                        round(r.gateway.last_step_age(), 3),
+                    "engine_restarts": r.gateway.restarts,
+                } for r in fl.replicas],
+                "routable_replicas": len(fl._routable()),
+                "num_replicas": len(fl.replicas),
+                "router": fl.router.name,
+            })
+        elif path == "/metrics":
+            body = fl.registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/debug/fleet":
+            self._send_json(200, {"replicas": fl.fleet_table(),
+                                  "router": fl.router.name,
+                                  "health": fl.health_state})
+        elif path == "/debug/requests":
+            rows = []
+            for rep in fl.replicas:
+                for row in rep.gateway.request_table():
+                    rows.append({**row, "replica": rep.index})
+            self._send_json(200, {
+                "requests": rows,
+                "num_replicas": len(fl.replicas),
+                "queue_depth": sum(r.gateway.queue_depth
+                                   for r in fl.replicas)})
+        elif path == "/debug/trace":
+            self._send_json(200, fl.trace_doc())
+        elif path == "/debug/profile":
+            self._send_json(200, fl.profile_doc())
+        else:
+            self._error(404, f"no route for GET {path}",
+                        "invalid_request")
+
     # ---------------------------------------------------------------- POST
     def do_POST(self):
         path = self.path.split("?", 1)[0]
+        if self.fleet is not None and path in ("/fleet/drain",
+                                               "/fleet/rebalance"):
+            self._do_post_fleet(path)
+            return
         if path != "/v1/completions":
             self._error(404, f"no route for POST {path}", "invalid_request")
             return
@@ -206,7 +280,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             request = self._build_request(payload)
-            stream = self.gateway.submit(request)
+            # the fleet front door routes (least-loaded / affinity /
+            # round-robin) and sheds sideways on a full replica; the
+            # single-engine path is untouched
+            front = self.fleet if self.fleet is not None else self.gateway
+            stream = front.submit(request)
         except QueueFullError as e:
             self._error(429, str(e), "rate_limit",
                         extra_headers=(("Retry-After", "1"),))
@@ -253,6 +331,43 @@ class _Handler(BaseHTTPRequestHandler):
                 stream, ids, reason, self.server.model_name, prompt_tokens))
         except (BrokenPipeError, ConnectionResetError):
             self.close_connection = True  # client gone; work already done
+
+    def _do_post_fleet(self, path):
+        """Fleet operations endpoints: ``POST /fleet/drain`` body
+        ``{"replica": i}`` (add ``"undrain": true`` to return it to
+        rotation) migrates a replica's live work to siblings and takes
+        it out of routing; ``POST /fleet/rebalance`` (optional body
+        ``{"max_moves": n}``) sheds the hottest replica's youngest
+        requests to the coolest."""
+        fl = self.fleet
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, f"invalid JSON body: {e}", "invalid_request")
+            return
+        try:
+            if path == "/fleet/drain":
+                idx = int(payload["replica"])
+                if not 0 <= idx < len(fl.replicas):
+                    raise ValueError(f"no replica {idx}")
+                if payload.get("undrain"):
+                    fl.undrain_replica(idx)
+                    self._send_json(200, {"replica": idx,
+                                          "state": "accepting"})
+                    return
+                moved = fl.drain_replica(idx)
+                self._send_json(200, {"replica": idx,
+                                      "state": "draining",
+                                      "migrations_requested": moved})
+            else:
+                moved = fl.rebalance(
+                    max_moves=int(payload.get("max_moves", 8)))
+                self._send_json(200, {"migrations_requested": moved})
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, str(e), "invalid_request")
 
     def _build_request(self, p):
         prompt = p.get("prompt")
@@ -332,11 +447,16 @@ class ServingHTTPServer:
     """
 
     def __init__(self, gateway, host="127.0.0.1", port=8000,
-                 model_name="paddle-tpu-llama", log_fn=None):
+                 model_name="paddle-tpu-llama", log_fn=None, fleet=None):
+        if (gateway is None) == (fleet is None):
+            raise ValueError(
+                "pass exactly one of gateway (single engine) or fleet")
         self.gateway = gateway
+        self.fleet = fleet
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.gateway = gateway
+        self._httpd.fleet = fleet
         self._httpd.model_name = model_name
         self._httpd.log_fn = log_fn
         self._thread = threading.Thread(
@@ -362,7 +482,8 @@ class ServingHTTPServer:
     def shutdown(self, drain=True, timeout=None):
         """Graceful stop: close the front door (new completions 503),
         drain (or cancel) in-flight work, then stop the accept loop."""
-        self.gateway.shutdown(drain=drain, timeout=timeout)
+        front = self.fleet if self.fleet is not None else self.gateway
+        front.shutdown(drain=drain, timeout=timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread.is_alive():
@@ -478,4 +599,63 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     server = ServingHTTPServer(
         gateway, host=host, port=port,
         model_name=model_name or type(model).__name__, log_fn=log_fn)
+    return server.start() if start else server
+
+
+def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
+                port=8000, num_slots=8, max_seq_len=None, decode_chunk=1,
+                max_queue=64, model_name=None, registry=None, log_fn=None,
+                start=True, prefix_cache=True, prefix_blocks=None,
+                prefix_block_size=32, paged_attn=True, prefill_chunk=512,
+                ragged_step=True, headroom_mult=2.0,
+                watchdog_deadline_s=30.0, max_restarts=8,
+                fault_hooks=None, clock=None, spec_decode=False,
+                spec_k=4, drafter=None, trace=False, trace_buffer=65536,
+                cost=True, affinity_band=16):
+    """Build an engine fleet → HTTP server and start listening (README
+    "Engine fleet"): ``replicas`` supervised engines — each its own
+    paged pool, prefix trie and scheduler, sharing compiled programs
+    per pool geometry — behind one routed front door.
+
+    ``router`` picks the admission policy: ``round-robin`` (the
+    baseline), ``least-loaded`` (live KV blocks + queue depth), or
+    ``affinity`` (the default: longest cached-prefix match wins within
+    ``affinity_band`` load units of the least-loaded replica, so
+    prefix-cache hits survive fan-out — FLEET_BENCH.json banks the
+    three-way comparison). ``num_slots`` / ``prefill_chunk`` /
+    ``max_seq_len`` / ``max_queue`` / ``prefix_blocks`` accept a
+    scalar or one value per replica (mixed pool geometries isolate
+    their jit caches automatically; ``decode_compilations() == 1``
+    holds per geometry across the whole fleet).
+
+    On top of the single-engine surface, the handler grows
+    ``GET /debug/fleet`` (the per-replica operations table),
+    ``POST /fleet/drain`` and ``POST /fleet/rebalance`` (live request
+    migration), ``/healthz`` aggregates replica states, and every
+    ``/metrics`` series carries a ``replica`` label (monotonic across
+    any single replica's rebuild). A replica that dies past its
+    restart budget fails over: its live requests re-admit on siblings
+    by ``restore()`` recompute and the streams continue
+    byte-identically — zero requests lost (the fleet chaos matrix,
+    tests/test_fleet.py).
+    """
+    from ..fleet import EngineFleet, PrefixAffinityRouter
+    if router == "affinity":
+        router = PrefixAffinityRouter(band=affinity_band)
+    fleet = EngineFleet(
+        model, replicas=replicas, router=router, num_slots=num_slots,
+        max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+        max_queue=max_queue, prefix_cache=prefix_cache,
+        prefix_blocks=prefix_blocks,
+        prefix_block_size=prefix_block_size, paged_attn=paged_attn,
+        prefill_chunk=prefill_chunk, ragged_step=ragged_step,
+        headroom_mult=headroom_mult, spec_decode=spec_decode,
+        spec_k=spec_k, drafter=drafter, registry=registry, clock=clock,
+        watchdog_deadline_s=watchdog_deadline_s,
+        max_restarts=max_restarts, fault_hooks=fault_hooks,
+        trace=trace, trace_buffer=trace_buffer, cost=cost, start=True)
+    server = ServingHTTPServer(
+        None, host=host, port=port,
+        model_name=model_name or type(model).__name__, log_fn=log_fn,
+        fleet=fleet)
     return server.start() if start else server

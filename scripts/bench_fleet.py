@@ -1,0 +1,218 @@
+"""Engine-fleet routing benchmark (README "Engine fleet" /
+serving/server/README.md).
+
+Question answered: when one gateway fans a shared-prefix trace out
+over N shared-nothing engine replicas — each with its OWN prefix trie
+— how much of the single-engine prefix-cache hit-rate does each
+routing policy preserve, and at what throughput? Replication without
+affinity scatters a prefix family across tries that each re-prefill
+the shared preamble from scratch; the affinity router's whole job is
+keeping the aggregate hit-rate at the single-engine level (the
+AlpaServe-style observation, PAPERS.md: placement/routing policy —
+not the kernel — dominates fleet goodput).
+
+Workload: ``groups`` prompt families, each sharing a ``prefix_len``-
+token preamble (the system-prompt pattern) with unique tails. One SEED
+request per family runs first and retires — donating the family's
+preamble blocks to whichever replica served it — then every FOLLOWER
+submits, and the router decides whether it lands on the replica whose
+trie holds its family's blocks.
+
+Legs (same model, same requests, same per-replica geometry):
+
+- **single** — one engine with the fleet's total slots: the hit-rate
+  ceiling every policy is measured against;
+- **round-robin** — load-blind rotation: followers scatter across
+  tries and the aggregate hit-rate collapses toward (1/N of families
+  warm per replica);
+- **least-loaded** — live KV blocks + queue depth: better packing,
+  still affinity-blind;
+- **affinity** — longest cached-prefix match within a load band: the
+  acceptance leg.
+
+Every leg's token streams are asserted byte-identical to the single-
+engine baseline (routing must place work, never change it), and
+``decode_compilations() == 1`` is asserted per replica (the fleet's
+per-geometry shared jit cache).
+
+Acceptance: the affinity leg's aggregate hit-rate is within 10% of
+the single-engine hit-rate (the ISSUE 12 gate), and strictly above
+round-robin's.
+
+Usage:
+  python scripts/bench_fleet.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_decode import _models  # noqa: E402
+
+REPLICAS = 2
+SLOTS_PER_REPLICA = 2
+S_MAX = 256
+BLOCK_SIZE = 8
+PREFIX_LEN = 4 * BLOCK_SIZE       # 4 shared blocks per family
+CHUNK = 64
+ACCEPT_HIT_RATE_FRACTION = 0.9    # within 10% of single-engine
+
+
+def _workload(vocab, groups=4, followers=5, max_new=8):
+    """(seeds, followers): one seed per prompt family + its followers,
+    every member sharing the family's PREFIX_LEN-token preamble."""
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(31)
+    seeds, tails = [], []
+    for g in range(groups):
+        preamble = rng.randint(0, vocab, (PREFIX_LEN,)).astype(np.int32)
+        seeds.append(GenerationRequest(
+            prompt=preamble.copy(), max_new_tokens=max_new))
+        for _ in range(followers):
+            tail = rng.randint(0, vocab, (6,)).astype(np.int32)
+            tails.append(GenerationRequest(
+                prompt=np.concatenate([preamble, tail]),
+                max_new_tokens=max_new))
+    return seeds, tails
+
+
+def _clone(r):
+    from paddle_tpu.serving import GenerationRequest
+    return GenerationRequest(prompt=r.prompt,
+                             max_new_tokens=r.max_new_tokens,
+                             temperature=r.temperature, top_k=r.top_k,
+                             seed=r.seed)
+
+
+def _single_leg(model, seeds, tails):
+    """The hit-rate ceiling: one engine with the fleet's total slots,
+    seeds first (publish the family preambles), then every follower."""
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine(
+        model, num_slots=REPLICAS * SLOTS_PER_REPLICA, max_seq_len=S_MAX,
+        decode_chunk=1, prefix_cache=True, prefix_block_size=BLOCK_SIZE,
+        prefill_chunk=CHUNK,
+        jit_cache=model.__dict__.setdefault("_serving_jit_fleetbench", {}))
+    t0 = time.perf_counter()
+    seed_outs = eng.generate([_clone(r) for r in seeds])
+    tail_outs = eng.generate([_clone(r) for r in tails])
+    wall = time.perf_counter() - t0
+    st = eng.prefix_cache.stats
+    tokens = eng.stats["tokens_generated"]
+    return {
+        "hits": st["hits"], "misses": st["misses"],
+        "hit_rate": round(st["hits"] / max(st["hits"] + st["misses"], 1),
+                          4),
+        "prefill_tokens_saved": eng.stats["prefill_tokens_saved"],
+        "tokens": tokens, "wall_s": round(wall, 4),
+        "tok_s": round(tokens / wall, 2),
+    }, [o.tolist() for o in seed_outs] + [o.tolist() for o in tail_outs]
+
+
+def _fleet_leg(model, policy, seeds, tails):
+    """One fleet pass under ``policy``: seeds submit + drain first
+    (each family's preamble lands in exactly one replica's trie), then
+    every follower submits at once and the router places it."""
+    from paddle_tpu.serving.fleet import EngineFleet
+    fleet = EngineFleet(
+        model, replicas=REPLICAS, router=policy,
+        num_slots=SLOTS_PER_REPLICA, max_seq_len=S_MAX, decode_chunk=1,
+        prefix_cache=True, prefix_block_size=BLOCK_SIZE,
+        prefill_chunk=CHUNK, max_queue=len(tails) + len(seeds) + 4,
+        start=True)
+    try:
+        t0 = time.perf_counter()
+        seed_streams = [fleet.submit(_clone(r)) for r in seeds]
+        seed_outs = [st.result()[0].tolist() for st in seed_streams]
+        tail_streams = [fleet.submit(_clone(r)) for r in tails]
+        tail_outs = [st.result()[0].tolist() for st in tail_streams]
+        wall = time.perf_counter() - t0
+        hits = sum(r.gateway._pc_stat("hits") for r in fleet.replicas)
+        misses = sum(r.gateway._pc_stat("misses")
+                     for r in fleet.replicas)
+        tokens = sum(r.gateway._stat("tokens_generated")
+                     for r in fleet.replicas)
+        saved = sum(r.gateway._stat("prefill_tokens_saved")
+                    for r in fleet.replicas)
+        compilations = [r.gateway.engine.decode_compilations()
+                        for r in fleet.replicas]
+        per_replica = {str(r.index): sum(
+            1 for _, i in fleet.decisions if i == r.index)
+            for r in fleet.replicas}
+        return {
+            "policy": policy,
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+            "prefill_tokens_saved": saved,
+            "tokens": tokens, "wall_s": round(wall, 4),
+            "tok_s": round(tokens / wall, 2),
+            "decisions_per_replica": per_replica,
+            "decode_compilations_per_replica": compilations,
+            "compile_once": all(c == 1 for c in compilations),
+        }, seed_outs + tail_outs
+    finally:
+        fleet.shutdown(drain=True, timeout=60)
+
+
+def measure_fleet(quick=True, groups=None, followers=None, max_new=None):
+    model = _models(quick)["jnp"]
+    seeds, tails = _workload(
+        model.config.vocab_size,
+        groups=groups or (4 if quick else 6),
+        followers=followers or (5 if quick else 8),
+        max_new=max_new or (8 if quick else 16))
+    single, base_streams = _single_leg(model, seeds, tails)
+    legs = {}
+    streams_equal = True
+    for policy in ("round-robin", "least-loaded", "affinity"):
+        legs[policy], streams = _fleet_leg(model, policy, seeds, tails)
+        streams_equal = streams_equal and streams == base_streams
+    aff = legs["affinity"]["hit_rate"]
+    rr = legs["round-robin"]["hit_rate"]
+    accepted = bool(
+        streams_equal
+        and all(leg["compile_once"] for leg in legs.values())
+        and aff >= ACCEPT_HIT_RATE_FRACTION * single["hit_rate"]
+        and aff > rr)
+    return {
+        "replicas": REPLICAS, "slots_per_replica": SLOTS_PER_REPLICA,
+        "block_size": BLOCK_SIZE, "shared_prefix_tokens": PREFIX_LEN,
+        "requests": len(seeds) + len(tails),
+        "single_engine": single,
+        "fleet": legs,
+        "streams_identical_across_policies": streams_equal,
+        "affinity_hit_rate_fraction_of_single": round(
+            aff / max(single["hit_rate"], 1e-9), 4),
+        "accepted": accepted,
+        "workload": "per-family seed publishes the shared preamble to "
+                    "ONE replica's trie, then followers fan out and "
+                    "the router decides whether they land on it; "
+                    "hit-rate aggregates hits/(hits+misses) across "
+                    "replica tries (carried across rebuilds).",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "fleet": measure_fleet(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0 if res["fleet"]["accepted"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

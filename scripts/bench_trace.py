@@ -22,8 +22,12 @@ the numbers are comparable to that bank):
 Legs are interleaved and each is scored by its BEST wall over
 ``repeats`` rounds (identical code modulo the tracer, so best-of
 converges to the same floor when the tracer truly costs nothing).
-Token streams are asserted identical across all legs — tracing must
-observe, never perturb.
+``repeats=9`` and a FLOOR-ratio acceptance gate (the disabled leg
+against the fastest of the three legs, the ``bench_dispatch.py``
+method): on a loaded box best-of-5 leaves ~4% scheduler noise between
+legs — observed as the ENABLED leg measuring faster than baseline —
+which would fail a 1% gate on pure jitter. Token streams are asserted
+identical across all legs — tracing must observe, never perturb.
 
 Usage:
   python scripts/bench_trace.py --quick [--json PATH]   # CPU-sized
@@ -66,7 +70,7 @@ def _run_leg(model, reqs, num_slots, s_max, tracer):
 
 
 def measure_trace_overhead(quick=True, n_requests=8, max_new=None,
-                           num_slots=4, repeats=5):
+                           num_slots=4, repeats=9):
     from paddle_tpu.profiler.tracing import SpanTracer
     max_new = max_new or (24 if quick else 64)
     s_max = 128 if quick else 256
@@ -95,8 +99,15 @@ def measure_trace_overhead(quick=True, n_requests=8, max_new=None,
     tokens_equal = (toks["baseline"] == toks["disabled"]
                     == toks["enabled"])
     events = len(tr_on.events())
-    disabled_ratio = best["disabled"] / best["baseline"]
-    enabled_ratio = best["enabled"] / best["baseline"]
+    # the acceptance ratio measures the disabled leg against the FLOOR
+    # (fastest of the three legs): all three run identical device work,
+    # so the floor is the machine's true wall for the workload and the
+    # disabled leg's distance from it bounds the guard's cost — a
+    # baseline leg that lands slow (scheduler jitter) must not
+    # manufacture a >1% "overhead" out of noise
+    floor = min(best.values())
+    disabled_ratio = best["disabled"] / floor
+    enabled_ratio = best["enabled"] / floor
     # context: the banked HTTP serve bench this engine config mirrors
     banked = None
     try:
@@ -110,6 +121,8 @@ def measure_trace_overhead(quick=True, n_requests=8, max_new=None,
         "enabled_wall_s": round(best["enabled"], 4),
         "disabled_overhead_ratio": round(disabled_ratio, 4),
         "enabled_overhead_ratio": round(enabled_ratio, 4),
+        "disabled_vs_baseline_ratio": round(
+            best["disabled"] / best["baseline"], 4),
         "enabled_events_captured": events,
         "enabled_us_per_event": round(
             max(best["enabled"] - best["baseline"], 0.0)

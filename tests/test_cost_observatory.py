@@ -570,6 +570,20 @@ class TestGuardDiscipline:
         # sanity: the sweep actually sees the instrumentation
         assert guarded >= 20, f"only {guarded} guarded sites found"
 
+    def test_sweep_covers_the_fleet_package(self):
+        """ISSUE 12 satellite: the rglob sweep must keep covering
+        ``serving/fleet/`` — the fleet's router-decision/failover/
+        migration instants ride the same one-attribute ``_tr()``
+        discipline as the engine's sites, and a future re-layout that
+        moved the fleet out of ``serving/`` would silently shrink the
+        sweep."""
+        swept = {p.name for p in SERVING_DIR.rglob("*.py")}
+        assert {"fleet.py", "router.py", "replica.py"} <= swept
+        # and the fleet actually contributes guarded sites: the fleet
+        # module's _tr() pattern must appear at least once
+        fleet_src = (SERVING_DIR / "fleet" / "fleet.py").read_text()
+        assert GUARD_RE.search(fleet_src) is not None
+
 
 # ---------------------------------------------------- profiler CLI (json)
 class TestProfilerCLIChrome:
