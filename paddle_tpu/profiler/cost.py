@@ -50,7 +50,7 @@ import numpy as np
 #: the fixed label set of ``serving_dispatches_total{program=...}``
 #: (values scrape as 0 until a kind first runs).
 PROGRAM_KINDS = ("prefill", "suffix", "psuffix", "decode", "pdecode",
-                 "ragged", "spec")
+                 "ragged", "mtick", "spec")
 
 
 def _nbytes(leaf) -> int:

@@ -531,6 +531,78 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 
 
 # ------------------------------------------------------ unified ragged step
+def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
+                       pv_all, lens, kys, app_mask, temps, top_ks, *, nh,
+                       nkv, hd, eps, decode_attn):
+    """ONE fused decode tick over all rows — THE shared tail body of
+    the unified ragged step's scan and the multi-tick step's
+    while_loop (the two must compute identically or ``decode_ticks>1``
+    streams could drift from the single-tick baseline). ``app_mask``
+    [R] int32 is 1 where the row's append/length-advance is real (the
+    ragged tail's ``dec_mask``; the multi-tick tail's alive mask) —
+    masked rows drop their append and attend at their frozen length.
+    Returns ``(next_tok, pk', pv', keys')``; the CALLER advances
+    ``lens`` by ``app_mask``.
+    """
+    R = tok.shape[0]
+    nb, bs = pk_all.shape[1], pk_all.shape[2]
+    mb = tables.shape[1]
+    s_tot = mb * bs
+    x = jnp.take(params["embed"], tok[:, None], axis=0)     # [R, 1, H]
+    sin_r = jnp.take(sin, lens, axis=0, mode="clip")
+    cos_r = jnp.take(cos, lens, axis=0, mode="clip")
+    bi = jnp.minimum(lens // bs, mb - 1)
+    phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
+    # masked rows (idle slots, chunk rows, alive-mask-retired rows)
+    # must not append: their next write belongs to a later program
+    phys = jnp.where((app_mask > 0) & (lens < s_tot), phys, nb)
+    prow = lens % bs
+
+    def layer(h, xs):
+        lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
+        hn = _rms(h, lin, eps)
+        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q = _apply_rope_rows(q, sin_r, cos_r)
+        k = _apply_rope_rows(k, sin_r, cos_r)
+        pk_l = pk_l.at[phys, prow].set(k[:, 0], mode="drop")
+        pv_l = pv_l.at[phys, prow].set(v[:, 0], mode="drop")
+        if decode_attn == "pallas":
+            attn = paged_decode_attention_pallas(
+                q[:, 0], pk_l, pv_l, tables, lens + app_mask)
+        else:
+            attn = paged_decode_attention_reference(
+                q[:, 0], pk_l, pv_l, tables, lens + app_mask)
+        h = h + jnp.einsum("bsd,dh->bsh",
+                           attn.reshape(R, 1, nh * hd), lwo)
+        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        return h, (pk_l, pv_l)
+
+    x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
+    lastt = _rms(x[:, 0], params["final_norm"], eps)
+    lgt = jnp.einsum("bh,hv->bv", lastt, head)
+    b2 = jax.vmap(jax.random.split)(kys)
+    nxt = sample_rows(lgt, b2[:, 1], temps, top_ks)
+    return nxt, npk, npv, b2[:, 0]
+
+
+def _span_last_sample(params, head, x, qstart, qlen, keys, temps, top_ks,
+                      eps):
+    """Tick 0's per-slot sample — each slot samples from its span's
+    LAST packed position (decode rows: the one token; chunk rows: the
+    chunk end — live only when the chunk completes the prompt).
+    Shared by the unified and multi-tick steps so the sampling rule
+    cannot drift. Returns ``(tok0, keys')`` after one split per row.
+    """
+    T = x.shape[1]
+    last_idx = jnp.clip(qstart + qlen - 1, 0, T - 1)
+    last = jnp.take(x[0], last_idx, axis=0)                 # [R, H]
+    last_h = _rms(last, params["final_norm"], eps)
+    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    both = jax.vmap(jax.random.split)(keys)                 # [R, 2, 2]
+    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
+    return tok0, both[:, 0]
+
+
 def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, sin, cos, *, nh, nkv, hd,
                          eps, decode_attn):
@@ -634,11 +706,7 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     as a one-shot prefill, so streams stay byte-identical); ``keys'``
     is the post-scan key state the engine adopts for decode rows.
     """
-    T = ids.shape[0]
-    R = tables.shape[0]
-    nb, bs = pool_k.shape[1], pool_k.shape[2]
-    mb = tables.shape[1]
-    s_tot = mb * bs
+    s_tot = tables.shape[1] * pool_k.shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
     head = params["lm_head"].T if tied else params["lm_head"]
@@ -648,58 +716,22 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
         decode_attn=decode_attn)
-    # each slot samples from its span's LAST packed position (decode
-    # rows: the one token; chunk rows: the chunk end — live only when
-    # the chunk completes the prompt)
-    last_idx = jnp.clip(qstart + qlen - 1, 0, T - 1)
-    last = jnp.take(x[0], last_idx, axis=0)                 # [R, H]
-    last_h = _rms(last, params["final_norm"], eps)
-    logits = jnp.einsum("bh,hv->bv", last_h, head)
-    both = jax.vmap(jax.random.split)(keys)                 # [R, 2, 2]
-    tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
-    keys_t0 = both[:, 0]
+    tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
+                                      keys, temps, top_ks, eps)
 
     # ------------------------------------------- fused tail (pure decode)
     lens0 = jnp.where(dec_mask > 0, kvlen, 0)
 
     def one_step(carry, _):
         tok, pk_all, pv_all, lens, kys = carry
-        x = jnp.take(params["embed"], tok[:, None], axis=0)
-        sin_r = jnp.take(sin, lens, axis=0, mode="clip")
-        cos_r = jnp.take(cos, lens, axis=0, mode="clip")
-        bi = jnp.minimum(lens // bs, mb - 1)
-        phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
         # non-decode rows (idle slots, a chunk row that just finished)
-        # must not append: their next write belongs to the next step's
-        # program, not this scan
-        phys = jnp.where((dec_mask > 0) & (lens < s_tot), phys, nb)
-        prow = lens % bs
-
-        def layer(h, xs):
-            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
-            hn = _rms(h, lin, eps)
-            q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
-            q = _apply_rope_rows(q, sin_r, cos_r)
-            k = _apply_rope_rows(k, sin_r, cos_r)
-            pk_l = pk_l.at[phys, prow].set(k[:, 0], mode="drop")
-            pv_l = pv_l.at[phys, prow].set(v[:, 0], mode="drop")
-            if decode_attn == "pallas":
-                attn = paged_decode_attention_pallas(
-                    q[:, 0], pk_l, pv_l, tables, lens + dec_mask)
-            else:
-                attn = paged_decode_attention_reference(
-                    q[:, 0], pk_l, pv_l, tables, lens + dec_mask)
-            h = h + jnp.einsum("bsd,dh->bsh",
-                               attn.reshape(R, 1, nh * hd), lwo)
-            h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
-            return h, (pk_l, pv_l)
-
-        x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
-        lastt = _rms(x[:, 0], params["final_norm"], eps)
-        lgt = jnp.einsum("bh,hv->bv", lastt, head)
-        b2 = jax.vmap(jax.random.split)(kys)
-        nxt = sample_rows(lgt, b2[:, 1], temps, top_ks)
-        return (nxt, npk, npv, lens + dec_mask, b2[:, 0]), nxt
+        # ride dec_mask=0: their appends drop inside the shared tick —
+        # their next write belongs to the next step's program
+        nxt, npk, npv, nkeys = _fused_decode_tick(
+            params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
+            lens, kys, dec_mask, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, decode_attn=decode_attn)
+        return (nxt, npk, npv, lens + dec_mask, nkeys), nxt
 
     if n_steps > 1:
         carry0 = (tok0, pk, pv, lens0, keys_t0)
@@ -724,6 +756,125 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
             eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+        donate_argnums=(1, 2) if donate else ())
+
+
+# ------------------------------------------------------- multi-tick decode
+def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
+                         qstart, qlen, kvlen, dec_mask, keys, temps,
+                         top_ks, eos_ids, budgets, n_ticks, *, max_ticks,
+                         nh, nkv, hd, eps, theta, tied, decode_attn):
+    """THE multi-tick serving step (README "Multi-tick decode"): the
+    unified ragged step with the host driven out of the per-token loop.
+    Tick 0 is ``_ragged_step_impl``'s packed forward verbatim (decode
+    rows span 1, prefill chunks span n, K/V written through the block
+    tables, one sample per span); the fused tail is the same decode
+    scan UPGRADED with
+
+    - a **runtime tick count**: ``n_ticks`` (host-chosen each step,
+      1..max_ticks) bounds a ``lax.while_loop`` instead of a static
+      ``lax.scan`` length, so ONE compilation serves every tick count —
+      mixed-traffic steps pass 1 and pay exactly the unified step's
+      work, decode-heavy steps pass ``decode_ticks``;
+    - an **on-device alive mask**: per-slot EOS hits (``eos_ids``, -1 =
+      no EOS configured) and remaining-budget counters (``budgets`` =
+      ``max_new_tokens - len(tokens)`` at step start) retire a row
+      inside the loop — a finished row's appends drop exactly like a
+      ``dec_mask`` dead row, its length stops advancing, and the loop
+      EXITS EARLY once every row is dead (a program can return with
+      ticks to spare);
+
+    so the host syncs once per ``n_ticks`` tokens instead of once per
+    token, and accepts the whole block in one ``host-accept``.
+
+    The alive update replays the host's ``_maybe_finish`` rule
+    exactly — after emitting token index ``t`` a row stays alive iff
+    the token is not its EOS and ``t + 1 < budget`` — so the device's
+    append cut equals the host's trim cut and the donation invariant
+    (the last emitted token's KV is never in the cache) is preserved
+    tick-for-tick. Appends per row == tokens the host accepts.
+
+    Per-row sampling walks are positionally identical to sequential
+    decode (split once per tick per row, all rows, dead or alive), so
+    streams are byte-identical to ``n_ticks = 1`` — greedy AND
+    seeded-sampled; the host adopts ``keys_walk[m - 1]`` for a row
+    that emitted ``m`` tokens, the same contract as the speculative
+    verify's key walk.
+
+    Returns ``(pool_k', pool_v', toks [max_ticks, R],
+    keys_walk [max_ticks, R, 2], ticks_run)``: row 0 is tick 0's
+    sample + advanced key (what a final chunk row adopts — the same
+    split walk as a one-shot prefill); rows past ``ticks_run`` are
+    zeros the host never reads.
+    """
+    R = tables.shape[0]
+    s_tot = tables.shape[1] * pool_k.shape[2]
+    sin, cos = _rope_tables(s_tot, hd, theta)
+    stack = tuple(params[k] for k in _STACK_KEYS)
+    head = params["lm_head"].T if tied else params["lm_head"]
+
+    # ----------------------------------- tick 0 (shared packed forward)
+    x, pk, pv = _packed_span_forward(
+        params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
+        kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
+        decode_attn=decode_attn)
+    tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
+                                      keys, temps, top_ks, eps)
+
+    # ------------------------------- fused tail (alive-masked, runtime n)
+    lens0 = jnp.where(dec_mask > 0, kvlen, 0)
+    # after tick 0 a decode row has emitted 1 token: it keeps ticking
+    # iff that token is not its EOS and its budget allows a second
+    alive0 = (dec_mask > 0) & (tok0 != eos_ids) & (budgets > 1)
+    toks_buf = jnp.zeros((max_ticks, R), jnp.int32).at[0].set(tok0)
+    keys_buf = jnp.zeros((max_ticks, R, 2),
+                         jnp.uint32).at[0].set(keys_t0)
+
+    def cond(state):
+        t, alive = state[0], state[1]
+        return jnp.logical_and(t < n_ticks, jnp.any(alive))
+
+    def body(state):
+        t, alive, tok, pk_all, pv_all, lens, kys, tb, kb = state
+        # dead rows — idle slots, chunk rows, and rows the alive mask
+        # retired (EOS hit / budget spent on an earlier tick) — ride
+        # app_mask=0 through the shared tick: appends drop, length
+        # frozen (a retired row's next write belongs to nobody)
+        am = alive.astype(jnp.int32)
+        nxt, npk, npv, nkeys = _fused_decode_tick(
+            params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
+            lens, kys, am, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, decode_attn=decode_attn)
+        tb = tb.at[t].set(nxt)
+        kb = kb.at[t].set(nkeys)
+        # the host's _maybe_finish rule, in-program: after emitting
+        # token index t a row stays alive iff the token is not its EOS
+        # and t + 1 more tokens fit its budget
+        alive = alive & (nxt != eos_ids) & (t + 1 < budgets)
+        return (t + 1, alive, nxt, npk, npv, lens + am, nkeys, tb, kb)
+
+    state0 = (jnp.int32(1), alive0, tok0, pk, pv, lens0, keys_t0,
+              toks_buf, keys_buf)
+    (ticks_run, _, _, pk, pv, _, _, toks_buf, keys_buf) = \
+        jax.lax.while_loop(cond, body, state0)
+    return pk, pv, toks_buf, keys_buf, ticks_run
+
+
+def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
+                            decode_attn, donate=None):
+    """One jitted multi-tick serving step (``_multitick_step_impl``):
+    shapes depend only on ``(num_slots, token_budget, max_ticks)`` —
+    the tick count actually run is a RUNTIME argument, so one
+    compilation serves every span mix AND every adaptive tick count
+    from 1 to ``max_ticks``. The compile-once contract covers the
+    multi-tick geometry with a single trace."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(
+        functools.partial(
+            _multitick_step_impl, max_ticks=int(max_ticks), nh=nh,
+            nkv=nkv, hd=hd, eps=eps, theta=theta, tied=tied,
+            decode_attn=decode_attn),
         donate_argnums=(1, 2) if donate else ())
 
 

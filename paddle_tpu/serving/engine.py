@@ -137,6 +137,27 @@ class ContinuousBatchingEngine:
     unified step below); the modeled win is launches-per-token
     (``scripts/bench_spec.py``, SPEC_BENCH.json).
 
+    ``decode_ticks > 1`` (unified ragged engine only, default 1 — every
+    banked baseline is an A/B away) turns on multi-tick decode (README
+    "Multi-tick decode"): EVERY step runs ONE multi-tick program
+    (``decode.build_multitick_step_fn``) whose packed tick 0 is the
+    unified step verbatim and whose fused tail runs a RUNTIME number of
+    decode ticks — up to ``decode_ticks`` — with on-device EOS/budget
+    retirement (a finished row's appends drop inside the program
+    exactly where the host's trim cuts) and early exit when every row
+    retires. The host syncs once per block instead of once per token
+    (``plan``/``launch``/``host-accept`` cover n tokens), trimming each
+    slot at its first EOS/budget cut so streams stay byte-identical to
+    ``decode_ticks=1``. The scheduler adapts the tick count per step
+    (``FIFOScheduler.choose_decode_ticks``: 1 under mixed traffic,
+    shrunk to the nearest guaranteed retirement while the queue waits);
+    since the count is a runtime argument, ``decode_compilations()``
+    stays at 1 — the multi-tick geometry keys its own jit-cache entry
+    (``("mtick", num_slots, token_budget, decode_ticks, attn)``).
+    Incompatible with ``spec_decode`` (a speculative step has no
+    pure-decode tail to fuse). ``decode_chunk`` fusion is superseded on
+    this path — the multi-tick program subsumes it with masking.
+
     Substrate note: the unified program's packed buffer is a fixed
     ``num_slots + prefill_chunk`` tokens, which the TPU Pallas kernel
     prices at the LIVE spans only (span-block gating + ragged DMA
@@ -155,7 +176,7 @@ class ContinuousBatchingEngine:
                  prefix_block_size=32, paged_attn=True,
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  step_clock=None, spec_decode=False, spec_k=4,
-                 drafter=None):
+                 drafter=None, decode_ticks=1):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -311,6 +332,33 @@ class ContinuousBatchingEngine:
                 from .drafter import NgramDrafter
                 drafter = NgramDrafter()
             self.drafter = drafter
+        # multi-tick decode (README "Multi-tick decode"): when > 1, the
+        # engine runs EVERY step through ONE multi-tick program —
+        # chunk rows ride tick 0 exactly like the unified step, and
+        # pure-decode steps fuse up to decode_ticks on-device ticks
+        # behind a single host sync, with EOS/budget retirement masked
+        # inside the program (decode.build_multitick_step_fn). The tick
+        # count actually run is a RUNTIME argument chosen per step by
+        # the scheduler (FIFOScheduler.choose_decode_ticks: clamped to
+        # 1 under mixed traffic, shrunk to the nearest guaranteed
+        # retirement when the queue has waiting work), so one
+        # compilation serves every tick count.
+        if int(decode_ticks) < 1:
+            raise ValueError(
+                f"decode_ticks must be >= 1, got {int(decode_ticks)}")
+        self._decode_ticks = int(decode_ticks)
+        self._mtick = self._decode_ticks > 1
+        if self._mtick and not self._ragged:
+            raise ValueError(
+                "decode_ticks > 1 requires the unified ragged engine "
+                "(paged_attn=True, ragged_step=True): multi-tick decode "
+                "is the unified step's fused tail driven past the host "
+                "sync")
+        if self._mtick and self._spec:
+            raise ValueError(
+                "decode_ticks > 1 is incompatible with spec_decode: a "
+                "speculative step is a verify launch every step, so "
+                "there is no pure-decode tail to multi-tick — pick one")
         if headroom_mult is not None and float(headroom_mult) <= 0:
             raise ValueError(
                 f"headroom_mult must be > 0 (or None for fixed-cap chunk "
@@ -349,6 +397,8 @@ class ContinuousBatchingEngine:
                       "prefill_copy_dispatches": 0,
                       "prefill_chunks": 0, "chunk_tokens": 0,
                       "unified_steps": 0,
+                      "mtick_syncs": 0, "mtick_ticks": 0,
+                      "last_decode_ticks": 0,
                       "spec_steps": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_tokens": 0,
                       "spec_last_accept": [],
@@ -491,6 +541,24 @@ class ContinuousBatchingEngine:
         # installs); keys_fin is adopted device-side via jnp.where
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
 
+    def _mtick_fn(self):
+        # like the ragged key: the full packed geometry (num_slots AND
+        # token budget) plus max_ticks — CONFIG, like the spec key's
+        # spec_len — key the trace apart from other engines sharing
+        # one jit_cache. The tick count actually run is a runtime
+        # argument, so this is the engine's ONE decode program.
+        key = ("mtick", self.num_slots, self._token_budget,
+               self._decode_ticks, self.config.decode_attention)
+        if key not in self._jit:
+            from .decode import build_multitick_step_fn
+            self._jit[key] = build_multitick_step_fn(
+                max_ticks=self._decode_ticks,
+                decode_attn=self.config.decode_attention,
+                **self._fn_consts())
+        # host reads the sampled token block, the key walk (per-slot
+        # adoption at each slot's trim cut) and the ticks-run scalar
+        return self._wrap_prog(key, self._jit[key], host_out=(2, 3, 4))
+
     def _spec_fn(self):
         # like the ragged key: the full packed geometry (num_slots AND
         # the spec token budget) plus the sampling-walk depth key the
@@ -519,6 +587,13 @@ class ContinuousBatchingEngine:
         """Max draft tokens per verify span (0 when speculation is
         off)."""
         return self._spec_k if self._spec else 0
+
+    @property
+    def decode_ticks(self) -> int:
+        """Max on-device decode ticks per host sync (1 = the unified
+        single-sync-per-token step) — the public surface for
+        banners/metrics. README "Multi-tick decode"."""
+        return self._decode_ticks
 
     @property
     def ragged_step(self) -> bool:
@@ -558,6 +633,19 @@ class ContinuousBatchingEngine:
                        and key[1] == self.num_slots
                        and key[2] == self._spec_budget
                        and key[3] == self._spec_len)
+        if self._mtick:
+            # the multi-tick program IS the decode program — every
+            # step, chunk-carrying or not, is one mtick-geometry launch
+            # whose tick count is a runtime argument, so the count
+            # covers the multi-tick geometry with a single trace.
+            # decode_ticks is CONFIG (part of the identity, like the
+            # spec key's spec_len): two engines differing only in
+            # decode_ticks share a packed budget but not a program.
+            return sum(fn._cache_size() for key, fn in self._jit.items()
+                       if key[0] == "mtick"
+                       and key[1] == self.num_slots
+                       and key[2] == self._token_budget
+                       and key[3] == self._decode_ticks)
         if self._ragged:
             return sum(fn._cache_size() for key, fn in self._jit.items()
                        if key[0] == "ragged"
@@ -1059,6 +1147,7 @@ class ContinuousBatchingEngine:
     def _emit(self, seq, token):
         if seq.t_first_token is None:
             seq.t_first_token = self._stamp_now()
+        seq.t_last_token = self._stamp_now()
         if self.on_token is not None:
             self.on_token(seq, token)
 
@@ -1116,6 +1205,9 @@ class ContinuousBatchingEngine:
                             self._admit_group(admitted, finished)
                 if self._spec:
                     step_tokens, had_chunks = self._spec_step(finished)
+                elif self._mtick:
+                    step_tokens, had_chunks = self._multitick_step(
+                        finished)
                 elif self._ragged:
                     step_tokens, had_chunks = self._unified_step(finished)
                 else:
@@ -1399,7 +1491,6 @@ class ContinuousBatchingEngine:
             return 0, False
         n = self.scheduler.choose_num_steps(active) if active else 1
         R, T = self.num_slots, self._token_budget
-        lens = self.cache.lengths
         ids = np.zeros(T, np.int32)
         seg = np.full(T, R, np.int32)       # sentinel: dead packed rows
         pos = np.zeros(T, np.int32)
@@ -1410,23 +1501,8 @@ class ContinuousBatchingEngine:
         temps = np.zeros(R, np.float32)
         topks = np.zeros(R, np.int32)
         keys = np.asarray(self._keys, np.uint32).copy()
-        cursor = 0
-        for slot, s in enumerate(self._slots):
-            if s is None or s.status != "running":
-                continue
-            # append-block on decode growth: the fused ticks write rows
-            # [len, len+n) — the table must cover them pre-call
-            self.cache.ensure_capacity(slot, int(lens[slot]) + n)
-            qstart[slot] = cursor
-            qlen[slot] = 1
-            kvlen[slot] = int(lens[slot]) + 1
-            dec_mask[slot] = 1
-            ids[cursor] = self._last_tok[slot]
-            seg[cursor] = slot
-            pos[cursor] = int(lens[slot])
-            temps[slot] = self._temps[slot]
-            topks[slot] = self._topks[slot]
-            cursor += 1
+        cursor = self._pack_decode_rows(n, ids, seg, pos, qstart, qlen,
+                                        kvlen, dec_mask, temps, topks)
         chunk_rows, cursor = self._pack_chunk_rows(
             plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
             temps, topks)
@@ -1475,28 +1551,213 @@ class ContinuousBatchingEngine:
                 s = self._slots[slot]
                 if s is not None and dec_mask[slot]:
                     s.launches += 1     # rode this step's one program
-            for i in range(n):
-                for slot in range(self.num_slots):
-                    seq = self._slots[slot]
-                    if seq is None or seq.status != "running" \
-                            or not dec_mask[slot]:
-                        continue  # freed/mid-prefill slot, finished
-                        # mid-chunk, or a span this call did not decode
-                        # (a chunk row installed above starts decoding
-                        # NEXT step); its sampled garbage never surfaces
-                    t = int(toks_np[i, slot])
-                    seq.tokens.append(t)
-                    self.cache.lengths[slot] += 1
-                    self._last_tok[slot] = t
-                    self.stats["active_slot_steps"] += 1
-                    self.stats["tokens_generated"] += 1
-                    self._emit(seq, t)
-                    self._maybe_finish(seq, finished)
+            self._accept_decode_rows(toks_np, n, dec_mask, finished)
         if tr is not None:
             tr.complete("host-accept", th0,
                         args={"emitted": (n * len(active) if active
                                           else 0)})
         return cursor + (n - 1) * len(active), bool(chunk_rows)
+
+    def _pack_decode_rows(self, n, ids, seg, pos, qstart, qlen, kvlen,
+                          dec_mask, temps, topks, eos_ids=None,
+                          budgets=None):
+        """Pack every RUNNING slot's span-1 decode row into the packed
+        token buffer — the ONE decode-row assembly shared by the
+        unified and multi-tick steps (``_pack_chunk_rows``' twin), so
+        the packing and table-pre-growth rules cannot silently
+        diverge. Pre-grows each slot's table for the fused block:
+        ``n`` rows on the unified scan (it appends unconditionally);
+        ``min(n, remaining)`` when the alive-mask metadata
+        (``eos_ids``/``budgets``) is being packed, because the device
+        stops a row's appends exactly at its EOS/budget cut. Returns
+        the cursor past the packed decode rows."""
+        lens = self.cache.lengths
+        cursor = 0
+        for slot, s in enumerate(self._slots):
+            if s is None or s.status != "running":
+                continue
+            grow = n if budgets is None else min(n, s.remaining)
+            self.cache.ensure_capacity(slot, int(lens[slot]) + grow)
+            qstart[slot] = cursor
+            qlen[slot] = 1
+            kvlen[slot] = int(lens[slot]) + 1
+            dec_mask[slot] = 1
+            ids[cursor] = self._last_tok[slot]
+            seg[cursor] = slot
+            pos[cursor] = int(lens[slot])
+            temps[slot] = self._temps[slot]
+            topks[slot] = self._topks[slot]
+            if eos_ids is not None:
+                eos = s.request.eos_token_id
+                eos_ids[slot] = -1 if eos is None else int(eos)
+                budgets[slot] = s.remaining
+            cursor += 1
+        return cursor
+
+    def _accept_decode_rows(self, toks_np, n, dec_mask, finished,
+                            counts=None):
+        """Host-accept of the fused ticks' ``[n, R]`` token block —
+        the ONE trim loop shared by the unified and multi-tick steps,
+        so the accept/trim rules (EOS and budget cuts via
+        ``_maybe_finish``, per-token bookkeeping) cannot silently
+        diverge. Tick-major like the device computed it; a slot whose
+        sequence finished at an earlier tick is skipped from then on
+        (on the multi-tick path the device's alive cut equals this
+        trim, so the skipped entries are masked garbage that never
+        surfaces). ``counts`` (optional [R] array) receives each
+        slot's accepted-token count — the multi-tick key-walk
+        adoption index. Returns tokens emitted."""
+        emitted = 0
+        for i in range(n):
+            for slot in range(self.num_slots):
+                seq = self._slots[slot]
+                if seq is None or seq.status != "running" \
+                        or not dec_mask[slot]:
+                    continue  # freed/mid-prefill slot, finished at an
+                    # earlier tick, or a span this call did not decode
+                    # (a chunk row installed above starts decoding
+                    # NEXT step); its sampled garbage never surfaces
+                t = int(toks_np[i, slot])
+                seq.tokens.append(t)
+                if counts is not None:
+                    counts[slot] += 1
+                self.cache.lengths[slot] += 1
+                self._last_tok[slot] = t
+                self.stats["active_slot_steps"] += 1
+                self.stats["tokens_generated"] += 1
+                emitted += 1
+                self._emit(seq, t)
+                self._maybe_finish(seq, finished)
+        return emitted
+
+    def _multitick_step(self, finished):
+        """ONE device call that advances every slot by up to
+        ``decode_ticks`` tokens (README "Multi-tick decode"): the
+        unified ragged step with the per-token host round-trip
+        amortized to one sync per ``n`` ticks. Every running slot
+        contributes a span-1 decode row and every planned prefill
+        chunk its span to the packed tick-0 buffer, exactly like
+        :meth:`_unified_step`; the fused tail then runs ``n``
+        (scheduler-chosen, runtime — one compilation serves them all)
+        decode ticks with ON-DEVICE EOS/budget retirement: a finished
+        row's appends drop inside the program precisely where the
+        host's trim will cut, and the program returns early once
+        every row is dead. The host accepts the whole ``[n, R]``
+        token block in one ``host-accept``, trimming each slot at its
+        first EOS/budget cut — byte-identical to tick-at-a-time —
+        and adopts each surviving row's PRNG key at its trim cut from
+        the returned key walk. Returns ``(tokens_processed,
+        had_chunks)`` for the headroom EWMAs."""
+        tr = self._tr()
+        tp0 = tr.now() if tr is not None else None
+        co = self._co()
+        if co is not None:
+            co.set_phase("plan")
+        plan = []
+        if self._chunk and self.scheduler.num_prefilling:
+            plan = self.scheduler.prefill_plan(self._prefill_budget(),
+                                               self.cache.block_size,
+                                               cap=self._chunk)
+        active = [s for s in self._slots
+                  if s is not None and s.status == "running"]
+        if not active and not plan:
+            return 0, False
+        n = self.scheduler.choose_decode_ticks(active,
+                                               self._decode_ticks)
+        R, T = self.num_slots, self._token_budget
+        ids = np.zeros(T, np.int32)
+        seg = np.full(T, R, np.int32)       # sentinel: dead packed rows
+        pos = np.zeros(T, np.int32)
+        qstart = np.zeros(R, np.int32)
+        qlen = np.zeros(R, np.int32)
+        kvlen = np.zeros(R, np.int32)
+        dec_mask = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        topks = np.zeros(R, np.int32)
+        eos_ids = np.full(R, -1, np.int32)  # -1: no EOS configured
+        budgets = np.zeros(R, np.int32)
+        keys = np.asarray(self._keys, np.uint32).copy()
+        # packing eos_ids/budgets switches _pack_decode_rows to the
+        # alive-mask pre-growth: the WHOLE block's capacity up front
+        # (min(n, remaining) rows — the device stops at the cut), so
+        # no mid-block host intervention, no fallback at block
+        # boundaries
+        cursor = self._pack_decode_rows(n, ids, seg, pos, qstart, qlen,
+                                        kvlen, dec_mask, temps, topks,
+                                        eos_ids=eos_ids,
+                                        budgets=budgets)
+        chunk_rows, cursor = self._pack_chunk_rows(
+            plan, cursor, ids, seg, pos, qstart, qlen, kvlen, keys,
+            temps, topks)
+        if tr is not None:
+            tr.complete("plan", tp0,
+                        args={"rows": len(active), "chunks": len(plan),
+                              "ticks": n})
+            tl0 = tr.now()
+        if co is not None:
+            co.set_phase("launch")
+        npk, npv, toks, kwalk, ticks_run = self._mtick_fn()(
+            self._params, self.cache.pool.k, self.cache.pool.v,
+            self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
+            dec_mask, keys, temps, topks, eos_ids, budgets,
+            np.int32(n))
+        self.cache.update(npk, npv)
+        toks_np = np.asarray(toks)          # [max_ticks, R]
+        kwalk_np = np.asarray(kwalk)        # [max_ticks, R, 2]
+        ticks = int(ticks_run)              # <= n: early exit when all
+        self.stats["unified_steps"] += 1    # rows retire on device
+        if co is not None:
+            co.set_phase("host-accept")
+        if tr is not None:
+            tr.complete("launch", tl0,
+                        args={"packed_tokens": cursor, "ticks": n,
+                              "ticks_run": ticks})
+            th0 = tr.now()
+        # chunk bookkeeping first — mirrors the unified-step order (a
+        # final chunk adopts tick 0's token/key, the same one split as
+        # a one-shot prefill)
+        for slot, seq, ntok, final in chunk_rows:
+            self._advance_chunk(seq, ntok, toks_np[0, slot],
+                                kwalk_np[0, slot], finished)
+        emitted_total = 0
+        if active:
+            self.stats["decode_calls"] += 1
+            self.stats["decode_steps"] += ticks
+            self.stats["slot_steps"] += ticks * self.num_slots
+            self.stats["mtick_syncs"] += 1
+            self.stats["mtick_ticks"] += ticks
+            self.stats["last_decode_ticks"] = ticks
+            counts = np.zeros(R, np.int32)  # accepted tokens per slot
+            for slot in range(self.num_slots):
+                s = self._slots[slot]
+                if s is not None and dec_mask[slot]:
+                    s.launches += 1     # rode this step's one program
+            emitted_total = self._accept_decode_rows(
+                toks_np, ticks, dec_mask, finished, counts=counts)
+            # adopt each SURVIVING decode row's key at its trim cut:
+            # keys_walk[m - 1] for a row that accepted m tokens (a
+            # still-running row accepted every tick, so this is the
+            # post-block key — same walk position as m sequential
+            # ticks). Finished slots are freed; idle/chunk rows keep
+            # their host key state. Snapshot AFTER chunk bookkeeping:
+            # a final chunk's _install_seq key write must survive.
+            knp = np.asarray(self._keys, np.uint32).copy()
+            adopted = False
+            for slot in range(self.num_slots):
+                seq = self._slots[slot]
+                if seq is None or not dec_mask[slot] \
+                        or seq.status != "running" \
+                        or counts[slot] == 0:
+                    continue
+                knp[slot] = kwalk_np[counts[slot] - 1, slot]
+                adopted = True
+            if adopted:
+                self._keys = jnp.asarray(knp)
+        if tr is not None:
+            tr.complete("host-accept", th0,
+                        args={"emitted": emitted_total,
+                              "ticks_run": ticks})
+        return sum(c for _, c in plan) + emitted_total, bool(chunk_rows)
 
     def _pack_chunk_rows(self, plan, cursor, ids, seg, pos, qstart, qlen,
                          kvlen, keys, temps, topks, sample_start=None):

@@ -96,6 +96,7 @@ class EngineFleet:
                  prefix_block_size=32, paged_attn=True,
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  spec_decode=False, spec_k=4, drafter=None,
+                 decode_ticks=1,
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -149,7 +150,7 @@ class EngineFleet:
             geom = (slots[i], smax[i], chunk[i], bool(paged_attn),
                     bool(ragged_step), bool(spec_decode), int(spec_k),
                     int(decode_chunk), int(prefix_block_size),
-                    bool(prefix_cache), pblocks[i])
+                    bool(prefix_cache), pblocks[i], int(decode_ticks))
             jit = jits.setdefault(geom, {})
 
             def factory(i=i, jit=jit):
@@ -164,7 +165,8 @@ class EngineFleet:
                     ragged_step=ragged_step,
                     headroom_mult=headroom_mult,
                     spec_decode=spec_decode, spec_k=spec_k,
-                    drafter=drafter, jit_cache=jit)
+                    drafter=drafter, decode_ticks=decode_ticks,
+                    jit_cache=jit)
 
             gw = ServingGateway(
                 factory(), max_queue=queues[i], idle_wait_s=idle_wait_s,

@@ -73,7 +73,8 @@ class Sequence:
                  "finish_reason", "slot", "key", "submit_step", "deadline",
                  "prefix_nodes", "prefix_hit_tokens", "prefilled",
                  "work", "restore_point", "queue_tick", "launches",
-                 "t_submit", "t_admitted", "t_first_token", "t_finish",
+                 "t_submit", "t_admitted", "t_first_token",
+                 "t_last_token", "t_finish",
                  "trace_mark", "trace_phase", "trace_chunk_i",
                  "trace_accepts")
 
@@ -132,6 +133,12 @@ class Sequence:
         self.t_submit = None
         self.t_admitted = None
         self.t_first_token = None
+        # stamp of the most recently ACCEPTED token (step-quantized
+        # like every stamp): /debug/requests derives TPOT-so-far from
+        # it instead of a live clock read, so a long multi-tick step
+        # shows the last sync's consistent figure rather than a
+        # numerator that inflates for n ticks and snaps back
+        self.t_last_token = None
         self.t_finish = None
         # request-lifecycle tracing state (profiler/tracing.py): the
         # clock mark the current phase started at, the phase's span

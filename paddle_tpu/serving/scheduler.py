@@ -200,6 +200,35 @@ class FIFOScheduler:
         admission order deterministic under preemption retries."""
         self.queue.appendleft(seq)
 
+    def choose_decode_ticks(self, active_seqs, max_ticks: int) -> int:
+        """How many on-device decode ticks the next MULTI-TICK step
+        should fuse behind one host sync (engine ``decode_ticks > 1``,
+        README "Multi-tick decode"). Unlike :meth:`choose_num_steps`,
+        the program's tick count is a RUNTIME argument with per-slot
+        EOS/budget retirement masked on device, so the choice is pure
+        latency policy — no compile set to bound, no per-slot budget
+        clamp needed:
+
+        - **mixed traffic** (prefill backlog) clamps to 1: fusing n
+          ticks would delay the next prompt chunk by n-1 ticks, the
+          TTFT head-of-line blocking chunking exists to remove;
+        - **waiting queue** shrinks n to the smallest active remaining
+          budget: the earliest GUARANTEED retirement then lands exactly
+          on a sync boundary, so a waiting request's admission is never
+          pushed past a slot's known budget cut (an early EOS inside
+          the block remains the standard multi-step trade — the device
+          masks its cost, the host sees it at the sync);
+        - otherwise n runs to the LARGEST active remaining budget
+          (capped at ``max_ticks``): near-finished rows retire
+          on-device mid-block instead of shrinking the block for
+          everyone — the whole point of the alive mask.
+        """
+        if max_ticks <= 1 or self.prefilling or not active_seqs:
+            return 1
+        horizon = (min if self.queue else max)(
+            s.remaining for s in active_seqs)
+        return max(1, min(int(max_ticks), horizon))
+
     def choose_num_steps(self, active_seqs) -> int:
         """How many decode steps to fuse into the next device call:
         the largest power of two that fits both ``decode_chunk`` and

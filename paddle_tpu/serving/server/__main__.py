@@ -108,6 +108,16 @@ def main(argv=None):
                          "decode-steps' worth of measured throughput to "
                          "prefill chunks per step (unified step only; "
                          "0 pins the fixed prefill-chunk cap)")
+    ap.add_argument("--decode-ticks", type=int, default=1,
+                    help="multi-tick decode (unified ragged engine "
+                         "only): fuse up to this many on-device decode "
+                         "ticks behind ONE host sync when every "
+                         "running slot is in pure decode — EOS/budget "
+                         "cuts are masked on device, streams stay "
+                         "byte-identical, and the host round-trip "
+                         "amortizes n-fold (tokens stream in bursts "
+                         "of up to n). Mixed traffic clamps back to "
+                         "single-tick. 1 = off (the baseline)")
     ap.add_argument("--spec-decode", action=argparse.BooleanOptionalAction,
                     default=False,
                     help="speculative multi-token decode (paged only): "
@@ -182,6 +192,7 @@ def main(argv=None):
             ragged_step=args.ragged_step,
             headroom_mult=args.headroom_mult or None,
             spec_decode=args.spec_decode, spec_k=args.spec_k,
+            decode_ticks=args.decode_ticks,
             trace=args.trace, trace_buffer=args.trace_buffer,
             cost=args.cost,
             watchdog_deadline_s=args.watchdog_deadline or None,
@@ -200,6 +211,8 @@ def main(argv=None):
             "prefill_chunk": [r.gateway.engine.prefill_chunk
                               for r in fleet.replicas],
             "spec_decode": fleet.replicas[0].gateway.engine.spec_decode,
+            "decode_ticks":
+                fleet.replicas[0].gateway.engine.decode_ticks,
             "trace": fleet.tracer.enabled,
             "cost": fleet.replicas[0].gateway.cost is not None,
             "endpoints": ["/v1/completions", "/healthz", "/metrics",
@@ -225,6 +238,7 @@ def main(argv=None):
         ragged_step=args.ragged_step,
         headroom_mult=args.headroom_mult or None,
         spec_decode=args.spec_decode, spec_k=args.spec_k,
+        decode_ticks=args.decode_ticks,
         trace=args.trace, trace_buffer=args.trace_buffer,
         cost=args.cost,
         watchdog_deadline_s=args.watchdog_deadline or None,
@@ -245,6 +259,9 @@ def main(argv=None):
                       "ragged_step": server.gateway.engine.ragged_step,
                       "spec_decode": server.gateway.engine.spec_decode,
                       "spec_k": server.gateway.engine.spec_k,
+                      # report what actually runs: the engine's
+                      # effective multi-tick fuse depth (1 = off)
+                      "decode_ticks": server.gateway.engine.decode_ticks,
                       # report what actually runs: whether the tracer
                       # is RECORDING now (the persistent --trace mode)
                       # and the effective ring capacity

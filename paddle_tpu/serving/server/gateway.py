@@ -96,7 +96,8 @@ from ..faults import TransientFault
 CARRIED_ENGINE_STATS = (
     "preemptions", "prefill_copy_dispatches", "prefill_chunks",
     "prefill_tokens_saved", "spec_proposed", "spec_accepted",
-    "spec_tokens", "decode_calls", "tokens_generated")
+    "spec_tokens", "decode_calls", "tokens_generated",
+    "mtick_syncs", "mtick_ticks")
 
 #: same carry for the prefix cache's own stats dict (a rebuild builds a
 #: fresh trie, zeroing hits/misses/evictions).
@@ -480,6 +481,17 @@ class ServingGateway:
                 "(prefill_chunk is the cap; fixed at it until the "
                 "EWMAs have signal or with adaptivity off).").set_fn(
             lambda: self.engine.stats["headroom"])
+        # multi-tick decode surface (README "Multi-tick decode"):
+        # mean on-device decode ticks per host sync — 1.0 means the
+        # host is back in the loop every token, decode_ticks means the
+        # fast path is fully engaged. Counters ride the _stat() carry,
+        # so a rebuild never dents the ratio.
+        r.gauge("serving_decode_ticks_per_sync",
+                "Mean fused on-device decode ticks per host sync on "
+                "the multi-tick engine (decode_ticks=1 engines and "
+                "engines that never decoded scrape 0).").set_fn(
+            lambda: (self._stat("mtick_ticks")
+                     / max(self._stat("mtick_syncs"), 1)))
         # speculative-decode surface (README "Speculative decoding"):
         # registered only on a speculative engine, read THROUGH
         # self.engine so a recovery rebuild re-binds them (same idiom
@@ -1465,8 +1477,17 @@ class ServingGateway:
                 qw = now - seq.t_submit          # still waiting: so far
             tpot = seq.tpot_s
             if tpot is None and seq.t_first_token is not None \
-                    and len(seq.tokens) > 1:
-                tpot = (now - seq.t_first_token) / (len(seq.tokens) - 1)
+                    and len(seq.tokens) > 1 \
+                    and seq.t_last_token is not None:
+                # TPOT-so-far from the LAST ACCEPTED token's stamp, not
+                # the live clock: mid-step the token count is frozen at
+                # the previous host-accept while `now` keeps advancing,
+                # so a clock-based numerator inflates for the whole
+                # step — n ticks of it under multi-tick decode — then
+                # snaps back. Stamp-over-stamp stays consistent however
+                # long the device runs between syncs.
+                tpot = (seq.t_last_token - seq.t_first_token) \
+                    / (len(seq.tokens) - 1)
             kv_tokens, kv_blocks, kv_bytes = 0, None, 0
             if slot is not None:
                 kv_tokens = int(eng.cache.lengths[slot])

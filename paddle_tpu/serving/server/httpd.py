@@ -504,7 +504,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           paged_attn=True, prefill_chunk=512, ragged_step=True,
           headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
-          drafter=None, trace=False, trace_buffer=65536, cost=True):
+          drafter=None, trace=False, trace_buffer=65536, cost=True,
+          decode_ticks=1):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -572,6 +573,20 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     and the per-request TTFT/TPOT/queue-wait decomposition lands on
     ``/metrics`` as ``serving_tpot_seconds`` /
     ``serving_queue_wait_seconds``.
+
+    ``decode_ticks > 1`` (unified ragged engine only, default 1 so
+    every banked baseline stays an A/B away) turns on multi-tick
+    decode (README "Multi-tick decode"): when every running slot is in
+    pure decode the engine fuses up to ``decode_ticks`` on-device
+    ticks behind ONE host sync, with EOS/budget retirement masked
+    inside the program — streams stay byte-identical, the host
+    round-trip is amortized n-fold, and mixed traffic clamps back to
+    single-tick so TTFT never regresses. ``/metrics`` grows the
+    ``serving_decode_ticks_per_sync`` gauge; the
+    ``serving_dispatches_per_decoded_token`` headline drops
+    proportionally (DISPATCH_BENCH.json banks the ladder). Note the
+    trade: a streaming client sees tokens in bursts of up to
+    ``decode_ticks``.
     """
     from ..engine import ContinuousBatchingEngine
 
@@ -588,6 +603,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             paged_attn=paged_attn, prefill_chunk=prefill_chunk,
             ragged_step=ragged_step, headroom_mult=headroom_mult,
             spec_decode=spec_decode, spec_k=spec_k, drafter=drafter,
+            decode_ticks=decode_ticks,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
     gateway = ServingGateway(
@@ -611,7 +627,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
                 watchdog_deadline_s=30.0, max_restarts=8,
                 fault_hooks=None, clock=None, spec_decode=False,
                 spec_k=4, drafter=None, trace=False, trace_buffer=65536,
-                cost=True, affinity_band=16):
+                cost=True, affinity_band=16, decode_ticks=1):
     """Build an engine fleet → HTTP server and start listening (README
     "Engine fleet"): ``replicas`` supervised engines — each its own
     paged pool, prefix trie and scheduler, sharing compiled programs
@@ -650,7 +666,8 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
         prefix_block_size=prefix_block_size, paged_attn=paged_attn,
         prefill_chunk=prefill_chunk, ragged_step=ragged_step,
         headroom_mult=headroom_mult, spec_decode=spec_decode,
-        spec_k=spec_k, drafter=drafter, registry=registry, clock=clock,
+        spec_k=spec_k, drafter=drafter, decode_ticks=decode_ticks,
+        registry=registry, clock=clock,
         watchdog_deadline_s=watchdog_deadline_s,
         max_restarts=max_restarts, fault_hooks=fault_hooks,
         trace=trace, trace_buffer=trace_buffer, cost=cost, start=True)

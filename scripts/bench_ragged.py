@@ -233,7 +233,10 @@ def _raw_step_wall(model, num_slots, s_max):
             eng.submit(_req(SHORT_LEN, new=60))
         eng.step()
         eng.step()
-        t_dec = min(_timed(eng.step) for _ in range(8))
+        # best-of-9 floor (the bench_dispatch/bench_trace repeat
+        # discipline, ISSUE 13): best-of-5-ish rounds flake ~4% on
+        # this host, and these walls are banked as absolute ms
+        t_dec = min(_timed(eng.step) for _ in range(9))
         for s in list(eng._slots):
             if s is not None:
                 eng.cancel(s)

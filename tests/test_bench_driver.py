@@ -157,11 +157,15 @@ class TestBenchDriverFlow:
                                       "accepted": True,
                                       "tokens_equal": True}), ""
             if leg == "--dispatch":
-                # dispatch-cost leg: same hang-proof contract
+                # dispatch-cost leg (now carrying the multi-tick
+                # decode ladder): same hang-proof contract
                 assert env == {"JAX_PLATFORMS": "cpu"}
                 return 0, json.dumps(
                     {"name": "dispatch", "ok": True,
                      "baseline_dispatches_per_decoded_token": 0.32,
+                     "dispatches_per_decoded_token_by_ticks":
+                         {"1": 0.32, "4": 0.13, "8": 0.11},
+                     "multitick_dispatch_reduction": 3.0,
                      "exact_vs_program_accessors": True,
                      "accepted": True}), ""
             if leg == "--smoke":
@@ -221,6 +225,10 @@ class TestBenchDriverFlow:
         assert art["trace_overhead"]["disabled_overhead_ratio"] == 1.002
         assert art["dispatch"]["accepted"] is True
         assert art["dispatch"]["exact_vs_program_accessors"] is True
+        # the multi-tick ladder rides the same banked leg
+        assert art["dispatch"]["multitick_dispatch_reduction"] == 3.0
+        assert art["dispatch"][
+            "dispatches_per_decoded_token_by_ticks"]["8"] == 0.11
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

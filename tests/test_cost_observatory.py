@@ -325,7 +325,8 @@ class TestGatewaySurface:
         by_kind = {lab[0][1]: v for (_, lab), v in
                    disp["samples"].items()}
         assert set(by_kind) == {"prefill", "suffix", "psuffix",
-                                "decode", "pdecode", "ragged", "spec"}
+                                "decode", "pdecode", "ragged", "mtick",
+                                "spec"}
         assert by_kind["ragged"] > 0          # the engine default path
         assert sum(by_kind.values()) == gw.cost.totals["dispatches"]
         xfer = {lab[0][1]: v for (_, lab), v in
@@ -569,6 +570,28 @@ class TestGuardDiscipline:
         assert not violations, "\n".join(violations)
         # sanity: the sweep actually sees the instrumentation
         assert guarded >= 20, f"only {guarded} guarded sites found"
+
+    def test_sweep_sees_the_multitick_step(self):
+        """ISSUE 13 satellite: the multi-tick step path must sit
+        behind the same one-attribute guards as every other step
+        path. The engine's ``_multitick_step`` is inside the swept
+        tree by construction; pin that it (a) exists, (b) contains
+        tracer/cost instrumentation, and (c) that instrumentation is
+        guard-disciplined (the sweep above would flag violations —
+        this test makes sure the sweep actually has multi-tick sites
+        to look at, so a refactor that moved them out of serving/
+        could not silently shrink coverage)."""
+        src = (SERVING_DIR / "engine.py").read_text()
+        assert "_multitick_step" in src
+        fn = src.split("def _multitick_step(")[1].split("\n    def ")[0]
+        # the step's instrumentation goes through the guards...
+        assert "tr = self._tr()" in fn and "co = self._co()" in fn
+        # ...and the hot sites never touch self.tracer/self.cost raw
+        assert "self.tracer." not in fn and "self.cost." not in fn
+        # the program handout rides the counting chokepoint, so the
+        # mtick program's dispatches are exactly attributed
+        assert "_wrap_prog" in src.split("def _mtick_fn(")[1].split(
+            "\n    def ")[0]
 
     def test_sweep_covers_the_fleet_package(self):
         """ISSUE 12 satellite: the rglob sweep must keep covering
