@@ -528,6 +528,15 @@ def watchdog():
     dp = _parse_result(rc, out)
     cb_extra["dispatch"] = dp if dp is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Quantized-density leg: int8-KV slot capacity at a fixed pool-byte
+    # budget + measured greedy divergence (scripts/bench_density.py) —
+    # exact byte accounting, deterministic tokens. Same hang-proof
+    # contract: CPU-forced, banked before the tunnel can wedge anything.
+    rc, out, err = _run([me, "--density"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    dn = _parse_result(rc, out)
+    cb_extra["density"] = dn if dn is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -717,6 +726,13 @@ if __name__ == "__main__":
         from bench_dispatch import measure_dispatch_cost
         print(json.dumps({"name": "dispatch", "ok": True,
                           **measure_dispatch_cost(quick=True)}))
+        sys.exit(0)
+    if "--density" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_density import measure_density
+        print(json.dumps({"name": "density", "ok": True,
+                          **measure_density(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -49,8 +49,34 @@ from .pallas_flash import _cparams, _interpret_mode
 NEG_INF = -1e30
 
 
-def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
-                  l_scr, acc_scr, *, scale, block_k):
+def _head_scale_mat(s, rows, gh, hkv):
+    """Per-(wide-row, KV-row) dequant factors for an int8 pool block
+    (README "Quantized serving"): wide row ``w`` belongs to KV head
+    ``(w % gh) // (gh // hkv)`` and its dequant factor for pool row
+    ``j`` is that head's scale ``s[j, h]``. Rather than interleave-
+    repeating the scale plane across each head's D lanes (a lane-dim
+    reshape Mosaic dislikes), build the [rows, hkv] head one-hot from
+    iota and take ONE small dot with the scale plane — 2D ops only,
+    the kernels' conservative-tiling discipline. ``s``: [block_k, hkv]
+    fp32 → returns [rows, block_k] fp32."""
+    g = gh // hkv
+    w = jax.lax.broadcasted_iota(jnp.int32, (rows, hkv), 0)
+    h = jax.lax.broadcasted_iota(jnp.int32, (rows, hkv), 1)
+    onehot = jnp.where((w % gh) // g == h, 1.0, 0.0).astype(jnp.float32)
+    return jax.lax.dot_general(onehot, s, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _paged_kernel(len_ref, tbl_ref, *refs, scale, block_k,
+                  quantized=False, hkv=0):
+    # positional ref layout follows the pallas_call spec lists: inputs
+    # (q, k, v[, k_scale, v_scale]), then the output, then scratch
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+         acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -67,8 +93,20 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
         q = q_ref[0]                        # [H, Hkv*D] block-diagonal
         k = k_ref[0]                        # [block_k, Hkv*D]
         v = v_ref[0]                        # [block_k, Hkv*D]
+        if quantized:
+            # int8 pool: the DMA above moved int8 (the HBM win); the
+            # dequant happens HERE, right after it — the data converts
+            # in VMEM on the way into the MXU, and the per-row-per-head
+            # scales apply POST-dot via the head one-hot trick
+            # (_head_scale_mat), since the block-diagonal wide rows
+            # make the factor separable per (row, col)
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if quantized:
+            s = s * _head_scale_mat(ks_ref[0], s.shape[0], s.shape[0],
+                                    hkv)
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(cols < length, s, NEG_INF)
         m_prev = m_scr[:, :1]
@@ -85,6 +123,11 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
+        if quantized:
+            # V dequant, same separability: fold the scales into P
+            # (P_wj * sv[j, head(w)]) and dot with the raw int8 values
+            p = p * _head_scale_mat(vs_ref[0], p.shape[0], p.shape[0],
+                                    hkv)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -96,14 +139,21 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret):
+def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret,
+                scales=None):
     """q_wide: [B, H, KD] block-diagonal; pool_*: [num_blocks, bs, KD];
-    tables: [B, max_blocks] int32 physical block ids."""
+    tables: [B, max_blocks] int32 physical block ids; scales: None, or
+    ``(k_scale, v_scale)`` [num_blocks, bs, Hkv] fp32 planes for an
+    int8 pool (dequant in-kernel, right after the table-indirect
+    DMA)."""
     B, H, KD = q_wide.shape
     num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
     nk = tables.shape[1]
     grid = (B, nk)
-    kernel = functools.partial(_paged_kernel, scale=scale, block_k=bs)
+    quantized = scales is not None
+    hkv = scales[0].shape[2] if quantized else 0
+    kernel = functools.partial(_paged_kernel, scale=scale, block_k=bs,
+                               quantized=quantized, hkv=hkv)
 
     def _kv_index(b, ki, lens, tbl):
         # table-indirect fetch with the dense kernel's ragged-skip clamp:
@@ -114,16 +164,24 @@ def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret):
         phys = tbl[b, jnp.minimum(ki, last)]
         return (jnp.clip(phys, 0, num_blocks - 1), 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, H, KD), lambda b, ki, lens, tbl: (b, 0, 0)),
+        pl.BlockSpec((1, bs, KD), _kv_index),
+        pl.BlockSpec((1, bs, KD), _kv_index),
+    ]
+    args = [lengths, tables, q_wide, pool_k, pool_v]
+    if quantized:
+        # the scale planes ride the SAME table-indirect index map as
+        # the data blocks: one block's scales arrive with its values
+        in_specs += [pl.BlockSpec((1, bs, hkv), _kv_index),
+                     pl.BlockSpec((1, bs, hkv), _kv_index)]
+        args += [scales[0], scales[1]]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, H, KD), lambda b, ki, lens, tbl: (b, 0, 0)),
-                pl.BlockSpec((1, bs, KD), _kv_index),
-                pl.BlockSpec((1, bs, KD), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, H, KD),
                                    lambda b, ki, lens, tbl: (b, 0, 0)),
             scratch_shapes=[
@@ -135,7 +193,7 @@ def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret):
         out_shape=jax.ShapeDtypeStruct((B, H, KD), q_wide.dtype),
         compiler_params=_cparams(("parallel", "arbitrary")),
         interpret=interpret,
-    )(lengths, tables, q_wide, pool_k, pool_v)
+    )(*args)
     return out
 
 
@@ -161,7 +219,32 @@ def _paged_bwd_rule(scale, res, g):
 _paged.defvjp(_paged_fwd_rule, _paged_bwd_rule)
 
 
-def paged_decode_attention_pallas(q, pool_k, pool_v, tables, lengths):
+# quantized twin (the arg count differs, so it needs its own custom_vjp
+# wrapper; same inference-only rationale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _paged_q(q_wide, pool_k, pool_v, k_scale, v_scale, tables, lengths,
+             scale):
+    return _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale,
+                       _interpret_mode(), scales=(k_scale, v_scale))
+
+
+def _paged_q_fwd_rule(q_wide, pool_k, pool_v, k_scale, v_scale, tables,
+                      lengths, scale):
+    return _paged_q(q_wide, pool_k, pool_v, k_scale, v_scale, tables,
+                    lengths, scale), None
+
+
+def _paged_q_bwd_rule(scale, res, g):
+    raise NotImplementedError(
+        "paged_decode_attention_pallas is inference-only (single-token "
+        "decode never backpropagates)")
+
+
+_paged_q.defvjp(_paged_q_fwd_rule, _paged_q_bwd_rule)
+
+
+def paged_decode_attention_pallas(q, pool_k, pool_v, tables, lengths,
+                                  k_scale=None, v_scale=None):
     """Single-token decode attention through a block table.
 
     q:        [B, H, D]              — one query token per sequence
@@ -170,6 +253,11 @@ def paged_decode_attention_pallas(q, pool_k, pool_v, tables, lengths):
     tables:   [B, max_blocks] int32  — physical block ids per sequence
                                        (entries >= num_blocks = unmapped)
     lengths:  [B] int32              — valid logical rows per sequence
+    k_scale/v_scale: None, or [num_blocks, bs, Hkv] fp32 scale planes
+              for an int8 pool (README "Quantized serving") — the
+              kernel DMAs int8 blocks and dequantizes in VMEM right
+              after the table-indirect fetch, so HBM traffic is int8
+              while the MXU math stays full-precision
     returns:  [B, H, D]
 
     The logical cache of row ``b`` is ``pool[tables[b]]`` flattened to
@@ -189,19 +277,27 @@ def paged_decode_attention_pallas(q, pool_k, pool_v, tables, lengths):
     eye = jnp.eye(Hkv, dtype=q.dtype)
     q_wide = jnp.einsum("bkgd,kj->bkgjd", q.reshape(B, Hkv, G, D), eye)
     q_wide = q_wide.reshape(B, H, KD)
-    out_wide = _paged(q_wide, pool_k.reshape(num_blocks, bs, KD),
-                      pool_v.reshape(num_blocks, bs, KD), tables, lengths,
-                      scale)
+    if k_scale is not None:
+        out_wide = _paged_q(q_wide, pool_k.reshape(num_blocks, bs, KD),
+                            pool_v.reshape(num_blocks, bs, KD),
+                            k_scale, v_scale, tables, lengths, scale)
+    else:
+        out_wide = _paged(q_wide, pool_k.reshape(num_blocks, bs, KD),
+                          pool_v.reshape(num_blocks, bs, KD), tables,
+                          lengths, scale)
     out = jnp.einsum("bkgjd,kj->bkgd",
                      out_wide.reshape(B, Hkv, G, Hkv, D), eye)
     return out.reshape(B, H, D)
 
 
-def paged_decode_attention_reference(q, pool_k, pool_v, tables, lengths):
+def paged_decode_attention_reference(q, pool_k, pool_v, tables, lengths,
+                                     k_scale=None, v_scale=None):
     """jnp oracle with identical semantics: materialize each row's
     logical cache by gathering its table (clip-mode keeps sentinel
     entries harmless — masked by ``lengths``), then run the dense
-    ragged reference."""
+    ragged reference. An int8 pool (``k_scale``/``v_scale`` given)
+    dequantizes right after the gather — the same
+    fetch-then-dequantize order as the Pallas kernel."""
     B = q.shape[0]
     num_blocks, bs, Hkv, D = pool_k.shape
     mb = tables.shape[1]
@@ -210,4 +306,11 @@ def paged_decode_attention_reference(q, pool_k, pool_v, tables, lengths):
                  mode="clip").reshape(B, mb * bs, Hkv, D)
     v = jnp.take(pool_v, tables, axis=0,
                  mode="clip").reshape(B, mb * bs, Hkv, D)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, tables, axis=0,
+                      mode="clip").reshape(B, mb * bs, Hkv)
+        vs = jnp.take(v_scale, tables, axis=0,
+                      mode="clip").reshape(B, mb * bs, Hkv)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     return decode_attention_reference(q, k, v, lengths)

@@ -66,12 +66,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_flash import _cparams, _interpret_mode
+from .pallas_paged_decode import _head_scale_mat
 
 NEG_INF = -1e30
 
 
-def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_scr, l_scr, acc_scr, *, scale, block_k, tq, gh):
+def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, *refs, scale,
+                   block_k, tq, gh, quantized=False, hkv=0):
+    # positional ref layout follows the pallas_call spec lists: inputs
+    # (q, k, v[, k_scale, v_scale]), then the output, then scratch
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+         acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     qi = pl.program_id(0)
     r = pl.program_id(1)
     ki = pl.program_id(2)
@@ -104,8 +113,19 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, q_ref, k_ref, v_ref,
         q = q_ref[:]                        # [tq, KD] block-diag wide
         k = k_ref[0]                        # [block_k, KD]
         v = v_ref[0]
+        if quantized:
+            # int8 pool: the table-indirect DMA above moved int8 (the
+            # HBM win); dequant happens HERE, right after it — values
+            # convert in VMEM on the way into the MXU and the per-row-
+            # per-head scales apply post-dot via the head one-hot
+            # trick (_head_scale_mat; the query block is a multiple of
+            # gh, so the row->head map is block-position-free)
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if quantized:
+            s = s * _head_scale_mat(ks_ref[0], tq, gh, hkv)
         wrow = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         # causal-within-span: wide row w belongs to span token
@@ -128,6 +148,10 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, q_ref, k_ref, v_ref,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
+        if quantized:
+            # V dequant, same separability: fold the scales into P
+            # (P_wj * sv[j, head(w)]) and dot with the raw values
+            p = p * _head_scale_mat(vs_ref[0], tq, gh, hkv)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -148,16 +172,22 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
-                 scale, gh, block_q, interpret):
+                 scale, gh, block_q, interpret, scales=None):
     """q_wide: [TH_pad, KD] block-diagonal wide rows (gh per token);
-    pool_*: [num_blocks, bs, KD]; tables: [R, max_blocks] int32."""
+    pool_*: [num_blocks, bs, KD]; tables: [R, max_blocks] int32;
+    scales: None, or ``(k_scale, v_scale)`` [num_blocks, bs, Hkv] fp32
+    planes for an int8 pool (dequant in-kernel, right after the
+    table-indirect DMA)."""
     TH, KD = q_wide.shape
     num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
     R, nk = tables.shape
     nq = TH // block_q
     grid = (nq, R, nk)
+    quantized = scales is not None
+    hkv = scales[0].shape[2] if quantized else 0
     kernel = functools.partial(_ragged_kernel, scale=scale, block_k=bs,
-                               tq=block_q, gh=gh)
+                               tq=block_q, gh=gh, quantized=quantized,
+                               hkv=hkv)
 
     def _kv_index(qi, r, ki, qs, ql, kl, tbl):
         # table-indirect fetch with the decode kernel's ragged-skip
@@ -171,16 +201,24 @@ def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
     def _q_index(qi, r, ki, qs, ql, kl, tbl):
         return (qi, 0)
 
+    in_specs = [
+        pl.BlockSpec((block_q, KD), _q_index),
+        pl.BlockSpec((1, bs, KD), _kv_index),
+        pl.BlockSpec((1, bs, KD), _kv_index),
+    ]
+    args = [qstart, qlen, kvlen, tables, q_wide, pool_k, pool_v]
+    if quantized:
+        # the scale planes ride the SAME table-indirect index map as
+        # the data blocks: one block's scales arrive with its values
+        in_specs += [pl.BlockSpec((1, bs, hkv), _kv_index),
+                     pl.BlockSpec((1, bs, hkv), _kv_index)]
+        args += [scales[0], scales[1]]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_q, KD), _q_index),
-                pl.BlockSpec((1, bs, KD), _kv_index),
-                pl.BlockSpec((1, bs, KD), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((block_q, KD), _q_index),
             scratch_shapes=[
                 pltpu.VMEM((block_q, 128), jnp.float32),
@@ -193,7 +231,7 @@ def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
         # across r and accumulated across ki) — no reordering allowed
         compiler_params=_cparams(("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(qstart, qlen, kvlen, tables, q_wide, pool_k, pool_v)
+    )(*args)
     return out
 
 
@@ -222,8 +260,34 @@ def _ragged_bwd_rule(scale, gh, block_q, res, g):
 _ragged.defvjp(_ragged_fwd_rule, _ragged_bwd_rule)
 
 
+# quantized twin (the arg count differs, so it needs its own custom_vjp
+# wrapper; same inference-only rationale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _ragged_q(q_wide, pool_k, pool_v, k_scale, v_scale, tables, qstart,
+              qlen, kvlen, scale, gh, block_q):
+    return _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen,
+                        kvlen, scale, gh, block_q, _interpret_mode(),
+                        scales=(k_scale, v_scale))
+
+
+def _ragged_q_fwd_rule(q_wide, pool_k, pool_v, k_scale, v_scale, tables,
+                       qstart, qlen, kvlen, scale, gh, block_q):
+    return _ragged_q(q_wide, pool_k, pool_v, k_scale, v_scale, tables,
+                     qstart, qlen, kvlen, scale, gh, block_q), None
+
+
+def _ragged_q_bwd_rule(scale, gh, block_q, res, g):
+    raise NotImplementedError(
+        "ragged_paged_attention_pallas is inference-only (the serving "
+        "step never backpropagates)")
+
+
+_ragged_q.defvjp(_ragged_q_fwd_rule, _ragged_q_bwd_rule)
+
+
 def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
-                                  kvlen, block_q=256):
+                                  kvlen, block_q=256, k_scale=None,
+                                  v_scale=None):
     """Mixed prefill+decode attention over packed query spans through
     per-sequence block tables.
 
@@ -237,6 +301,11 @@ def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
     kvlen:    [R] int32 — valid logical KV rows per sequence AFTER this
                           step's writes (span token i attends over
                           positions 0 .. kvlen - qlen + i)
+    k_scale/v_scale: None, or [num_blocks, bs, Hkv] fp32 scale planes
+              for an int8 pool (README "Quantized serving") — the
+              kernel DMAs int8 blocks and dequantizes in VMEM right
+              after the table-indirect fetch, so HBM traffic is int8
+              while the MXU math stays full-precision
     returns:  [T, H, D]; packed rows outside every span are exact zeros
 
     GQA is resolved with the block-diagonal wide-query trick (see
@@ -267,9 +336,15 @@ def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
     th_pad = -(-(T * H) // bq) * bq
     if th_pad != T * H:
         q_wide = jnp.pad(q_wide, ((0, th_pad - T * H), (0, 0)))
-    out_wide = _ragged(q_wide, pool_k.reshape(num_blocks, bs, KD),
-                       pool_v.reshape(num_blocks, bs, KD), tables,
-                       qstart, qlen, kvlen, scale, H, bq)
+    if k_scale is not None:
+        out_wide = _ragged_q(q_wide, pool_k.reshape(num_blocks, bs, KD),
+                             pool_v.reshape(num_blocks, bs, KD),
+                             k_scale, v_scale, tables, qstart, qlen,
+                             kvlen, scale, H, bq)
+    else:
+        out_wide = _ragged(q_wide, pool_k.reshape(num_blocks, bs, KD),
+                           pool_v.reshape(num_blocks, bs, KD), tables,
+                           qstart, qlen, kvlen, scale, H, bq)
     out_wide = out_wide[:T * H]
     # extract each head's own kv-group block from the wide accumulator
     out = jnp.einsum("bkgjd,kj->bkgd",
@@ -278,13 +353,16 @@ def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
 
 
 def ragged_attention_reference(q, pool_k, pool_v, tables, qstart, qlen,
-                               kvlen):
+                               kvlen, k_scale=None, v_scale=None):
     """jnp oracle with identical semantics — and, deliberately, the
     exact op sequence of the two programs it unifies: a span-1 row
     reproduces ``paged_decode_attention_reference`` and a span-n row
     reproduces ``_paged_suffix_prefill_impl``'s in-program attention
     (same einsums, same masking, same plain softmax), so the unified
-    serving step can be pinned bitwise against the old pair."""
+    serving step can be pinned bitwise against the old pair. An int8
+    pool (``k_scale``/``v_scale`` given) dequantizes right after the
+    two-stage gather — the same fetch-then-dequantize order as the
+    kernel."""
     T, H, D = q.shape
     num_blocks, bs, Hkv, _ = pool_k.shape
     G = H // Hkv
@@ -314,6 +392,15 @@ def ragged_attention_reference(q, pool_k, pool_v, tables, qstart, qlen,
                       mode="clip").reshape(R, s_tot, Hkv, D)
     v_rows = jnp.take(pool_v, tables, axis=0,
                       mode="clip").reshape(R, s_tot, Hkv, D)
+    if k_scale is not None:
+        # int8 pool: dequantize right after the per-row gather (the
+        # kernel's fetch-then-dequantize order), per row and head
+        ks_rows = jnp.take(k_scale, tables, axis=0,
+                           mode="clip").reshape(R, s_tot, Hkv)
+        vs_rows = jnp.take(v_scale, tables, axis=0,
+                           mode="clip").reshape(R, s_tot, Hkv)
+        k_rows = k_rows.astype(jnp.float32) * ks_rows[..., None]
+        v_rows = v_rows.astype(jnp.float32) * vs_rows[..., None]
     k = jnp.take(k_rows, seg, axis=0)                     # [T, s_tot, ...]
     v = jnp.take(v_rows, seg, axis=0)
     kf = jnp.repeat(k, G, axis=2) if G > 1 else k

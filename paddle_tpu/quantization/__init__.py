@@ -19,7 +19,42 @@ from ..core.tensor import Tensor
 from ..ops._op import tensor_op
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quanted_linear",
-           "fake_quant", "FakeQuanterWithAbsMaxObserver", "QuantedLinear"]
+           "fake_quant", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
+           "quantize_weight_int8", "convert_weights_int8"]
+
+
+def quantize_weight_int8(w, reduce_axis, bits=8):
+    """Symmetric per-channel int8 weight-only quantization — THE shared
+    machinery behind :class:`ConvertedLinear` and the serving engine's
+    ``quantize_weights=True`` decode path
+    (``serving/decode.quantize_decode_params``, README "Quantized
+    serving").
+
+    ``w`` is the raw weight array; ``reduce_axis`` names the
+    contraction axis of the matmul the weight feeds (the "in" dim), so
+    each OUTPUT channel gets its own absmax scale — per-channel, not
+    per-tensor, because one outlier channel must not flatten every
+    other channel's resolution. Returns ``(q int8, scale f32)`` with
+    ``scale`` keeping the reduced axis as size 1 (broadcasts straight
+    back against ``q`` for the dequant ``q * scale``). Symmetric range
+    [-127, 127]: -128 is never emitted so ``|q * scale| <= absmax``
+    exactly. All-zero channels carry scale 0 and dequantize to exact
+    zeros (the quantize guard divides by a tiny floor instead).
+    ``bits < 8`` narrows the grid inside the same int8 storage (the
+    PTQ 4-bit convert path); ``bits > 8`` cannot fit int8 and raises.
+    """
+    if not 2 <= int(bits) <= 8:
+        raise ValueError(
+            f"int8 storage holds 2..8-bit symmetric grids, got "
+            f"bits={bits}")
+    qmax = float(2 ** (int(bits) - 1) - 1)
+    w = jnp.asarray(w)
+    scale = (jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axis,
+                     keepdims=True) / qmax)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32)
+                           / jnp.maximum(scale, 1e-30)),
+                 -qmax, qmax).astype(jnp.int8)
+    return q, scale
 
 
 # ------------------------------------------------------------- fake quant
@@ -131,17 +166,21 @@ class ObservedLinear(nn.Layer):
 class ConvertedLinear(nn.Layer):
     """Inference form: weights stored int8 + scale, dequantized on the fly
     (on TPU the int8 weight halves HBM traffic; XLA emits the dequant as a
-    fused convert on the way into the MXU)."""
+    fused convert on the way into the MXU).
+
+    Scales are PER OUTPUT CHANNEL and computed ONCE here, at convert
+    time (``quantize_weight_int8``) — the forward only applies them.
+    Per-tensor absmax let a single outlier channel flatten every other
+    channel's resolution, and deriving scales inside ``__call__`` both
+    re-paid the reduction on every step and made the quantization grid
+    drift with whatever dtype autocast handed in. ``w_scale`` has shape
+    ``[1, out_features]`` (paddle's ``[in, out]`` weight layout)."""
 
     def __init__(self, weight, bias, weight_bits=8):
         super().__init__()
-        qmax = 2.0 ** (weight_bits - 1) - 1
-        w = weight.value
-        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
-        self.register_buffer("qweight",
-                             Tensor(jnp.clip(jnp.round(w / scale),
-                                             -qmax - 1, qmax)
-                                    .astype(jnp.int8)))
+        q, scale = quantize_weight_int8(weight.value, reduce_axis=0,
+                                        bits=weight_bits)
+        self.register_buffer("qweight", Tensor(q))
         self.register_buffer("w_scale", Tensor(scale))
         self.bias = bias
 
@@ -242,6 +281,21 @@ class PTQ:
             lambda child: isinstance(child, ObservedLinear),
             lambda child: ConvertedLinear(child.weight, child.bias,
                                           self.cfg.weight_bits))
+
+
+def convert_weights_int8(model):
+    """One-call weight-only int8 conversion (no observers, no
+    calibration): swap every ``nn.Linear`` for a
+    :class:`ConvertedLinear` with per-channel scales baked at convert
+    time. IDEMPOTENT: already-converted layers (and QAT/observed ones)
+    are skipped, so ``convert_weights_int8(convert_weights_int8(m))``
+    is a no-op — the second pass finds nothing to swap and never
+    re-quantizes an int8 weight (which would double the quantization
+    error). The serving engine's ``quantize_weights=True`` knob is the
+    raw-array twin of this layer-level surface."""
+    return _swap_layers(
+        model, None, lambda lin, _cfg: ConvertedLinear(lin.weight,
+                                                       lin.bias))
 
 
 def quanted_linear(x, weight, bias=None, w_bits=8, a_scale=None, a_bits=8):

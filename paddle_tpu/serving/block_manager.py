@@ -37,20 +37,46 @@ import numpy as np
 
 
 class BlockManager:
-    """Physical block pool: device arrays + free heap + refcounts."""
+    """Physical block pool: device arrays + free heap + refcounts.
+
+    ``kv_dtype="int8"`` stores the pool block-quantized (README
+    "Quantized serving"): ``k``/``v`` become int8 and each block
+    carries a per-row-per-head fp32 SCALE PLANE alongside it —
+    ``k_scale``/``v_scale`` ``[L, num_blocks, block_size, Hkv]``,
+    indexed by the SAME physical block id as the data, so every
+    lifecycle move (alloc/free/ref/drop, trie donation, speculative
+    truncation) carries a block's scales with it for free: there is no
+    separate scale bookkeeping to drift. Appends quantize on the way
+    in (``serving/decode.quantize_kv_rows``); the attention kernels
+    dequantize right after the table-indirect DMA, so HBM block bytes
+    are int8 (a ~4x cut vs fp32 at head_dim 64; scales cost
+    ``4 / head_dim`` of the int8 data) while the matmuls stay
+    full-precision."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
-                 head_dim, dtype=jnp.float32):
+                 head_dim, dtype=jnp.float32, kv_dtype=None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (store at pool dtype) or 'int8', "
+                f"got {kv_dtype!r}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         shape = (num_layers, self.num_blocks, self.block_size,
                  num_kv_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        store = jnp.int8 if self.quantized else dtype
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        if self.quantized:
+            self.k_scale = jnp.zeros(shape[:-1], jnp.float32)
+            self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
         self._free_heap = list(range(self.num_blocks))
         self._free_set = set(self._free_heap)
         self._ref = np.zeros(self.num_blocks, np.int32)
@@ -81,11 +107,23 @@ class BlockManager:
 
     @property
     def block_nbytes(self) -> int:
-        """HBM bytes one block holds across all layers, K and V — the
+        """HBM bytes one block's K/V DATA holds across all layers — the
         unit of the ``/debug/requests`` per-request KV-bytes column and
         the cost observatory's occupancy-to-bytes conversion. Abstract
-        (shape × itemsize): no device sync."""
+        (shape × itemsize): no device sync. Dtype-aware by
+        construction: an int8 pool reports int8 bytes (scale planes are
+        accounted separately, :attr:`scale_block_nbytes`)."""
         per = self.k.size * np.dtype(self.k.dtype).itemsize
+        return 2 * per // self.num_blocks
+
+    @property
+    def scale_block_nbytes(self) -> int:
+        """HBM bytes one block's SCALE PLANES hold across all layers,
+        K and V (0 on an unquantized pool) — the ``kind="scales"``
+        half of the ``kv_pool_bytes`` gauge."""
+        if not self.quantized:
+            return 0
+        per = self.k_scale.size * np.dtype(self.k_scale.dtype).itemsize
         return 2 * per // self.num_blocks
 
     def alloc(self):

@@ -45,11 +45,18 @@ from ..kernels.pallas_ragged_attention import (ragged_attention_reference,
                                                ragged_paged_attention_pallas)
 from ..models.llama import _apply_rope, _qkv_bshd, _rms, _rope_tables, \
     _swiglu_raw
+from .kv_cache import quantize_kv_rows
 
 NEG_INF = -1e30
 
 _STACK_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "input_ln", "post_ln")
+
+#: the decode-path projection matmuls quantize_weights=True converts
+#: (README "Quantized serving"); norms and the embedding gather stay
+#: full-precision (a gather reads one row — there is no bandwidth to
+#: win — and norm weights are tiny but numerically load-bearing)
+_WEIGHT_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
 def llama_decode_params(model):
@@ -63,6 +70,106 @@ def llama_decode_params(model):
         lm_head=(model.embed_tokens.value if model.lm_head is None
                  else model.lm_head.value))
     return p, model.lm_head is None
+
+
+# --------------------------------------------- int8 weight-only decode
+def quantize_decode_params(params, tied):
+    """Convert the decode param pytree to int8 weight-only form — the
+    engine's ``quantize_weights=True`` knob (README "Quantized
+    serving"), riding the same per-channel absmax machinery as
+    ``quantization.ConvertedLinear`` (``quantize_weight_int8``). Each
+    projection weight becomes a ``(q int8, scale f32)`` pair — a
+    pytree-structure change, so quantized engines key their programs
+    apart in a shared jit cache — dequantized per layer inside the
+    programs (``_dq_layer``): HBM streams int8, the MXU sees the
+    dequantized convert. ``lm_head`` quantizes over its contraction
+    axis for the orientation it is used in (tied heads run
+    ``embed.T``); the embedding table itself stays full-precision for
+    the token gather."""
+    from ..quantization import quantize_weight_int8
+    out = dict(params)
+    for k in _WEIGHT_QUANT_KEYS:
+        out[k] = quantize_weight_int8(params[k], reduce_axis=1)
+    out["lm_head"] = quantize_weight_int8(params["lm_head"],
+                                          reduce_axis=1 if tied else 0)
+    return out
+
+
+def _dq(w, dt):
+    """Dequantize one int8 weight-only ``(q, scale)`` pair to ``dt``;
+    full-precision arrays pass through untouched (the one branch every
+    decode program shares, so quantized and raw params run the same
+    impl — the pytree structure IS the trace variant)."""
+    if isinstance(w, tuple):
+        q, s = w
+        return (q.astype(jnp.float32) * s).astype(dt)
+    return w
+
+
+def _dq_layer(lp, dt):
+    """Per-layer weight handoff: dequantize the 7 projection entries of
+    one scanned layer tuple IN the layer body — one layer materializes
+    at a time, so the weight stack still streams int8 from HBM — and
+    pass everything after them (norm weights, cache slices) through
+    untouched."""
+    return tuple(_dq(w, dt) for w in lp[:7]) + tuple(lp[7:])
+
+
+def _dq_head(params, tied, dt):
+    """The lm-head matmul operand, dequantized when quantized (tied
+    heads transpose AFTER dequant — the scales were laid out for the
+    stored orientation)."""
+    head = _dq(params["lm_head"], dt)
+    return head.T if tied else head
+
+
+# ------------------------------------------------- int8 block-pool view
+# A quantized pool arrives as ONE pytree argument per side —
+# ``(data int8, scale f32)`` — so every program signature (and its
+# donation spec) is unchanged; these four helpers are the only places
+# the programs touch the difference. Appends quantize on write through
+# ``kv_cache.quantize_kv_rows`` (THE quantization rule — shared with
+# the prefill scatter); attention dequantizes inside the kernels
+# (``k_scale``/``v_scale``) or right after the oracle gather.
+def _kv_data(pool):
+    """The raw storage array of a pool side (shape/dtype queries)."""
+    return pool[0] if isinstance(pool, tuple) else pool
+
+
+def _kv_attn_args(pool_k, pool_v):
+    """Unpack both pool sides for an attention call: ``(k, v,
+    k_scale, v_scale)`` with None scales on a full-precision pool."""
+    if isinstance(pool_k, tuple):
+        return pool_k[0], pool_v[0], pool_k[1], pool_v[1]
+    return pool_k, pool_v, None, None
+
+
+def _kv_write(pool_l, phys, row, x):
+    """Scatter K/V rows ``x [..., Hkv, D]`` into one layer's pool slice
+    at ``(phys, row)`` — quantizing on write (data + per-row-per-head
+    scales to the SAME coordinates) on an int8 pool. Drop-mode both
+    ways: a dead row vanishes from data and scales alike."""
+    if isinstance(pool_l, tuple):
+        data, sc = pool_l
+        q, s = quantize_kv_rows(x)
+        return (data.at[phys, row].set(q, mode="drop"),
+                sc.at[phys, row].set(s, mode="drop"))
+    return pool_l.at[phys, row].set(x, mode="drop")
+
+
+def _kv_gather_rows(pool_l, tables, shape4):
+    """Gather per-row logical caches through the block tables
+    (clip-mode; the suffix-prefill oracle path), dequantizing right
+    after the gather on an int8 pool. ``shape4`` is the target
+    ``(G, s_tot, Hkv, D)``."""
+    if isinstance(pool_l, tuple):
+        data, sc = pool_l
+        rows = jnp.take(data, tables, axis=0,
+                        mode="clip").reshape(shape4)
+        srows = jnp.take(sc, tables, axis=0,
+                         mode="clip").reshape(shape4[:-1])
+        return rows.astype(jnp.float32) * srows[..., None]
+    return jnp.take(pool_l, tables, axis=0, mode="clip").reshape(shape4)
 
 
 def _apply_rope_rows(x, sin_p, cos_p):
@@ -117,10 +224,11 @@ def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
     B, S = ids.shape
     sin, cos = _rope_tables(S, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    wdt = params["embed"].dtype
+    head = _dq_head(params, tied, wdt)
 
     def prefill_layer(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = lp
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = _dq_layer(lp, wdt)
         hn = _rms(h, lin, eps)
         q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope(q, sin, cos)
@@ -189,7 +297,8 @@ def _suffix_prefill_impl(params, cache_k, cache_v, slots, prefix_lens, ids,
     num_slots, s_max = cache_k.shape[1], cache_k.shape[2]
     sin, cos = _rope_tables(s_max, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    wdt = params["embed"].dtype
+    head = _dq_head(params, tied, wdt)
 
     # gather each row's slot cache: [L, G, s_max, Hkv, D]. Padding rows
     # point at slot index num_slots — the gather clips (harmless read of
@@ -210,7 +319,8 @@ def _suffix_prefill_impl(params, cache_k, cache_v, slots, prefix_lens, ids,
     scale = 1.0 / (hd ** 0.5)
 
     def layer(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv) = lp
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv) = \
+            _dq_layer(lp, wdt)
         hn = _rms(h, lin, eps)
         q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_grid(q, sin_p, cos_p)
@@ -292,15 +402,19 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     tables/lengths/knobs are runtime arrays, so the compile set stays
     the same pow2 (group, bucket) grid as the dense suffix path.
 
-    Returns (pool_k', pool_v', tok0, keys').
+    Returns (pool_k', pool_v', tok0, keys'). On an int8 pool each side
+    arrives (and returns) as a ``(data, scale)`` pair: suffix K/V
+    quantize on write (``_kv_write``) and the in-program attention
+    dequantizes right after the table gather (``_kv_gather_rows``).
     """
     G, S = ids.shape
-    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    nb, bs = _kv_data(pool_k).shape[1], _kv_data(pool_k).shape[2]
     mb = tables.shape[1]
     s_tot = mb * bs
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    wdt = params["embed"].dtype
+    head = _dq_head(params, tied, wdt)
 
     pos = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     sin_p = jnp.take(sin, pos, axis=0, mode="clip")   # [G, S, D]
@@ -325,20 +439,21 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     prow = pos % bs
 
     def layer(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = \
+            _dq_layer(lp, wdt)
         hn = _rms(h, lin, eps)
         q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_grid(q, sin_p, cos_p)
         k = _apply_rope_grid(k, sin_p, cos_p)
-        # write the suffix K/V through the table, then gather each row's
-        # logical cache (shared prefix + own suffix) for attention; the
-        # causal mask keeps columns from seeing rows past their position
-        pk_l = pk_l.at[phys, prow].set(k, mode="drop")
-        pv_l = pv_l.at[phys, prow].set(v, mode="drop")
-        ck = jnp.take(pk_l, tables, axis=0,
-                      mode="clip").reshape(G, s_tot, nkv, hd)
-        cv = jnp.take(pv_l, tables, axis=0,
-                      mode="clip").reshape(G, s_tot, nkv, hd)
+        # write the suffix K/V through the table (quantize-on-write on
+        # an int8 pool), then gather each row's logical cache (shared
+        # prefix + own suffix) for attention — dequantized right after
+        # the gather; the causal mask keeps columns from seeing rows
+        # past their position
+        pk_l = _kv_write(pk_l, phys, prow, k)
+        pv_l = _kv_write(pv_l, phys, prow, v)
+        ck = _kv_gather_rows(pk_l, tables, (G, s_tot, nkv, hd))
+        cv = _kv_gather_rows(pv_l, tables, (G, s_tot, nkv, hd))
         kf = jnp.repeat(ck, grp, axis=2) if grp > 1 else ck
         vf = jnp.repeat(cv, grp, axis=2) if grp > 1 else cv
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
@@ -392,7 +507,8 @@ def _decode_steps_impl(params, cache_k, cache_v, tokens, lengths, keys,
     s_max = cache_k.shape[2]
     sin, cos = _rope_tables(s_max, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    wdt = params["embed"].dtype
+    head = _dq_head(params, tied, wdt)
 
     def one_step(carry, _):
         tok, ck_all, cv_all, lens, kys = carry
@@ -401,7 +517,8 @@ def _decode_steps_impl(params, cache_k, cache_v, tokens, lengths, keys,
         cos_p = jnp.take(cos, lens, axis=0)
 
         def layer(h, xs):
-            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv = xs
+            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, ck, cv = \
+                _dq_layer(xs, wdt)
             hn = _rms(h, lin, eps)
             q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
             q = _apply_rope_rows(q, sin_p, cos_p)
@@ -470,7 +587,8 @@ def _paged_decode_steps_impl(params, pool_k, pool_v, tables, tokens,
     s_tot = mb * bs
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    wdt = params["embed"].dtype
+    head = _dq_head(params, tied, wdt)
 
     def one_step(carry, _):
         tok, pk_all, pv_all, lens, kys = carry
@@ -487,7 +605,8 @@ def _paged_decode_steps_impl(params, pool_k, pool_v, tables, tokens,
         prow = lens % bs
 
         def layer(h, xs):
-            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
+            lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = \
+                _dq_layer(xs, wdt)
             hn = _rms(h, lin, eps)
             q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
             q = _apply_rope_rows(q, sin_p, cos_p)
@@ -545,9 +664,10 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
     ``lens`` by ``app_mask``.
     """
     R = tok.shape[0]
-    nb, bs = pk_all.shape[1], pk_all.shape[2]
+    nb, bs = _kv_data(pk_all).shape[1], _kv_data(pk_all).shape[2]
     mb = tables.shape[1]
     s_tot = mb * bs
+    wdt = params["embed"].dtype
     x = jnp.take(params["embed"], tok[:, None], axis=0)     # [R, 1, H]
     sin_r = jnp.take(sin, lens, axis=0, mode="clip")
     cos_r = jnp.take(cos, lens, axis=0, mode="clip")
@@ -559,19 +679,23 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
     prow = lens % bs
 
     def layer(h, xs):
-        lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = xs
+        lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = \
+            _dq_layer(xs, wdt)
         hn = _rms(h, lin, eps)
         q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_rows(q, sin_r, cos_r)
         k = _apply_rope_rows(k, sin_r, cos_r)
-        pk_l = pk_l.at[phys, prow].set(k[:, 0], mode="drop")
-        pv_l = pv_l.at[phys, prow].set(v[:, 0], mode="drop")
+        pk_l = _kv_write(pk_l, phys, prow, k[:, 0])
+        pv_l = _kv_write(pv_l, phys, prow, v[:, 0])
+        kd, vd, ksc, vsc = _kv_attn_args(pk_l, pv_l)
         if decode_attn == "pallas":
             attn = paged_decode_attention_pallas(
-                q[:, 0], pk_l, pv_l, tables, lens + app_mask)
+                q[:, 0], kd, vd, tables, lens + app_mask,
+                k_scale=ksc, v_scale=vsc)
         else:
             attn = paged_decode_attention_reference(
-                q[:, 0], pk_l, pv_l, tables, lens + app_mask)
+                q[:, 0], kd, vd, tables, lens + app_mask,
+                k_scale=ksc, v_scale=vsc)
         h = h + jnp.einsum("bsd,dh->bsh",
                            attn.reshape(R, 1, nh * hd), lwo)
         h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
@@ -616,11 +740,12 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
     paged kernel or its jnp oracle. Returns ``(x [1, T, H], pk, pv)``.
     """
     R = tables.shape[0]
-    nb, bs = pool_k.shape[1], pool_k.shape[2]
+    nb, bs = _kv_data(pool_k).shape[1], _kv_data(pool_k).shape[2]
     mb = tables.shape[1]
     s_tot = mb * bs
     T = ids.shape[0]
     stack = tuple(params[k] for k in _STACK_KEYS)
+    wdt = params["embed"].dtype
     sin_p = jnp.take(sin, pos, axis=0, mode="clip")[None]   # [1, T, D]
     cos_p = jnp.take(cos, pos, axis=0, mode="clip")[None]
     # pool write coordinates: token t appends at its logical position
@@ -636,21 +761,29 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
     prow0 = pos % bs
 
     def layer0(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = lp
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = \
+            _dq_layer(lp, wdt)
         hn = _rms(h, lin, eps)
         q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_grid(q, sin_p, cos_p)
         k = _apply_rope_grid(k, sin_p, cos_p)
-        # write the packed K/V through the tables, then attend over each
-        # span causally at its row's kv length
-        pk_l = pk_l.at[phys0, prow0].set(k[0], mode="drop")
-        pv_l = pv_l.at[phys0, prow0].set(v[0], mode="drop")
+        # write the packed K/V through the tables (quantize-on-write on
+        # an int8 pool), then attend over each span causally at its
+        # row's kv length — THE one dequant site: the ragged kernel
+        # (or its oracle) dequantizes right after the table-indirect
+        # fetch, and every consumer of this forward (unified step,
+        # multi-tick tick 0, speculative verify) rides it
+        pk_l = _kv_write(pk_l, phys0, prow0, k[0])
+        pv_l = _kv_write(pv_l, phys0, prow0, v[0])
+        kd, vd, ksc, vsc = _kv_attn_args(pk_l, pv_l)
         if decode_attn == "pallas":
             attn = ragged_paged_attention_pallas(
-                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+                q[0], kd, vd, tables, qstart, qlen, kvlen,
+                k_scale=ksc, v_scale=vsc)
         else:
             attn = ragged_attention_reference(
-                q[0], pk_l, pv_l, tables, qstart, qlen, kvlen)
+                q[0], kd, vd, tables, qstart, qlen, kvlen,
+                k_scale=ksc, v_scale=vsc)
         h = h + jnp.einsum("bsd,dh->bsh",
                            attn.reshape(1, T, nh * hd), lwo)
         h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
@@ -706,10 +839,10 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     as a one-shot prefill, so streams stay byte-identical); ``keys'``
     is the post-scan key state the engine adopts for decode rows.
     """
-    s_tot = tables.shape[1] * pool_k.shape[2]
+    s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    head = _dq_head(params, tied, params["embed"].dtype)
 
     # ----------------------------------- tick 0 (shared packed forward)
     x, pk, pv = _packed_span_forward(
@@ -808,10 +941,10 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     zeros the host never reads.
     """
     R = tables.shape[0]
-    s_tot = tables.shape[1] * pool_k.shape[2]
+    s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    head = _dq_head(params, tied, params["embed"].dtype)
 
     # ----------------------------------- tick 0 (shared packed forward)
     x, pk, pv = _packed_span_forward(
@@ -928,9 +1061,9 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     """
     T = ids.shape[0]
     R = tables.shape[0]
-    s_tot = tables.shape[1] * pool_k.shape[2]
+    s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
-    head = params["lm_head"].T if tied else params["lm_head"]
+    head = _dq_head(params, tied, params["embed"].dtype)
 
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,

@@ -176,7 +176,8 @@ class ContinuousBatchingEngine:
                  prefix_block_size=32, paged_attn=True,
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  step_clock=None, spec_decode=False, spec_k=4,
-                 drafter=None, decode_ticks=1):
+                 drafter=None, decode_ticks=1, kv_dtype=None,
+                 quantize_weights=False):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -186,6 +187,17 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefill_bucketing must be 'pow2' or 'exact', got "
                 f"{prefill_bucketing!r}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (store KV at the pool dtype) or "
+                f"'int8', got {kv_dtype!r}")
+        if kv_dtype == "int8" and not (paged_attn and ragged_step):
+            raise ValueError(
+                "kv_dtype='int8' requires the unified ragged paged "
+                "engine (paged_attn=True, ragged_step=True): the int8 "
+                "pool's one dequant site is the ragged attention "
+                "kernel, and the dense / two-program paths never grew "
+                "scale-plane plumbing")
         self.model = model
         self.config = c
         self.num_slots = int(num_slots)
@@ -193,6 +205,34 @@ class ContinuousBatchingEngine:
         self._bucketing = prefill_bucketing
         self._params, self._tied = llama_decode_params(model)
         self._paged = bool(paged_attn)
+        # int8 block-quantized KV (README "Quantized serving"): the
+        # pool stores int8 with per-row-per-head fp32 scale planes, the
+        # append paths quantize on write, and the ragged kernel
+        # dequantizes after the table-indirect DMA. Default None keeps
+        # the pool at the model dtype — every banked baseline is
+        # byte-identical to before the knob existed.
+        self._kv_quant = kv_dtype == "int8"
+        self._kv_dtype = kv_dtype
+        # int8 weight-only decode matmuls: convert ONCE per model (the
+        # converted pytree is model-resident, so the factory's rebuilds
+        # and every fleet replica share both the quantized arrays and
+        # the jit cache — decode_compilations()==1 across rebuilds)
+        self._wq8 = bool(quantize_weights)
+        if self._wq8:
+            from .decode import quantize_decode_params
+            qp = model.__dict__.get("_decode_qparams")
+            if qp is None:
+                qp = quantize_decode_params(self._params, self._tied)
+                model.__dict__["_decode_qparams"] = qp
+            self._params = qp
+        # jit-key variant tags: quantized pools/params are a DIFFERENT
+        # TRACE of the same impl (dtype / pytree structure), so engines
+        # differing only in kv_dtype or quantize_weights sharing one
+        # jit_cache dict must key apart or both compile pins break.
+        # Appended at the END of each key; () on default engines keeps
+        # every pre-existing key byte-identical.
+        self._kvtag = ("kv8",) if self._kv_quant else ()
+        self._wtag = ("w8",) if self._wq8 else ()
         dtype = self._params["embed"].dtype
         from .block_manager import BlockManager
         from .prefix_cache import PrefixCache
@@ -204,18 +244,25 @@ class ContinuousBatchingEngine:
                     f"prefix_block_size must be >= 1, got {bs}")
             max_blocks = -(-self.max_seq_len // bs)
             live = self.num_slots * max_blocks
+            # the pool's STORAGE dtype follows kv_dtype (int8 data +
+            # scale planes), not the model dtype — a shared pool must
+            # match the engine's quantization mode exactly
+            store = jnp.int8 if self._kv_quant else dtype
             if isinstance(prefix_cache, PrefixCache):
                 pool = prefix_cache.pool
                 want = (c.num_hidden_layers, c.num_key_value_heads,
                         c.head_dim)
                 have = (pool.k.shape[0],) + pool.k.shape[3:]
-                if have != want or pool.k.dtype != dtype \
-                        or pool.block_size != bs:
+                if have != want or pool.k.dtype != store \
+                        or pool.block_size != bs \
+                        or getattr(pool, "quantized",
+                                   False) != self._kv_quant:
                     raise ValueError(
                         f"shared PrefixCache pool geometry "
                         f"{have}/bs={pool.block_size}/{pool.k.dtype} does "
                         f"not match this paged engine "
-                        f"{want}/bs={bs}/{dtype}")
+                        f"{want}/bs={bs}/{store} "
+                        f"(kv_dtype={self._kv_dtype!r})")
                 if pool.num_blocks <= live:
                     raise ValueError(
                         f"shared pool of {pool.num_blocks} blocks cannot "
@@ -238,16 +285,18 @@ class ContinuousBatchingEngine:
                             f"prefix_blocks must be >= 1, got {budget}")
                 pool = BlockManager(
                     c.num_hidden_layers, live + budget, bs,
-                    c.num_key_value_heads, c.head_dim, dtype=dtype)
+                    c.num_key_value_heads, c.head_dim, dtype=dtype,
+                    kv_dtype=self._kv_dtype)
                 self.prefix_cache = PrefixCache(pool, max_blocks=budget)
             else:
                 pool = BlockManager(
                     c.num_hidden_layers, live, bs, c.num_key_value_heads,
-                    c.head_dim, dtype=dtype)
+                    c.head_dim, dtype=dtype, kv_dtype=self._kv_dtype)
             self.cache = PagedKVCache(
                 c.num_hidden_layers, self.num_slots, self.max_seq_len,
                 c.num_key_value_heads, c.head_dim, dtype=dtype,
-                block_size=bs, pool=pool, prefix_cache=self.prefix_cache)
+                block_size=bs, pool=pool, prefix_cache=self.prefix_cache,
+                kv_dtype=self._kv_dtype)
         else:
             self.cache = SlotKVCache(
                 c.num_hidden_layers, self.num_slots, self.max_seq_len,
@@ -493,7 +542,11 @@ class ContinuousBatchingEngine:
                     theta=float(c.rope_theta), tied=self._tied)
 
     def _prefill_fn(self):
-        key = ("prefill",)
+        # the weight tag (not the kv tag): the cold prefill touches the
+        # params but never the pool, so two engines differing only in
+        # kv_dtype SHARE this trace while a quantized-weights engine
+        # (different param pytree = different trace) keys apart
+        key = ("prefill",) + self._wtag
         if key not in self._jit:
             self._jit[key] = build_prefill_fn(**self._fn_consts())
         # host_out: the engine fetches tok0 (result 2); pk/pv feed the
@@ -503,8 +556,10 @@ class ContinuousBatchingEngine:
     def _suffix_fn(self):
         # paged and dense suffix programs are distinct (table-indirect
         # vs slot-indexed) and may share one jit_cache dict, so they key
-        # apart; the cold prefill is IDENTICAL either way and is shared
-        key = ("psuffix",) if self._paged else ("suffix",)
+        # apart; the cold prefill is IDENTICAL either way and is shared.
+        # The suffix program touches params AND pool — both tags.
+        key = (("psuffix",) if self._paged else ("suffix",)) \
+            + self._kvtag + self._wtag
         if key not in self._jit:
             build = (build_paged_suffix_prefill_fn if self._paged
                      else build_suffix_prefill_fn)
@@ -513,7 +568,8 @@ class ContinuousBatchingEngine:
 
     def _decode_fn(self, n_steps):
         kind = "pdecode" if self._paged else "decode"
-        key = (kind, int(n_steps), self.config.decode_attention)
+        key = (kind, int(n_steps), self.config.decode_attention) \
+            + self._kvtag + self._wtag
         if key not in self._jit:
             build = (build_paged_decode_steps_fn if self._paged
                      else build_decode_steps_fn)
@@ -531,7 +587,8 @@ class ContinuousBatchingEngine:
         # only THIS engine's geometry, and e.g. slots=8/chunk=64 vs
         # slots=16/chunk=56 share a token budget of 72)
         key = ("ragged", self.num_slots, self._token_budget,
-               int(n_steps), self.config.decode_attention)
+               int(n_steps), self.config.decode_attention) \
+            + self._kvtag + self._wtag
         if key not in self._jit:
             self._jit[key] = build_ragged_step_fn(
                 n_steps=int(n_steps),
@@ -548,7 +605,8 @@ class ContinuousBatchingEngine:
         # one jit_cache. The tick count actually run is a runtime
         # argument, so this is the engine's ONE decode program.
         key = ("mtick", self.num_slots, self._token_budget,
-               self._decode_ticks, self.config.decode_attention)
+               self._decode_ticks, self.config.decode_attention) \
+            + self._kvtag + self._wtag
         if key not in self._jit:
             from .decode import build_multitick_step_fn
             self._jit[key] = build_multitick_step_fn(
@@ -564,7 +622,8 @@ class ContinuousBatchingEngine:
         # the spec token budget) plus the sampling-walk depth key the
         # trace apart from other engines sharing one jit_cache
         key = ("spec", self.num_slots, self._spec_budget,
-               self._spec_len, self.config.decode_attention)
+               self._spec_len, self.config.decode_attention) \
+            + self._kvtag + self._wtag
         if key not in self._jit:
             from .decode import build_spec_verify_fn
             self._jit[key] = build_spec_verify_fn(
@@ -596,6 +655,24 @@ class ContinuousBatchingEngine:
         return self._decode_ticks
 
     @property
+    def kv_dtype(self) -> str:
+        """The EFFECTIVE KV storage dtype this engine serves from:
+        ``"int8"`` on a quantized pool, else the pool's array dtype
+        name — the public surface for banners/metrics (README
+        "Quantized serving")."""
+        if self._kv_quant:
+            return "int8"
+        arr = self.cache.pool.k if self._paged else self.cache.k
+        return str(arr.dtype)
+
+    @property
+    def quantize_weights(self) -> bool:
+        """Whether the decode-path projection matmuls run int8
+        weight-only (converted once at engine build) — the public
+        surface for banners/metrics."""
+        return self._wq8
+
+    @property
     def ragged_step(self) -> bool:
         """Whether this engine runs the unified ragged step (one device
         program per step for decode rows + prefill chunks) — the public
@@ -623,16 +700,20 @@ class ContinuousBatchingEngine:
         engine the verify program IS the decode program — every step,
         chunk-carrying or not, is one spec-geometry launch — so the
         count covers the verify geometry too."""
+        tags = self._kvtag + self._wtag
         if self._spec:
             # spec_len is CONFIG (spec_k + 1), not a runtime variant
             # like the ragged key's n_steps — two engines differing
             # only in spec_k can share a budget (the chunk term of the
-            # max dominates), so it must be part of the identity
+            # max dominates), so it must be part of the identity.
+            # key[5:] is the quantization-variant tail: a quantized
+            # engine sharing this jit_cache is a different program.
             return sum(fn._cache_size() for key, fn in self._jit.items()
                        if key[0] == "spec"
                        and key[1] == self.num_slots
                        and key[2] == self._spec_budget
-                       and key[3] == self._spec_len)
+                       and key[3] == self._spec_len
+                       and key[5:] == tags)
         if self._mtick:
             # the multi-tick program IS the decode program — every
             # step, chunk-carrying or not, is one mtick-geometry launch
@@ -645,23 +726,29 @@ class ContinuousBatchingEngine:
                        if key[0] == "mtick"
                        and key[1] == self.num_slots
                        and key[2] == self._token_budget
-                       and key[3] == self._decode_ticks)
+                       and key[3] == self._decode_ticks
+                       and key[5:] == tags)
         if self._ragged:
             return sum(fn._cache_size() for key, fn in self._jit.items()
                        if key[0] == "ragged"
                        and key[1] == self.num_slots
-                       and key[2] == self._token_budget)
+                       and key[2] == self._token_budget
+                       and key[5:] == tags)
         kind = "pdecode" if self._paged else "decode"
         return sum(fn._cache_size() for key, fn in self._jit.items()
-                   if key[0] == kind)
+                   if key[0] == kind and key[3:] == tags)
 
     def prefill_compilations(self) -> int:
         """Prefill-side traces, cold + suffix: bounded by the pow2
         (group, bucket) grid — independent of the hit/miss/eviction mix
-        (the bounded-compile half of the prefix-cache contract)."""
+        (the bounded-compile half of the prefix-cache contract). Tag-
+        aware like :meth:`decode_compilations`: only THIS engine's
+        quantization variant counts."""
         sfx = "psuffix" if self._paged else "suffix"
         return sum(fn._cache_size() for key, fn in self._jit.items()
-                   if key[0] in ("prefill", sfx))
+                   if (key[0] == "prefill" and key[1:] == self._wtag)
+                   or (key[0] == sfx
+                       and key[1:] == self._kvtag + self._wtag))
 
     # ------------------------------------------------------------- intake
     def _key_for(self, request):
@@ -939,8 +1026,9 @@ class ContinuousBatchingEngine:
             if live:
                 temps[i] = float(seq.request.temperature)
                 topks[i] = int(seq.request.top_k)
-        kv = ((self.cache.pool.k, self.cache.pool.v) if self._paged
-              else (self.cache.k, self.cache.v))
+        # pool arrays in program-argument form: (data, scale) pairs on
+        # an int8 pool, plain arrays otherwise (PagedKVCache.kv_args)
+        kv = self.cache.kv_args()
         with self._tspan("prefill_launch",
                          args={"bucket": s_pad, "group": len(rows)}):
             # host arrays pass uncoerced (see _admit_cold): the cost
@@ -1518,7 +1606,7 @@ class ContinuousBatchingEngine:
         if co is not None:
             co.set_phase("launch")
         npk, npv, toks, keys_t0, keys_fin = self._ragged_fn(n)(
-            self._params, self.cache.pool.k, self.cache.pool.v,
+            self._params, *self.cache.kv_args(),
             self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
             dec_mask, keys, temps, topks)
         self.cache.update(npk, npv)
@@ -1697,7 +1785,7 @@ class ContinuousBatchingEngine:
         if co is not None:
             co.set_phase("launch")
         npk, npv, toks, kwalk, ticks_run = self._mtick_fn()(
-            self._params, self.cache.pool.k, self.cache.pool.v,
+            self._params, *self.cache.kv_args(),
             self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
             dec_mask, keys, temps, topks, eos_ids, budgets,
             np.int32(n))
@@ -1890,7 +1978,7 @@ class ContinuousBatchingEngine:
         if co is not None:
             co.set_phase("launch")
         npk, npv, toks, kwalk = self._spec_fn()(
-            self._params, self.cache.pool.k, self.cache.pool.v,
+            self._params, *self.cache.kv_args(),
             self.cache.tables, ids, seg, pos, qstart, qlen, kvlen,
             sample_start, keys, temps, topks)
         self.cache.update(npk, npv)

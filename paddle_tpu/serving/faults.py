@@ -171,6 +171,14 @@ class FaultPlan:
         if jnp.issubdtype(store.k.dtype, jnp.floating):
             store.k = jnp.full_like(store.k, jnp.nan)
             store.v = jnp.full_like(store.v, jnp.nan)
+        elif getattr(store, "quantized", False):
+            # int8 pool: the data arrays are integral (no NaN exists),
+            # but poisoning the fp32 scale planes is just as
+            # destructive — every dequantized read turns NaN — so the
+            # recovery-really-recomputes proof holds on the quantized
+            # engine too (README "Quantized serving")
+            store.k_scale = jnp.full_like(store.k_scale, jnp.nan)
+            store.v_scale = jnp.full_like(store.v_scale, jnp.nan)
 
     def __call__(self, engine):
         """The hook the engine invokes at the top of each step

@@ -96,7 +96,7 @@ class EngineFleet:
                  prefix_block_size=32, paged_attn=True,
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  spec_decode=False, spec_k=4, drafter=None,
-                 decode_ticks=1,
+                 decode_ticks=1, kv_dtype=None, quantize_weights=False,
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -147,10 +147,16 @@ class EngineFleet:
             # live + prefix budget sizes pool_k/pool_v, so
             # prefix_blocks (and the trie toggle that defaults it) are
             # geometry, not just policy
+            # kv_dtype and quantize_weights are geometry too: an int8
+            # pool is a different arg DTYPE and quantized params a
+            # different pytree — per-geometry jit caches must not
+            # collide or both engines' compile pins break (the
+            # pool-geometry-keyed-cache rule)
             geom = (slots[i], smax[i], chunk[i], bool(paged_attn),
                     bool(ragged_step), bool(spec_decode), int(spec_k),
                     int(decode_chunk), int(prefix_block_size),
-                    bool(prefix_cache), pblocks[i], int(decode_ticks))
+                    bool(prefix_cache), pblocks[i], int(decode_ticks),
+                    kv_dtype, bool(quantize_weights))
             jit = jits.setdefault(geom, {})
 
             def factory(i=i, jit=jit):
@@ -166,6 +172,8 @@ class EngineFleet:
                     headroom_mult=headroom_mult,
                     spec_decode=spec_decode, spec_k=spec_k,
                     drafter=drafter, decode_ticks=decode_ticks,
+                    kv_dtype=kv_dtype,
+                    quantize_weights=quantize_weights,
                     jit_cache=jit)
 
             gw = ServingGateway(

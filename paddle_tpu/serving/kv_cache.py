@@ -67,6 +67,26 @@ class PoolExhausted(RuntimeError):
             f"budget"))
 
 
+def quantize_kv_rows(x):
+    """Per-row-per-head symmetric int8 quantization of K/V rows — THE
+    quantization rule of the int8 block pool (README "Quantized
+    serving"); every append path (prefill scatter, chunk write, decode
+    append, spec-verify write, multi-tick in-loop append) routes
+    through this one function so the grid can never drift between
+    sites. ``x [..., Hkv, D]`` → ``(q int8 same shape,
+    scale f32 [..., Hkv])`` with ``scale = amax|x| / 127`` per
+    (row, head): each row quantizes INDEPENDENTLY — no neighbor, no
+    stale pool garbage, no earlier append influences it — which is
+    what makes quantized streams deterministic under restore()/replay
+    and lets truncate/donate move blocks without touching values.
+    All-zero rows carry scale 0 and dequantize to exact zeros."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    q = jnp.clip(jnp.round(xf / jnp.maximum(scale, 1e-30)[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _write_prefill(cache_k, cache_v, pk, pv, slot):
     # pk/pv: [L, S_pad, Hkv, D] -> one slot's leading rows. Rows past the
     # real prompt length hold prefill padding garbage; they sit beyond
@@ -100,21 +120,49 @@ def _copy_block_out(pool_k, pool_v, cache_k, cache_v, slot, row0, block_id):
     return pk, pv
 
 
-def _paged_write_prefill(pool_k, pool_v, pk, pv, table_row, prompt_len):
-    # pk/pv: [L, S_pad, Hkv, D] -> scatter rows [0, prompt_len) through
-    # the slot's block table into the pool. Rows past prompt_len (bucket
-    # padding) map to the sentinel and DROP — they must not land in the
-    # pool, where the trailing private block is real but any row beyond
-    # it would clip-alias another sequence's block.
+def _prefill_scatter_coords(pool_k, pk, table_row, prompt_len):
+    # THE prefill scatter-coordinate rule, shared by the plain and
+    # quantized writers (the clamp/drop semantics must not fork):
+    # rows [0, prompt_len) map through the slot's block table; rows
+    # past prompt_len (bucket padding) map to the sentinel ``nb`` and
+    # DROP — they must not land in the pool, where the trailing
+    # private block is real but any row beyond it would clip-alias
+    # another sequence's block.
     S = pk.shape[1]
     nb, bs = pool_k.shape[1], pool_k.shape[2]
     pos = jnp.arange(S, dtype=jnp.int32)
     bi = jnp.minimum(pos // bs, table_row.shape[0] - 1)
     phys = jnp.where(pos < prompt_len, jnp.take(table_row, bi), nb)
-    row = pos % bs
+    return phys, pos % bs
+
+
+def _paged_write_prefill(pool_k, pool_v, pk, pv, table_row, prompt_len):
+    # pk/pv: [L, S_pad, Hkv, D] -> scatter through the block table
+    # (coordinate rule + padding-drop: _prefill_scatter_coords)
+    phys, row = _prefill_scatter_coords(pool_k, pk, table_row,
+                                        prompt_len)
     pool_k = pool_k.at[:, phys, row].set(pk, mode="drop")
     pool_v = pool_v.at[:, phys, row].set(pv, mode="drop")
     return pool_k, pool_v
+
+
+def _paged_write_prefill_q(pool_k, pool_v, pool_ks, pool_vs, pk, pv,
+                           table_row, prompt_len):
+    # the quantized twin of _paged_write_prefill: the prefill program's
+    # full-precision K/V rows quantize ON WRITE (quantize_kv_rows) and
+    # land int8 in the pool with their per-row-per-head scales written
+    # to the SAME (block, row) coordinates (shared rule:
+    # _prefill_scatter_coords) — one drop-mode scatter each, so
+    # padding rows vanish from data and scales alike
+    phys, row = _prefill_scatter_coords(pool_k, pk, table_row,
+                                        prompt_len)
+    qk, sk = quantize_kv_rows(pk)
+    qv, sv = quantize_kv_rows(pv)
+    pool_k = pool_k.at[:, phys, row].set(qk, mode="drop")
+    pool_v = pool_v.at[:, phys, row].set(qv, mode="drop")
+    pool_ks = pool_ks.at[:, phys, row].set(sk, mode="drop")
+    pool_vs = pool_vs.at[:, phys, row].set(sv, mode="drop")
+    return pool_k, pool_v, pool_ks, pool_vs
 
 
 @functools.lru_cache(maxsize=None)
@@ -126,8 +174,12 @@ def _writer(donate):
 
 
 @functools.lru_cache(maxsize=None)
-def _paged_writer(donate):
-    # donate the POOL arrays (the pool is the cache being updated)
+def _paged_writer(donate, quantized=False):
+    # donate the POOL arrays (the pool is the cache being updated);
+    # the quantized writer donates the scale planes too
+    if quantized:
+        return jax.jit(_paged_write_prefill_q,
+                       donate_argnums=(0, 1, 2, 3) if donate else ())
     return jax.jit(_paged_write_prefill,
                    donate_argnums=(0, 1) if donate else ())
 
@@ -224,6 +276,12 @@ class SlotKVCache:
         """Adopt the decode step's functionally-updated cache arrays."""
         self.k, self.v = new_k, new_v
 
+    def kv_args(self):
+        """The cache arrays as the suffix program takes them — the
+        dense twin of :meth:`PagedKVCache.kv_args` (always plain
+        ``(k, v)``: the dense shim never quantizes)."""
+        return self.k, self.v
+
     def slot_kv_bytes(self, slot) -> int:
         """HBM bytes of the slot's valid rows (rows × per-row bytes) —
         the dense twin of :meth:`PagedKVCache.slot_kv_bytes` for the
@@ -277,18 +335,30 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_slots, max_seq_len, num_kv_heads,
                  head_dim, dtype=jnp.float32, block_size=32, pool=None,
-                 prefix_cache=None, donate=None):
+                 prefix_cache=None, donate=None, kv_dtype=None):
         from .block_manager import BlockManager
         bs = int(block_size)
         if bs < 1:
             raise ValueError(f"block_size must be >= 1, got {bs}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (store at pool dtype) or 'int8', "
+                f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
         self.block_size = bs
         self.max_blocks = -(-self.max_seq_len // bs)
         if pool is None:
             pool = BlockManager(num_layers, self.num_slots * self.max_blocks,
-                                bs, num_kv_heads, head_dim, dtype=dtype)
+                                bs, num_kv_heads, head_dim, dtype=dtype,
+                                kv_dtype=kv_dtype)
+        if getattr(pool, "quantized", False) != self.quantized:
+            raise ValueError(
+                f"pool kv_dtype {getattr(pool, 'kv_dtype', None)!r} does "
+                f"not match cache kv_dtype {kv_dtype!r}: an int8 cache "
+                f"needs a pool carrying scale planes (and vice versa)")
         if pool.block_size != bs:
             raise ValueError(
                 f"pool block_size {pool.block_size} != cache block_size "
@@ -461,25 +531,90 @@ class PagedKVCache:
 
     def slot_kv_bytes(self, slot) -> int:
         """HBM bytes the slot's table currently holds (blocks × block
-        bytes) — the ``/debug/requests`` cost column."""
-        return int(self._n_blocks[slot]) * self.pool.block_nbytes
+        bytes, scale planes included on a quantized pool) — the
+        ``/debug/requests`` cost column. Dtype-aware by construction:
+        the pool's per-block byte counts follow its storage dtype."""
+        return int(self._n_blocks[slot]) * (
+            self.pool.block_nbytes + self.pool.scale_block_nbytes)
+
+    def used_blocks(self) -> int:
+        """Allocated (live + trie) blocks — ONE table scan, shared by
+        the byte gauges so a /metrics scrape never pays the
+        :meth:`occupancy` walk more than once per series."""
+        occ = self.occupancy()
+        return occ["live"] + occ["trie"]
+
+    def bytes_per_token(self) -> float:
+        """Marginal HBM bytes one cached token costs (block data +
+        scale-plane bytes / block_size). Pure constants — no occupancy
+        scan — so the scrape-time gauge is free."""
+        return (self.pool.block_nbytes
+                + self.pool.scale_block_nbytes) / self.block_size
+
+    def occupancy_bytes(self) -> dict:
+        """Pool occupancy in BYTES, split by storage kind — the
+        ``kv_pool_bytes{kind="kv|scales"}`` gauges and the
+        ``serving_kv_bytes_per_token`` rate (README "Quantized
+        serving"). Derived from :meth:`occupancy`'s block accounting ×
+        the pool's dtype-aware per-block byte counts, so an int8 pool
+        reports int8 bytes plus its fp32 scale planes and the default
+        pool reports exactly what it always did with ``scales == 0``.
+        ``capacity_*`` cover the whole pool (the fixed HBM budget the
+        density bench holds constant); ``used_*`` cover allocated
+        (live + trie) blocks; ``per_token`` is the marginal HBM cost
+        of one cached token (block bytes / block_size)."""
+        used = self.used_blocks()
+        kv_b, sc_b = self.pool.block_nbytes, self.pool.scale_block_nbytes
+        return {
+            "used_kv": used * kv_b,
+            "used_scales": used * sc_b,
+            "capacity_kv": self.pool.num_blocks * kv_b,
+            "capacity_scales": self.pool.num_blocks * sc_b,
+            "per_token": self.bytes_per_token(),
+        }
 
     # ------------------------------------------------------------ writes
+    def kv_args(self):
+        """The pool arrays as the decode programs take them: plain
+        ``(k, v)`` on a full-precision pool, ``((k, k_scale),
+        (v, v_scale))`` on an int8 pool — each quantized side is ONE
+        pytree argument, so every program signature is unchanged and
+        the quantized variant is simply a different trace (keyed apart
+        in the engine's jit cache)."""
+        p = self.pool
+        if self.quantized:
+            return (p.k, p.k_scale), (p.v, p.v_scale)
+        return p.k, p.v
+
     def write_prefill(self, slot, pk, pv, prompt_len):
         """Install a prefilled prompt's K/V into ``slot`` — through the
         block table, into private pool blocks (one compile-once scatter
         per prefill bucket; the table row and length are runtime
-        arguments)."""
+        arguments). On an int8 pool the full-precision prefill rows
+        quantize on write, scales landing beside the data."""
         if pk.shape[1] > self.max_seq_len:
             raise ValueError(
                 f"prefill length {pk.shape[1]} exceeds max_seq_len "
                 f"{self.max_seq_len}")
         self.ensure_capacity(slot, int(prompt_len))
-        self.pool.k, self.pool.v = _paged_writer(self._donate)(
-            self.pool.k, self.pool.v, pk, pv,
-            jnp.asarray(self.tables[slot]), np.int32(prompt_len))
+        p = self.pool
+        if self.quantized:
+            p.k, p.v, p.k_scale, p.v_scale = \
+                _paged_writer(self._donate, True)(
+                    p.k, p.v, p.k_scale, p.v_scale, pk, pv,
+                    jnp.asarray(self.tables[slot]), np.int32(prompt_len))
+        else:
+            p.k, p.v = _paged_writer(self._donate)(
+                p.k, p.v, pk, pv,
+                jnp.asarray(self.tables[slot]), np.int32(prompt_len))
         self.lengths[slot] = int(prompt_len)
 
     def update(self, new_k, new_v):
-        """Adopt the decode/suffix step's functionally-updated pool."""
-        self.pool.k, self.pool.v = new_k, new_v
+        """Adopt the decode/suffix step's functionally-updated pool —
+        ``(data, scale)`` pairs on a quantized pool (:meth:`kv_args`'
+        inverse), plain arrays otherwise."""
+        p = self.pool
+        if self.quantized:
+            (p.k, p.k_scale), (p.v, p.v_scale) = new_k, new_v
+        else:
+            p.k, p.v = new_k, new_v

@@ -576,6 +576,36 @@ class ServingGateway:
                     "Fraction of the [num_slots, max_blocks] block "
                     "table grid populated by live sequences."
                     ).set_fn(lambda: self.engine.cache.table_fill())
+            # quantized-serving surface (README "Quantized serving"):
+            # pool HBM in BYTES, dtype-aware via
+            # PagedKVCache.occupancy_bytes() — an int8 pool reports
+            # int8 data bytes under kind="kv" plus its fp32 scale
+            # planes under kind="scales" (0 on the default pool), and
+            # the per-cached-token marginal HBM cost the density bench
+            # banks against. Allocated (live + trie) blocks x
+            # per-block bytes.
+            kvb = r.gauge(
+                "kv_pool_bytes",
+                "Allocated KV pool HBM bytes by storage kind (kv = "
+                "block data at the pool dtype, scales = the int8 "
+                "pool's fp32 scale planes; 0 when unquantized).")
+            # each kind scans the block tables once (used_blocks);
+            # per-token is pure constants — a scrape pays two cheap
+            # scans total, never three occupancy_bytes() walks
+            kvb.set_fn(
+                lambda: (self.engine.cache.used_blocks()
+                         * self.engine.cache.pool.block_nbytes),
+                kind="kv")
+            kvb.set_fn(
+                lambda: (self.engine.cache.used_blocks()
+                         * self.engine.cache.pool.scale_block_nbytes),
+                kind="scales")
+            r.gauge("serving_kv_bytes_per_token",
+                    "Marginal HBM bytes one cached token costs (block "
+                    "bytes incl. scale planes / block_size) — the "
+                    "denominator of the quantized-density win."
+                    ).set_fn(
+                lambda: self.engine.cache.bytes_per_token())
         if getattr(self.engine, "prefix_cache", None) is not None:
             # scrape-time counters backed by the cache's own stats plus
             # the gateway's carried base (the driver thread is the only
@@ -1388,6 +1418,31 @@ class ServingGateway:
         t["d2h_bytes_per_decoded_token"] = round(
             t["d2h_bytes"] / max(tokens, 1), 3)
         doc["window_steps"] = window_steps
+        eng = self.engine
+        if getattr(eng, "_paged", False):
+            # KV columns in BYTES, not blocks (README "Quantized
+            # serving"): block counts hide the density story — an int8
+            # pool's block is ~4x smaller — so the profile reports the
+            # dtype-aware byte footprint (live/trie split from
+            # occupancy(), per-block bytes from the pool) alongside
+            # the storage dtype and per-token rate.
+            # ONE occupancy walk: every byte field below derives from
+            # this reading plus the pool's per-block constants
+            occ = eng.cache.occupancy()
+            kv_b = eng.cache.pool.block_nbytes
+            sc_b = eng.cache.pool.scale_block_nbytes
+            per_block = kv_b + sc_b
+            used = occ["live"] + occ["trie"]
+            doc["kv_pool"] = {
+                "kv_dtype": eng.kv_dtype,
+                "live_bytes": occ["live"] * per_block,
+                "trie_bytes": occ["trie"] * per_block,
+                "free_bytes": occ["free"] * per_block,
+                "used_kv_bytes": used * kv_b,
+                "used_scale_bytes": used * sc_b,
+                "capacity_bytes": eng.cache.pool.num_blocks * per_block,
+                "bytes_per_token": eng.cache.bytes_per_token(),
+            }
         return doc
 
     def capture_profile(self, steps=0, timeout_s=30.0) -> dict:

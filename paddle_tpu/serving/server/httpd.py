@@ -505,7 +505,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           headroom_mult=2.0, watchdog_deadline_s=30.0, max_restarts=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
           drafter=None, trace=False, trace_buffer=65536, cost=True,
-          decode_ticks=1):
+          decode_ticks=1, kv_dtype=None, quantize_weights=False):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -587,6 +587,21 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     proportionally (DISPATCH_BENCH.json banks the ladder). Note the
     trade: a streaming client sees tokens in bursts of up to
     ``decode_ticks``.
+
+    ``kv_dtype="int8"`` (unified ragged paged engine only, default
+    None so every banked baseline stays byte-identical) serves from
+    the int8 block-quantized KV pool (README "Quantized serving"):
+    appends quantize on write with per-row-per-head fp32 scale planes
+    riding the same physical blocks, the ragged kernel dequantizes
+    after the table-indirect DMA, and pool HBM drops ~4x vs fp32 —
+    the density win DENSITY_BENCH.json banks. ``/metrics`` grows
+    ``kv_pool_bytes{kind="kv|scales"}`` and
+    ``serving_kv_bytes_per_token``; ``/debug/profile`` reports the
+    pool in bytes. ``quantize_weights=True`` additionally routes the
+    decode-path projection matmuls through int8 weight-only storage
+    (converted once per model — rebuilds and fleet replicas share the
+    converted arrays and the jit cache, so
+    ``decode_compilations()==1`` holds across restarts).
     """
     from ..engine import ContinuousBatchingEngine
 
@@ -603,7 +618,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             paged_attn=paged_attn, prefill_chunk=prefill_chunk,
             ragged_step=ragged_step, headroom_mult=headroom_mult,
             spec_decode=spec_decode, spec_k=spec_k, drafter=drafter,
-            decode_ticks=decode_ticks,
+            decode_ticks=decode_ticks, kv_dtype=kv_dtype,
+            quantize_weights=quantize_weights,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
     gateway = ServingGateway(
@@ -627,7 +643,8 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
                 watchdog_deadline_s=30.0, max_restarts=8,
                 fault_hooks=None, clock=None, spec_decode=False,
                 spec_k=4, drafter=None, trace=False, trace_buffer=65536,
-                cost=True, affinity_band=16, decode_ticks=1):
+                cost=True, affinity_band=16, decode_ticks=1,
+                kv_dtype=None, quantize_weights=False):
     """Build an engine fleet → HTTP server and start listening (README
     "Engine fleet"): ``replicas`` supervised engines — each its own
     paged pool, prefix trie and scheduler, sharing compiled programs
@@ -667,6 +684,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
         prefill_chunk=prefill_chunk, ragged_step=ragged_step,
         headroom_mult=headroom_mult, spec_decode=spec_decode,
         spec_k=spec_k, drafter=drafter, decode_ticks=decode_ticks,
+        kv_dtype=kv_dtype, quantize_weights=quantize_weights,
         registry=registry, clock=clock,
         watchdog_deadline_s=watchdog_deadline_s,
         max_restarts=max_restarts, fault_hooks=fault_hooks,

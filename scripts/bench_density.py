@@ -1,0 +1,241 @@
+"""Quantized serving density benchmark: int8 block-quantized KV at a
+FIXED pool-byte budget (README "Quantized serving").
+
+Question answered: holding the KV pool's HBM budget constant, how many
+MORE concurrent slots does ``kv_dtype="int8"`` serve than the fp32
+baseline — and what does quality actually pay (measured, not assumed)?
+
+Legs (all deterministic — exact byte accounting + token comparison, no
+wall-clock in the gates):
+
+- **capacity**: the baseline engine's pool capacity in bytes (exact,
+  from ``PagedKVCache.occupancy_bytes()`` — dtype-aware: int8 data
+  PLUS its fp32 scale planes) becomes the budget; the int8 leg takes
+  the largest slot count whose pool fits the SAME budget, then
+  actually serves that many requests CONCURRENTLY (peak
+  ``num_active`` is measured, not inferred). Acceptance:
+  ``slot_capacity_ratio >= 1.8``.
+- **quality**: greedy-stream divergence rate of int8 vs the fp32
+  baseline on the mixed shared-prefix trace — fraction of streams
+  that diverge anywhere, plus the mean matched-prefix fraction.
+  Reported as measured; nothing assumes zero.
+- **determinism**: the int8 engine replays byte-identically, and
+  ``decode_compilations() == 1`` on the quantized geometry.
+- **default unchanged**: the default (``kv_dtype`` unset) engine's
+  streams are byte-identical before and after quantized engines ran
+  against the same shared jit cache — the banked baselines cannot
+  have drifted.
+- **weights**: int8 weight-only decode rides along — projection-weight
+  bytes fp vs int8 and stream determinism.
+
+Usage:
+  python scripts/bench_density.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_decode import _models  # noqa: E402  (same model as the other legs)
+
+BLOCK_SIZE = 16
+
+
+def _trace(n_req, quick=True):
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(17)
+    sys_prompts = [rng.randint(0, 2048, (32,)).astype(np.int32)
+                   for _ in range(2)]
+    max_new = 8 if quick else 16
+    reqs = []
+    for i in range(n_req):
+        tail = rng.randint(0, 2048, (12,)).astype(np.int32)
+        reqs.append(GenerationRequest(
+            prompt=np.concatenate([sys_prompts[i % 2], tail]),
+            max_new_tokens=max_new))
+    return reqs
+
+
+def _clone(r):
+    from paddle_tpu.serving import GenerationRequest
+    return GenerationRequest(prompt=r.prompt,
+                             max_new_tokens=r.max_new_tokens)
+
+
+def _engine(model, num_slots, s_max, **kw):
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_seq_len=s_max, decode_chunk=1,
+        prefix_block_size=BLOCK_SIZE, prefill_chunk=None,
+        jit_cache=model.__dict__.setdefault("_serving_jit", {}), **kw)
+
+
+def _pool_capacity_bytes(eng):
+    ob = eng.cache.occupancy_bytes()
+    return ob["capacity_kv"] + ob["capacity_scales"]
+
+
+def _probe_capacity_bytes(model, num_slots, s_max, kv_dtype):
+    """Pool capacity for a candidate slot count WITHOUT building the
+    full serving stack: a bare PagedKVCache runs the same exact
+    dtype-aware accounting the engine's pool would (occupancy_bytes),
+    so the slot-count search never constructs and discards whole
+    engines."""
+    from paddle_tpu.serving.kv_cache import PagedKVCache
+    c = model.config
+    cache = PagedKVCache(
+        c.num_hidden_layers, num_slots, s_max, c.num_key_value_heads,
+        c.head_dim, dtype=model.embed_tokens.value.dtype,
+        block_size=BLOCK_SIZE, kv_dtype=kv_dtype)
+    ob = cache.occupancy_bytes()
+    return ob["capacity_kv"] + ob["capacity_scales"]
+
+
+def _run_concurrent(eng, reqs):
+    """Generate with peak-concurrency tracking: the capacity leg's
+    'slots measured' is the max simultaneously active slots, not a
+    derived number. Requests are cloned per engine — the same trace
+    object is reused across five engine runs and must stay pristine."""
+    seqs = [eng.submit(_clone(r)) for r in reqs]
+    peak = 0
+    while eng.has_work():
+        eng.step()
+        peak = max(peak, eng.num_active)
+    return [list(s.output_ids()) for s in seqs], peak
+
+
+def _divergence(base, quant):
+    diverged = sum(1 for a, b in zip(base, quant) if a != b)
+    fracs = []
+    for a, b in zip(base, quant):
+        m = 0
+        for t, u in zip(a, b):
+            if t != u:
+                break
+            m += 1
+        fracs.append(m / max(len(a), 1))
+    return {"streams": len(base), "diverged_streams": diverged,
+            "divergence_rate": diverged / max(len(base), 1),
+            "matched_prefix_fraction": sum(fracs) / max(len(fracs), 1)}
+
+
+def measure_density(quick=True, base_slots=4):
+    s_max = 128 if quick else 256
+    model = _models(quick)["jnp"]
+
+    # ---------------------------------------------------- capacity A/B
+    base = _engine(model, base_slots, s_max)
+    budget = _pool_capacity_bytes(base)
+    per_slot_base = budget // base_slots
+    # largest int8 slot count whose pool fits the SAME byte budget —
+    # probe the exact dtype-aware accounting, never a derived formula
+    q_slots = base_slots
+    while _probe_capacity_bytes(model, q_slots + 1, s_max,
+                                "int8") <= budget:
+        q_slots += 1
+    quant = _engine(model, q_slots, s_max, kv_dtype="int8")
+    q_bytes = _pool_capacity_bytes(quant)
+    assert q_bytes <= budget
+
+    # default-path pin, first reading: streams before quantized engines
+    # share the jit cache
+    reqs_small = _trace(2 * base_slots, quick)
+    default_before, _ = _run_concurrent(_engine(model, base_slots, s_max),
+                                        _trace(2 * base_slots, quick))
+
+    # the int8 engine SERVES its claimed capacity: one request per slot,
+    # peak concurrency measured
+    outs_q, peak_q = _run_concurrent(quant, _trace(q_slots, quick))
+    base_outs, peak_b = _run_concurrent(base, _trace(base_slots, quick))
+
+    # ------------------------------------------------- quality (greedy)
+    b_streams, _ = _run_concurrent(_engine(model, base_slots, s_max),
+                                   reqs_small)
+    q_streams, _ = _run_concurrent(
+        _engine(model, base_slots, s_max, kv_dtype="int8"), reqs_small)
+    q_streams2, _ = _run_concurrent(
+        _engine(model, base_slots, s_max, kv_dtype="int8"), reqs_small)
+    div = _divergence(b_streams, q_streams)
+
+    # ------------------------------------------------------ weight leg
+    w_eng = _engine(model, base_slots, s_max, quantize_weights=True)
+    w_streams, _ = _run_concurrent(w_eng, reqs_small)
+    w_streams2, _ = _run_concurrent(
+        _engine(model, base_slots, s_max, quantize_weights=True),
+        reqs_small)
+    from paddle_tpu.serving.decode import _WEIGHT_QUANT_KEYS, \
+        llama_decode_params
+    raw, _tied = llama_decode_params(model)
+    fp_w_bytes = sum(raw[k].size * raw[k].dtype.itemsize
+                     for k in _WEIGHT_QUANT_KEYS + ("lm_head",))
+    q_w_bytes = sum(q.size * q.dtype.itemsize + s.size * s.dtype.itemsize
+                    for q, s in (w_eng._params[k]
+                                 for k in _WEIGHT_QUANT_KEYS
+                                 + ("lm_head",)))
+
+    # default-path pin, second reading: quantized siblings in the same
+    # jit cache must not have perturbed the default engine's streams
+    default_after, _ = _run_concurrent(_engine(model, base_slots, s_max),
+                                       _trace(2 * base_slots, quick))
+
+    ob_b = base.cache.occupancy_bytes()
+    ob_q = quant.cache.occupancy_bytes()
+    ratio = q_slots / base_slots
+    res = {
+        "pool_budget_bytes": int(budget),
+        "baseline_slots": base_slots,
+        "baseline_pool_bytes": int(budget),
+        "baseline_bytes_per_slot": int(per_slot_base),
+        "baseline_bytes_per_token": ob_b["per_token"],
+        "int8_slots": q_slots,
+        "int8_pool_bytes": int(q_bytes),
+        "int8_bytes_per_token": ob_q["per_token"],
+        "int8_scale_plane_bytes": int(ob_q["capacity_scales"]),
+        "slot_capacity_ratio": ratio,
+        "peak_concurrent_slots_int8": peak_q,
+        "peak_concurrent_slots_base": peak_b,
+        "served_full_capacity": peak_q == q_slots,
+        "greedy_divergence": div,
+        "int8_deterministic": q_streams == q_streams2,
+        "weights_deterministic": w_streams == w_streams2,
+        "weight_bytes_fp": int(fp_w_bytes),
+        "weight_bytes_int8": int(q_w_bytes),
+        "weight_bytes_ratio": fp_w_bytes / q_w_bytes,
+        "decode_compilations_int8": quant.decode_compilations(),
+        "decode_compilations_w8": w_eng.decode_compilations(),
+        "default_streams_unchanged": default_before == default_after,
+        "block_size": BLOCK_SIZE,
+        "trace": f"{2 * base_slots} reqs round-robin over 2 shared "
+                 f"32-token system prompts + unique 12-token tails",
+        "accepted": bool(
+            ratio >= 1.8 and peak_q == q_slots
+            and q_streams == q_streams2
+            and quant.decode_compilations() == 1
+            and default_before == default_after),
+    }
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "density": measure_density(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0 if res["density"]["accepted"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

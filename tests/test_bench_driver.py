@@ -168,6 +168,16 @@ class TestBenchDriverFlow:
                      "multitick_dispatch_reduction": 3.0,
                      "exact_vs_program_accessors": True,
                      "accepted": True}), ""
+            if leg == "--density":
+                # quantized-density leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps(
+                    {"name": "density", "ok": True,
+                     "slot_capacity_ratio": 3.5,
+                     "greedy_divergence": {"divergence_rate": 0.0},
+                     "int8_deterministic": True,
+                     "default_streams_unchanged": True,
+                     "accepted": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -202,11 +212,11 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:10] == ["--decode-cb", "--serve-http",
+        assert order[:11] == ["--decode-cb", "--serve-http",
                               "--prefix-cache", "--paged-attn",
                               "--chunked-prefill", "--ragged", "--spec",
                               "--chaos", "--trace-overhead",
-                              "--dispatch"]
+                              "--dispatch", "--density"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -229,6 +239,10 @@ class TestBenchDriverFlow:
         assert art["dispatch"]["multitick_dispatch_reduction"] == 3.0
         assert art["dispatch"][
             "dispatches_per_decoded_token_by_ticks"]["8"] == 0.11
+        assert art["density"]["accepted"] is True
+        assert art["density"]["slot_capacity_ratio"] == 3.5
+        assert art["density"][
+            "greedy_divergence"]["divergence_rate"] == 0.0
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

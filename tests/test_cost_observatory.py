@@ -593,6 +593,39 @@ class TestGuardDiscipline:
         assert "_wrap_prog" in src.split("def _mtick_fn(")[1].split(
             "\n    def ")[0]
 
+    def test_sweep_sees_the_quantized_kv_paths(self):
+        """ISSUE 14 satellite: the int8-KV append/dequant call sites
+        live inside the swept tree and stay guard-disciplined. Every
+        quantized append routes through ONE helper (``_kv_write`` —
+        quantize-on-write cannot fork per site), the packed forward's
+        attention unpacks scales through ``_kv_attn_args`` (the one
+        dequant handoff), and the engine hands pool arguments out
+        through ``kv_args()`` at the SAME ``_wrap_prog``-counted
+        launch sites as before — so quantized dispatches are exactly
+        attributed and no new raw tracer/cost touch appeared."""
+        dec = (SERVING_DIR / "decode.py").read_text()
+        for fn_name in ("_packed_span_forward", "_fused_decode_tick",
+                        "_paged_suffix_prefill_impl"):
+            body = dec.split(f"def {fn_name}(")[1].split("\ndef ")[0]
+            assert "_kv_write(" in body, fn_name
+            assert "_kv_attn_args(" in body or "_kv_gather_rows(" \
+                in body, fn_name
+            # no stray raw pool scatter survived the refactor: appends
+            # that bypass _kv_write would silently skip quantization
+            assert ".at[phys" not in body, fn_name
+        eng = (SERVING_DIR / "engine.py").read_text()
+        for step in ("_unified_step", "_multitick_step", "_spec_step"):
+            body = eng.split(f"def {step}(")[1].split("\n    def ")[0]
+            assert "kv_args()" in body, step
+            assert "self.tracer." not in body \
+                and "self.cost." not in body, step
+        # the quantized program variants ride the same counted handout
+        for fn_name in ("_ragged_fn", "_mtick_fn", "_spec_fn",
+                        "_suffix_fn", "_prefill_fn"):
+            body = eng.split(f"def {fn_name}(")[1].split("\n    def ")[0]
+            assert "_wrap_prog" in body, fn_name
+            assert "_kvtag" in body or "_wtag" in body, fn_name
+
     def test_sweep_covers_the_fleet_package(self):
         """ISSUE 12 satellite: the rglob sweep must keep covering
         ``serving/fleet/`` — the fleet's router-decision/failover/

@@ -182,6 +182,95 @@ class TestQuantization:
             out_ac.value.astype(jnp.float32), ref, atol=0.1)
 
 
+class TestWeightOnlyInt8:
+    """ISSUE 14 satellite: ConvertedLinear's scales are PER-CHANNEL and
+    hoisted to convert time, and the weight-only conversion surface is
+    idempotent."""
+
+    def _model(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                paddle.seed(7)
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        return M()
+
+    def test_per_channel_scales_hoisted_to_convert_time(self):
+        from paddle_tpu.quantization import (ConvertedLinear,
+                                             convert_weights_int8,
+                                             quantize_weight_int8)
+        m = convert_weights_int8(self._model())
+        assert isinstance(m.fc1, ConvertedLinear)
+        # one scale PER OUTPUT CHANNEL ([1, out] for the [in, out]
+        # layout), computed once at convert time — not per call
+        assert m.fc1.w_scale.shape == [1, 16]
+        assert m.fc1.qweight.numpy().dtype == np.int8
+        # the shared helper is the single quantization rule
+        w = paddle.to_tensor(
+            np.random.RandomState(9).randn(8, 16).astype(np.float32))
+        q, s = quantize_weight_int8(w.value, reduce_axis=0)
+        deq = np.asarray(q, np.float32) * np.asarray(s)
+        assert np.all(np.abs(deq - w.numpy()) <= np.asarray(s) / 2 + 1e-7)
+
+    def test_per_channel_beats_per_tensor_on_outlier_channel(self):
+        from paddle_tpu.quantization import quantize_weight_int8
+        rng = np.random.RandomState(11)
+        w = rng.randn(8, 16).astype(np.float32)
+        w[:, 3] *= 100.0                     # one outlier channel
+        q, s = quantize_weight_int8(w, reduce_axis=0)
+        deq = np.asarray(q, np.float32) * np.asarray(s)
+        # per-tensor absmax would flatten every other channel's
+        # resolution to ~absmax/127 ≈ 2.4; per-channel keeps them sharp
+        err = np.abs(deq - w)[:, [c for c in range(16) if c != 3]]
+        assert err.max() < 0.05
+
+    def test_convert_weights_int8_idempotent(self):
+        from paddle_tpu.quantization import (ConvertedLinear,
+                                             convert_weights_int8)
+        m = convert_weights_int8(self._model())
+        fc1, q1 = m.fc1, m.fc1.qweight
+        m2 = convert_weights_int8(m)        # quantize(quantize(m))
+        # a no-op: same layer objects, same int8 arrays — the second
+        # pass must never re-quantize an int8 weight (which would
+        # double the quantization error)
+        assert m2.fc1 is fc1 and m2.fc1.qweight is q1
+        x = paddle.to_tensor(
+            np.random.RandomState(12).randn(4, 8).astype(np.float32))
+        np.testing.assert_array_equal(m(x).numpy(), m2(x).numpy())
+        assert isinstance(m2.fc2, ConvertedLinear)
+
+    def test_ptq_convert_idempotent_and_bias_dtype_under_autocast(self):
+        import jax.numpy as jnp
+        from paddle_tpu.quantization import ConvertedLinear
+        m = self._model()
+        ref = None
+        x = paddle.to_tensor(
+            np.random.RandomState(13).randn(4, 8).astype(np.float32))
+        ptq = PTQ(QuantConfig(weight_bits=8, activation_bits=8))
+        om = ptq.quantize(m)
+        om(x)
+        cm = ptq.convert(om)
+        ref = cm(x).numpy()
+        cm2 = ptq.convert(cm)               # convert(convert(m)): no-op
+        assert cm2.fc1 is cm.fc1
+        np.testing.assert_array_equal(cm2(x).numpy(), ref)
+        # bias dtype follows the activation dtype under bf16 autocast
+        # (an fp32 bias would silently re-promote the whole matmul)
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = cm(x)
+        assert out.dtype == jnp.bfloat16
+        assert isinstance(cm.fc1, ConvertedLinear)
+        np.testing.assert_allclose(out.value.astype(jnp.float32), ref,
+                                   atol=0.15)
+
+
 class TestTensorToSparseR5:
     """Tensor.to_sparse_coo / to_sparse_csr method spellings vs scipy."""
 
