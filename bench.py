@@ -537,6 +537,16 @@ def watchdog():
     dn = _parse_result(rc, out)
     cb_extra["density"] = dn if dn is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Tensor-parallel leg: TP=2 stream equality vs single-chip + fp/int8
+    # collective-byte ratio on the virtual CPU mesh
+    # (scripts/bench_tp.py; the child forces its own device count via
+    # XLA_FLAGS before importing jax). Same hang-proof contract:
+    # CPU-forced, exact counters, banked before the tunnel can wedge.
+    rc, out, err = _run([me, "--tp"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    tpj = _parse_result(rc, out)
+    cb_extra["tp"] = tpj if tpj is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -733,6 +743,13 @@ if __name__ == "__main__":
         from bench_density import measure_density
         print(json.dumps({"name": "density", "ok": True,
                           **measure_density(quick=True)}))
+        sys.exit(0)
+    if "--tp" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_tp import measure_tp
+        print(json.dumps({"name": "tp", "ok": True,
+                          **measure_tp(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -55,7 +55,16 @@ PROGRAM_KINDS = ("prefill", "suffix", "psuffix", "decode", "pdecode",
 
 def _nbytes(leaf) -> int:
     """Abstract byte size of one pytree leaf — shape × itemsize, no
-    device sync (works on jax Arrays, numpy arrays and scalars)."""
+    device sync (works on jax Arrays, numpy arrays and scalars).
+
+    Always the LOGICAL (global-shape) size: on a tensor-parallel
+    engine a replicated host argument is physically broadcast to every
+    mesh device and a sharded result leaf is materialized once per
+    shard, but the boundary cost attributed here is the one logical
+    copy — per-shard leaves must not be double-counted across the mesh
+    (the cross-chip traffic TP adds is accounted SEPARATELY, as
+    ``serving_collective_bytes_total{dtype}`` via
+    :meth:`CostObservatory.record_collective`)."""
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
@@ -107,6 +116,12 @@ class CostObservatory:
         self._phase = None
         self.totals = {"dispatches": 0, "h2d_bytes": 0, "d2h_bytes": 0,
                        "compiles": 0, "wall_s": 0.0}
+        # cross-chip collective traffic by wire dtype (tensor-parallel
+        # engines; README "Tensor-parallel serving") — deliberately a
+        # SEPARATE ledger from h2d/d2h: all-reduce bytes never cross
+        # the host boundary, and folding them into transfer totals
+        # would corrupt the banked dispatch-bench baselines
+        self.collectives = {}
 
     # ------------------------------------------------------------- control
     def enable(self):
@@ -166,6 +181,27 @@ class CostObservatory:
         ph["d2h_bytes"] += d2h
         ph["wall_s"] += dt
 
+    def record_collective(self, dtype, ops, nbytes):
+        """Account one sharded launch's cross-chip all-reduce traffic:
+        ``ops`` collective operations moving ``nbytes`` wire bytes per
+        device, under wire-dtype label ``dtype`` (``fp`` | ``int8``).
+        Shape-derived by the caller (the engine's
+        ``_record_collectives``) — exact and deterministic, no network
+        probe. The ``serving_collective_bytes_total{dtype}`` counter
+        and the ``/debug/profile`` collectives section read this."""
+        rec = self.collectives.get(dtype)
+        if rec is None:
+            rec = {"ops": 0, "bytes": 0}
+            self.collectives[dtype] = rec
+        rec["ops"] += int(ops)
+        rec["bytes"] += int(nbytes)
+
+    def collective_bytes(self, dtype) -> int:
+        """Total wire bytes recorded under one collective dtype (0 for
+        a dtype that never ran — tp=1 engines scrape explicit zeros)."""
+        rec = self.collectives.get(dtype)
+        return int(rec["bytes"]) if rec else 0
+
     # -------------------------------------------------------------- reading
     def kind_calls(self, kind) -> int:
         """Total dispatches of one program kind (the
@@ -197,7 +233,10 @@ class CostObservatory:
                              for k, v in list(self.programs.items())},
                 "phases": {k: dict(v)
                            for k, v in list(self.phases.items())},
-                "totals": dict(self.totals)}
+                "totals": dict(self.totals),
+                "collectives": {k: dict(v)
+                                for k, v in list(
+                                    self.collectives.items())}}
 
     def export(self, base=None, at=None) -> dict:
         """The cost-attribution document: aggregate, the delta since
@@ -247,7 +286,17 @@ class CostObservatory:
                   for k in ("dispatches", "h2d_bytes", "d2h_bytes",
                             "compiles")}
         totals["wall_s"] = round(wall_total, 9)
-        return {"programs": programs, "phases": phases, "totals": totals}
+        base_c = (base or {}).get("collectives", {})
+        collectives = {}
+        for dtype, rec in state.get("collectives", {}).items():
+            b = base_c.get(dtype, {})
+            d_ops = rec["ops"] - b.get("ops", 0)
+            d_bytes = rec["bytes"] - b.get("bytes", 0)
+            if d_ops <= 0 and d_bytes <= 0:
+                continue
+            collectives[dtype] = {"ops": d_ops, "bytes": d_bytes}
+        return {"programs": programs, "phases": phases, "totals": totals,
+                "collectives": collectives}
 
 
 class _CountedProgram:

@@ -20,7 +20,9 @@ from ..ops._op import tensor_op
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quanted_linear",
            "fake_quant", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
-           "quantize_weight_int8", "convert_weights_int8"]
+           "quantize_weight_int8", "convert_weights_int8",
+           "quantize_collective_int8", "quantized_psum_int8",
+           "collective_wire_bytes"]
 
 
 def quantize_weight_int8(w, reduce_axis, bits=8):
@@ -55,6 +57,83 @@ def quantize_weight_int8(w, reduce_axis, bits=8):
                            / jnp.maximum(scale, 1e-30)),
                  -qmax, qmax).astype(jnp.int8)
     return q, scale
+
+
+# ------------------------------------------- quantized all-reduce (EQuARX)
+def quantize_collective_int8(x):
+    """Symmetric per-row int8 quantization of a collective payload —
+    THE wire format of the serving stack's ``collective_dtype="int8"``
+    tensor-parallel all-reduce (README "Tensor-parallel serving",
+    EQuARX / PAPERS.md). Each row (absmax over the LAST axis) gets its
+    own fp32 scale, so one outlier activation cannot flatten a whole
+    chunk's resolution; all-zero rows carry scale 0 and dequantize to
+    exact zeros. Returns ``(q int8, scale f32 [..., 1])``."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
+    q = jnp.clip(jnp.round(xf / jnp.maximum(scale, 1e-30)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantized_psum_int8(x, axis_name, tp):
+    """EQuARX-style block-quantized all-reduce over mesh axis
+    ``axis_name`` (size ``tp``): both communication phases move int8
+    payloads plus per-row fp32 scales instead of full-precision
+    activations, cutting wire bytes ~``itemsize / (1 + tp·4/H)``-fold
+    (~3.5–3.9x for fp32 at serving hidden sizes).
+
+    Phase 1 (reduce-scatter): split the partial sum into ``tp`` chunks
+    along the last axis, quantize, ``all_to_all`` so shard ``i``
+    receives every shard's quantized chunk ``i``, dequantize and sum
+    in fp32 — a FIXED summation order, so the result (and therefore
+    the token stream) is deterministic and identical on every shard.
+    Phase 2 (all-gather): requantize the reduced chunk, ``all_gather``,
+    dequantize and reassemble. The double quantization is the quality
+    price the serving bench MEASURES (greedy divergence in
+    TP_BENCH.json) rather than assumes away.
+
+    Requires the last axis divisible by ``tp`` (the engine validates
+    ``hidden_size % tp == 0`` at build). Shapes/dtype are preserved."""
+    shp = x.shape
+    hidden = shp[-1]
+    chunk = hidden // tp
+    xc = jnp.moveaxis(x.reshape(shp[:-1] + (tp, chunk)), -2, 0)
+    q, s = quantize_collective_int8(xc)            # [tp, ..., chunk]
+    q2 = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s2 = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    red = jnp.sum(q2.astype(jnp.float32) * s2, axis=0)   # [..., chunk]
+    qr, sr = quantize_collective_int8(red)
+    qg = jax.lax.all_gather(qr, axis_name, axis=0)       # [tp, ..., chunk]
+    sg = jax.lax.all_gather(sr, axis_name, axis=0)
+    full = jnp.moveaxis(qg.astype(jnp.float32) * sg, 0, -2)
+    return full.reshape(shp).astype(x.dtype)
+
+
+def collective_wire_bytes(rows, hidden, tp, collective_dtype,
+                          fp_itemsize=4):
+    """EXACT per-device wire bytes of ONE per-layer all-reduce of a
+    ``[rows, hidden]`` activation on a ``tp``-way mesh — the counter
+    model behind ``serving_collective_bytes_total{dtype}`` (README
+    "Tensor-parallel serving") and the bench's >=3x acceptance gate.
+
+    Both dtypes are priced on the same ring reduce-scatter +
+    all-gather schedule (each phase moves ``(tp-1)/tp`` of the payload
+    per device), so the fp-vs-int8 ratio isolates the WIRE FORMAT:
+
+    - ``"fp"``: payload = ``rows · hidden · fp_itemsize``;
+    - ``"int8"``: payload = ``rows · hidden`` int8 bytes plus one fp32
+      scale per (row, chunk) — ``rows · tp`` scales per phase — the
+      exact layout :func:`quantized_psum_int8` moves.
+
+    Deterministic, shape-derived, no measurement noise. Returns 0 for
+    ``tp <= 1`` (no mesh, no wire)."""
+    if tp <= 1:
+        return 0
+    if collective_dtype == "int8":
+        payload = rows * hidden + rows * tp * 4
+    else:
+        payload = rows * hidden * fp_itemsize
+    return int(2 * payload * (tp - 1) // tp)
 
 
 # ------------------------------------------------------------- fake quant

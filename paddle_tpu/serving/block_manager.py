@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import heapq
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -54,7 +55,7 @@ class BlockManager:
     full-precision."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
-                 head_dim, dtype=jnp.float32, kv_dtype=None):
+                 head_dim, dtype=jnp.float32, kv_dtype=None, mesh=None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
@@ -77,6 +78,34 @@ class BlockManager:
             self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
         else:
             self.k_scale = self.v_scale = None
+        # tensor-parallel pool partition (README "Tensor-parallel
+        # serving"): commit the arrays head-sharded over the ("tp",)
+        # mesh — each shard owns Hkv/tp heads of EVERY physical block,
+        # scale planes on the same axis, so ALL the host bookkeeping
+        # below (heap, refcounts, tables) stays replicated-by-identity
+        # and every lifecycle move carries the shards for free.
+        self.tp = 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from .decode import _pool_pspec
+            self.tp = mesh.devices.size
+            if num_kv_heads % self.tp:
+                raise ValueError(
+                    f"pool of {num_kv_heads} KV heads cannot partition "
+                    f"over a {self.tp}-device mesh")
+            # THE pool spec (serving/decode._pool_pspec), not a local
+            # re-spelling: a spelling difference here would read as a
+            # fresh sharding to the pjit cache every step
+            if self.quantized:
+                data_spec, scale_spec = _pool_pspec(True)
+                scale_s = NamedSharding(mesh, scale_spec)
+                self.k_scale = jax.device_put(self.k_scale, scale_s)
+                self.v_scale = jax.device_put(self.v_scale, scale_s)
+            else:
+                data_spec = _pool_pspec(False)
+            data_s = NamedSharding(mesh, data_spec)
+            self.k = jax.device_put(self.k, data_s)
+            self.v = jax.device_put(self.v, data_s)
         self._free_heap = list(range(self.num_blocks))
         self._free_set = set(self._free_heap)
         self._ref = np.zeros(self.num_blocks, np.int32)

@@ -35,6 +35,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..kernels.flash_attention import attention as _attention
 from ..kernels.pallas_decode import (decode_attention_pallas,
@@ -172,6 +174,145 @@ def _kv_gather_rows(pool_l, tables, shape4):
     return jnp.take(pool_l, tables, axis=0, mode="clip").reshape(shape4)
 
 
+# --------------------------------------------- tensor parallel (TP) plumbing
+# Multi-chip tensor-parallel serving (README "Tensor-parallel serving"):
+# the engine's ``tp=N`` knob wraps the serving programs in ``shard_map``
+# over a 1-D ``("tp",)`` mesh sharded OVER HEADS — wq/wk/wv (and the MLP
+# gate/up) column-sharded so each shard computes ``nh/tp`` query heads
+# and ``nkv/tp`` KV heads, wo/w_down row-sharded so their matmuls yield
+# partial sums, and the paged KV pool partitioned on its head axis (each
+# shard owns ``Hkv/tp`` heads of EVERY physical block — int8 scale
+# planes partition on the same axis, so the host-side block tables /
+# BlockManager / trie bookkeeping stay replicated and untouched).
+# Exactly ONE all-reduce site pair per layer — post o-proj and post
+# down-proj (``tp_reduce``) — is the only cross-chip traffic;
+# ``collective_dtype="int8"`` runs it EQuARX-style block-quantized
+# (``quantization.quantized_psum_int8``), cutting wire bytes ~3.5x.
+# Attention (the ragged paged kernel or its jnp oracle) runs fully
+# local: GQA group ratio nh/nkv is preserved per shard, the span/table
+# metadata is replicated, and K/V appends land in the shard's own head
+# slice — so donate/truncate/preempt/restore/trie-hit carry shards for
+# free. Everything after the final all-reduce (final norm, lm head,
+# sampling, the PRNG walk) is replicated math: every shard computes the
+# same tokens, which is what lets the host read any one shard's copy.
+TP_AXIS = "tp"
+
+_COL_KEYS = ("wq", "wk", "wv", "w_gate", "w_up")   # shard output features
+_ROW_KEYS = ("wo", "w_down")                       # shard input features
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_mesh(tp):
+    """The serving TP mesh: the first ``tp`` visible devices on one
+    ``("tp",)`` axis (CPU-mesh development uses
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the test
+    suite's conftest forces 8 virtual devices)."""
+    devs = jax.devices()
+    if tp > len(devs):
+        raise ValueError(
+            f"tp={tp} exceeds the {len(devs)} visible device(s); on CPU "
+            f"set XLA_FLAGS=--xla_force_host_platform_device_count={tp}")
+    return Mesh(np.array(devs[:tp]), (TP_AXIS,))
+
+
+def _tp_validate(nh, nkv, tp):
+    if nh % tp or nkv % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_attention_heads ({nh}) and "
+            f"num_key_value_heads ({nkv}): the mesh shards over heads")
+
+
+def _tp_allreduce(collective_dtype, tp):
+    """The per-layer cross-shard reduction — ``tp_reduce`` in the layer
+    bodies. ``"fp"`` is a plain ``psum``; ``"int8"`` is the EQuARX-style
+    block-quantized all-reduce (README "Tensor-parallel serving":
+    measured greedy divergence, not assumed zero)."""
+    if collective_dtype == "int8":
+        from ..quantization import quantized_psum_int8
+        return functools.partial(quantized_psum_int8, axis_name=TP_AXIS,
+                                 tp=tp)
+    return functools.partial(jax.lax.psum, axis_name=TP_AXIS)
+
+
+def _params_pspec(wq8):
+    """PartitionSpec pytree matching the decode param dict:
+    column-sharded QKV/gate/up, row-sharded o/down, everything else
+    (embedding, norms, lm head) replicated. ``wq8`` mirrors the
+    int8 weight-only pytree — each quantized leaf is a ``(q, scale)``
+    pair whose scale keeps the contraction axis as size 1, so a
+    column-sharded weight's per-output-channel scales shard with it
+    while a row-sharded weight's scales stay replicated."""
+    # NOTE: trailing-None-free specs throughout this module — jax
+    # normalizes PartitionSpec(..., "tp", None) to (..., "tp") on
+    # program OUTPUTS, and a pool array fed back next step under the
+    # un-normalized spelling would read as a different sharding to the
+    # pjit cache (one spurious re-specialization per program, breaking
+    # the compile-once pin).
+    col = PartitionSpec(None, None, TP_AXIS)
+    row = PartitionSpec(None, TP_AXIS)
+    rep = PartitionSpec()
+    spec = dict(embed=rep, input_ln=rep, post_ln=rep, final_norm=rep,
+                lm_head=rep)
+    for k in _COL_KEYS:
+        spec[k] = col
+    for k in _ROW_KEYS:
+        spec[k] = row
+    if wq8:
+        for k in _COL_KEYS:
+            spec[k] = (col, col)       # scale [L, 1, out] shards with q
+        for k in _ROW_KEYS:
+            spec[k] = (row, rep)       # scale [L, 1, H] is replicated
+        spec["lm_head"] = (rep, rep)
+    return spec
+
+
+def _pool_pspec(kv_quant):
+    """PartitionSpec for one pool side: blocks replicated, HEADS
+    sharded (axis 3 of ``[L, nb, bs, Hkv, D]``); an int8 pool's scale
+    plane ``[L, nb, bs, Hkv]`` partitions on the same head axis."""
+    data = PartitionSpec(None, None, None, TP_AXIS)
+    if kv_quant:
+        return (data, PartitionSpec(None, None, None, TP_AXIS))
+    return data
+
+
+def _prefill_kv_pspec():
+    """Spec of the cold prefill's returned K/V ``[L, G, S, Hkv, D]`` —
+    always full-precision (quantize-on-write happens in the pool
+    scatter, not here), heads sharded on axis 3."""
+    return PartitionSpec(None, None, None, TP_AXIS)
+
+
+def _tp_shard(impl, tp, in_specs, out_specs):
+    """shard_map over the serving TP mesh. ``check_vma=False``: the
+    replicated outputs (tokens, keys) are replicated by construction —
+    every shard runs the same post-all-reduce math — and the sampling
+    primitives defeat the automatic replication checker."""
+    return jax.shard_map(impl, mesh=_tp_mesh(tp), in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def place_tp_params(params, tp, wq8):
+    """Commit the decode param pytree onto the TP mesh per
+    :func:`_params_pspec` — done ONCE per (model, tp, wq8) by the
+    engine (cached model-resident, so rebuilds and fleet replicas share
+    the placed arrays and the jit cache never re-uploads)."""
+    mesh = _tp_mesh(tp)
+    spec = _params_pspec(wq8)
+
+    def _put(leaf, s):
+        return jax.device_put(leaf, NamedSharding(mesh, s))
+
+    out = {}
+    for k, v in params.items():
+        s = spec[k]
+        if isinstance(v, tuple):
+            out[k] = tuple(_put(leaf, ls) for leaf, ls in zip(v, s))
+        else:
+            out[k] = _put(v, s)
+    return out
+
+
 def _apply_rope_rows(x, sin_p, cos_p):
     """Rope with a DIFFERENT position per batch row (ragged decode).
 
@@ -210,7 +351,7 @@ def sample_rows(logits, keys, temps, top_ks):
 
 # ------------------------------------------------------------------ prefill
 def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
-                  hd, eps, theta, tied):
+                  hd, eps, theta, tied, tp_reduce=None):
     """Batched prefill: ids [G, S_pad] (right-padded prompts), lengths
     [G] real token counts, per-row keys/temps/top_ks.
 
@@ -234,8 +375,10 @@ def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
         attn = _attention(q, k, v, causal=True)
-        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(B, S, nh * hd), lwo)
-        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        o = jnp.einsum("bsd,dh->bsh", attn.reshape(B, S, nh * hd), lwo)
+        h = h + (o if tp_reduce is None else tp_reduce(o))
+        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (k, v)
 
     x = jnp.take(params["embed"], ids, axis=0)
@@ -249,9 +392,27 @@ def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
     return pk, pv, tok0, both[:, 0]
 
 
-def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied):
+def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied, tp=1,
+                     collective_dtype="fp", wq8=False):
     """One jitted prefill; jax retraces per (group, prompt-bucket)
-    shape — both padded to powers of two by the engine."""
+    shape — both padded to powers of two by the engine. ``tp > 1``
+    wraps it in shard_map over the heads-sharded mesh (README
+    "Tensor-parallel serving"): the returned K/V carries each shard's
+    ``Hkv/tp`` heads, partitioned exactly like the pool it is about to
+    be scattered into."""
+    if int(tp) > 1:
+        tp = int(tp)
+        _tp_validate(nh, nkv, tp)
+        impl = functools.partial(
+            _prefill_impl, nh=nh // tp, nkv=nkv // tp, hd=hd, eps=eps,
+            theta=theta, tied=tied,
+            tp_reduce=_tp_allreduce(collective_dtype, tp))
+        rep = PartitionSpec()
+        return jax.jit(_tp_shard(
+            impl, tp,
+            in_specs=(_params_pspec(wq8),) + (rep,) * 5,
+            out_specs=(_prefill_kv_pspec(), _prefill_kv_pspec(),
+                       rep, rep)))
     return jax.jit(functools.partial(
         _prefill_impl, nh=nh, nkv=nkv, hd=hd, eps=eps, theta=theta,
         tied=tied))
@@ -373,7 +534,8 @@ def build_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied, donate=None):
 # ----------------------------------------------------- paged suffix prefill
 def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
                                ids, suffix_lens, keys, temps, top_ks, *,
-                               nh, nkv, hd, eps, theta, tied):
+                               nh, nkv, hd, eps, theta, tied,
+                               tp_reduce=None):
     """Suffix prefill through per-row block tables: the paged twin of
     ``_suffix_prefill_impl``, reading/writing the BlockManager pool
     instead of per-slot dense caches.
@@ -463,8 +625,10 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
         probs = jnp.where(mask[:, None], probs, 0.0)
         vf = jnp.where(row_valid[:, :, None, None], vf, 0.0)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vf)
-        h = h + jnp.einsum("bsd,dh->bsh", attn.reshape(G, S, nh * hd), lwo)
-        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        o = jnp.einsum("bsd,dh->bsh", attn.reshape(G, S, nh * hd), lwo)
+        h = h + (o if tp_reduce is None else tp_reduce(o))
+        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
     x = jnp.take(params["embed"], ids, axis=0)
@@ -479,12 +643,30 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
 
 
 def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
-                                  donate=None):
+                                  donate=None, tp=1,
+                                  collective_dtype="fp", kv_quant=False,
+                                  wq8=False):
     """One jitted paged suffix prefill — doubling as THE chunked-prefill
     program (see ``_paged_suffix_prefill_impl``); retraces per (group,
-    bucket) shape — same bounded pow2 grid as the dense suffix path."""
+    bucket) shape — same bounded pow2 grid as the dense suffix path.
+    ``tp > 1`` runs it sharded over heads with the pool partitioned per
+    shard (README "Tensor-parallel serving")."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
+    if int(tp) > 1:
+        tp = int(tp)
+        _tp_validate(nh, nkv, tp)
+        impl = functools.partial(
+            _paged_suffix_prefill_impl, nh=nh // tp, nkv=nkv // tp,
+            hd=hd, eps=eps, theta=theta, tied=tied,
+            tp_reduce=_tp_allreduce(collective_dtype, tp))
+        rep = PartitionSpec()
+        pool = _pool_pspec(kv_quant)
+        return jax.jit(_tp_shard(
+            impl, tp,
+            in_specs=(_params_pspec(wq8), pool, pool) + (rep,) * 7,
+            out_specs=(pool, pool, rep, rep)),
+            donate_argnums=(1, 2) if donate else ())
     return jax.jit(
         functools.partial(_paged_suffix_prefill_impl, nh=nh, nkv=nkv, hd=hd,
                           eps=eps, theta=theta, tied=tied),
@@ -652,7 +834,7 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 # ------------------------------------------------------ unified ragged step
 def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
                        pv_all, lens, kys, app_mask, temps, top_ks, *, nh,
-                       nkv, hd, eps, decode_attn):
+                       nkv, hd, eps, decode_attn, tp_reduce=None):
     """ONE fused decode tick over all rows — THE shared tail body of
     the unified ragged step's scan and the multi-tick step's
     while_loop (the two must compute identically or ``decode_ticks>1``
@@ -696,9 +878,10 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
             attn = paged_decode_attention_reference(
                 q[:, 0], kd, vd, tables, lens + app_mask,
                 k_scale=ksc, v_scale=vsc)
-        h = h + jnp.einsum("bsd,dh->bsh",
-                           attn.reshape(R, 1, nh * hd), lwo)
-        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        o = jnp.einsum("bsd,dh->bsh", attn.reshape(R, 1, nh * hd), lwo)
+        h = h + (o if tp_reduce is None else tp_reduce(o))
+        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
     x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
@@ -729,7 +912,7 @@ def _span_last_sample(params, head, x, qstart, qlen, keys, temps, top_ks,
 
 def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, sin, cos, *, nh, nkv, hd,
-                         eps, decode_attn):
+                         eps, decode_attn, tp_reduce=None):
     """ONE forward pass over a packed buffer of variable-length query
     spans through the block tables — the shared tick-0 assembly of the
     unified ragged step AND the speculative verify program (the two
@@ -784,9 +967,10 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
             attn = ragged_attention_reference(
                 q[0], kd, vd, tables, qstart, qlen, kvlen,
                 k_scale=ksc, v_scale=vsc)
-        h = h + jnp.einsum("bsd,dh->bsh",
-                           attn.reshape(1, T, nh * hd), lwo)
-        h = h + _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        o = jnp.einsum("bsd,dh->bsh", attn.reshape(1, T, nh * hd), lwo)
+        h = h + (o if tp_reduce is None else tp_reduce(o))
+        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
     x = jnp.take(params["embed"], ids[None], axis=0)        # [1, T, H]
@@ -797,7 +981,7 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
 def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, dec_mask, keys, temps, top_ks,
                       *, n_steps, nh, nkv, hd, eps, theta, tied,
-                      decode_attn):
+                      decode_attn, tp_reduce=None):
     """THE unified serving step: one device call that advances every
     slot's span — decode rows (span 1) and prefill chunks (span n) —
     through the same block tables, collapsing the
@@ -848,7 +1032,7 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn)
+        decode_attn=decode_attn, tp_reduce=tp_reduce)
     tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
                                       keys, temps, top_ks, eps)
 
@@ -863,7 +1047,7 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
         nxt, npk, npv, nkeys = _fused_decode_tick(
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, dec_mask, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, decode_attn=decode_attn)
+            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce)
         return (nxt, npk, npv, lens + dec_mask, nkeys), nxt
 
     if n_steps > 1:
@@ -877,14 +1061,37 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 
 
 def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
-                         decode_attn, donate=None):
+                         decode_attn, donate=None, tp=1,
+                         collective_dtype="fp", kv_quant=False,
+                         wq8=False):
     """One jitted unified serving step (``_ragged_step_impl``): shapes
     depend only on ``(num_slots, token_budget)`` plus the fused
     ``n_steps`` — one compilation per step size serves every span mix,
-    the same compile-once contract as the decode program it
-    replaces."""
+    the same compile-once contract as the decode program it replaces.
+    ``tp > 1`` wraps the WHOLE step in shard_map over the heads-sharded
+    mesh (README "Tensor-parallel serving"): attention and the QKV/MLP
+    projections run fully sharded, the paged pool partitions per shard
+    on its head axis, and the only cross-chip traffic is the per-layer
+    all-reduce pair (``collective_dtype`` picks fp vs EQuARX-style
+    int8). The compile-once contract is unchanged — the TP degree joins
+    the engine's jit key, not the trace's shapes."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
+    if int(tp) > 1:
+        tp = int(tp)
+        _tp_validate(nh, nkv, tp)
+        impl = functools.partial(
+            _ragged_step_impl, n_steps=n_steps, nh=nh // tp,
+            nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
+            decode_attn=decode_attn,
+            tp_reduce=_tp_allreduce(collective_dtype, tp))
+        rep = PartitionSpec()
+        pool = _pool_pspec(kv_quant)
+        return jax.jit(_tp_shard(
+            impl, tp,
+            in_specs=(_params_pspec(wq8), pool, pool) + (rep,) * 11,
+            out_specs=(pool, pool, rep, rep, rep)),
+            donate_argnums=(1, 2) if donate else ())
     return jax.jit(
         functools.partial(
             _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
@@ -896,7 +1103,8 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, dec_mask, keys, temps,
                          top_ks, eos_ids, budgets, n_ticks, *, max_ticks,
-                         nh, nkv, hd, eps, theta, tied, decode_attn):
+                         nh, nkv, hd, eps, theta, tied, decode_attn,
+                         tp_reduce=None):
     """THE multi-tick serving step (README "Multi-tick decode"): the
     unified ragged step with the host driven out of the per-token loop.
     Tick 0 is ``_ragged_step_impl``'s packed forward verbatim (decode
@@ -950,7 +1158,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn)
+        decode_attn=decode_attn, tp_reduce=tp_reduce)
     tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
                                       keys, temps, top_ks, eps)
 
@@ -977,7 +1185,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
         nxt, npk, npv, nkeys = _fused_decode_tick(
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, am, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, decode_attn=decode_attn)
+            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce)
         tb = tb.at[t].set(nxt)
         kb = kb.at[t].set(nkeys)
         # the host's _maybe_finish rule, in-program: after emitting
@@ -994,15 +1202,33 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 
 
 def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
-                            decode_attn, donate=None):
+                            decode_attn, donate=None, tp=1,
+                            collective_dtype="fp", kv_quant=False,
+                            wq8=False):
     """One jitted multi-tick serving step (``_multitick_step_impl``):
     shapes depend only on ``(num_slots, token_budget, max_ticks)`` —
     the tick count actually run is a RUNTIME argument, so one
     compilation serves every span mix AND every adaptive tick count
     from 1 to ``max_ticks``. The compile-once contract covers the
-    multi-tick geometry with a single trace."""
+    multi-tick geometry with a single trace. ``tp > 1`` shards it over
+    heads exactly like the unified step it extends."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
+    if int(tp) > 1:
+        tp = int(tp)
+        _tp_validate(nh, nkv, tp)
+        impl = functools.partial(
+            _multitick_step_impl, max_ticks=int(max_ticks), nh=nh // tp,
+            nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
+            decode_attn=decode_attn,
+            tp_reduce=_tp_allreduce(collective_dtype, tp))
+        rep = PartitionSpec()
+        pool = _pool_pspec(kv_quant)
+        return jax.jit(_tp_shard(
+            impl, tp,
+            in_specs=(_params_pspec(wq8), pool, pool) + (rep,) * 14,
+            out_specs=(pool, pool, rep, rep, rep)),
+            donate_argnums=(1, 2) if donate else ())
     return jax.jit(
         functools.partial(
             _multitick_step_impl, max_ticks=int(max_ticks), nh=nh,
@@ -1015,7 +1241,7 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
 def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, sample_start, keys, temps,
                       top_ks, *, spec_len, nh, nkv, hd, eps, theta, tied,
-                      decode_attn):
+                      decode_attn, tp_reduce=None):
     """THE speculative serving step (README "Speculative decoding"):
     one device call that scores every slot's draft-extended span — a
     verify row packs ``[last_token, d_1 .. d_k]`` at positions
@@ -1068,7 +1294,7 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn)
+        decode_attn=decode_attn, tp_reduce=tp_reduce)
     # per-row sample positions: spec_len consecutive packed rows from
     # sample_start, clamped inside the row's span (idle rows clamp to
     # row 0 — garbage the host never reads)
@@ -1092,13 +1318,32 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 
 
 def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
-                         decode_attn, donate=None):
+                         decode_attn, donate=None, tp=1,
+                         collective_dtype="fp", kv_quant=False,
+                         wq8=False):
     """One jitted speculative verify step (``_spec_verify_impl``):
     shapes depend only on ``(num_slots, spec token budget, spec_len)``
     — one compilation serves every draft/acceptance/chunk mix, the
-    same compile-once contract as the programs it replaces."""
+    same compile-once contract as the programs it replaces. ``tp > 1``
+    shards it over heads exactly like the unified step whose tick-0
+    assembly it shares."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
+    if int(tp) > 1:
+        tp = int(tp)
+        _tp_validate(nh, nkv, tp)
+        impl = functools.partial(
+            _spec_verify_impl, spec_len=spec_len, nh=nh // tp,
+            nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
+            decode_attn=decode_attn,
+            tp_reduce=_tp_allreduce(collective_dtype, tp))
+        rep = PartitionSpec()
+        pool = _pool_pspec(kv_quant)
+        return jax.jit(_tp_shard(
+            impl, tp,
+            in_specs=(_params_pspec(wq8), pool, pool) + (rep,) * 11,
+            out_specs=(pool, pool, rep, rep)),
+            donate_argnums=(1, 2) if donate else ())
     return jax.jit(
         functools.partial(
             _spec_verify_impl, spec_len=spec_len, nh=nh, nkv=nkv, hd=hd,

@@ -177,7 +177,7 @@ class ContinuousBatchingEngine:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  step_clock=None, spec_decode=False, spec_k=4,
                  drafter=None, decode_ticks=1, kv_dtype=None,
-                 quantize_weights=False):
+                 quantize_weights=False, tp=1, collective_dtype="fp"):
         c = model.config
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
@@ -187,6 +187,46 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefill_bucketing must be 'pow2' or 'exact', got "
                 f"{prefill_bucketing!r}")
+        # multi-chip tensor parallelism (README "Tensor-parallel
+        # serving"): tp=N shards every serving program over an N-device
+        # heads-sharded mesh with the paged pool partitioned per shard.
+        if int(tp) < 1:
+            raise ValueError(f"tp must be >= 1, got {int(tp)}")
+        if collective_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"collective_dtype must be 'fp' or 'int8', got "
+                f"{collective_dtype!r}")
+        self._tp = int(tp)
+        # tp=1 has no mesh and no wire: normalize the collective dtype
+        # so banners/geometry tuples report the effective value
+        self._coll_dtype = collective_dtype if self._tp > 1 else "fp"
+        if self._tp > 1:
+            if not (bool(paged_attn) and bool(ragged_step)):
+                raise ValueError(
+                    "tp > 1 requires the unified ragged paged engine "
+                    "(paged_attn=True, ragged_step=True): tensor "
+                    "parallelism shards the packed-span programs and "
+                    "the block pool; the dense / two-program paths "
+                    "never grew mesh plumbing")
+            if c.num_attention_heads % self._tp \
+                    or c.num_key_value_heads % self._tp:
+                raise ValueError(
+                    f"tp={self._tp} must divide num_attention_heads "
+                    f"({c.num_attention_heads}) and num_key_value_heads "
+                    f"({c.num_key_value_heads}): the mesh shards over "
+                    f"heads")
+            if self._coll_dtype == "int8" and c.hidden_size % self._tp:
+                raise ValueError(
+                    f"collective_dtype='int8' needs hidden_size "
+                    f"({c.hidden_size}) divisible by tp={self._tp}: the "
+                    f"quantized all-reduce chunks the activation per "
+                    f"shard")
+            from .decode import _tp_mesh
+            # raises with the XLA_FLAGS hint when the mesh can't exist;
+            # bound here so the pool construction below reuses THE mesh
+            self._tp_mesh = _tp_mesh(self._tp)
+        else:
+            self._tp_mesh = None
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None (store KV at the pool dtype) or "
@@ -230,9 +270,25 @@ class ContinuousBatchingEngine:
         # differing only in kv_dtype or quantize_weights sharing one
         # jit_cache dict must key apart or both compile pins break.
         # Appended at the END of each key; () on default engines keeps
-        # every pre-existing key byte-identical.
+        # every pre-existing key byte-identical. The TP degree (and its
+        # collective dtype) is a variant the same way: a sharded
+        # program is a different trace of the same impl, so tp=2 and
+        # tp=1 engines sharing one jit_cache must key apart.
         self._kvtag = ("kv8",) if self._kv_quant else ()
         self._wtag = ("w8",) if self._wq8 else ()
+        self._tptag = ((f"tp{self._tp}", self._coll_dtype)
+                       if self._tp > 1 else ())
+        if self._tp > 1:
+            # commit the params onto the mesh ONCE per (model, tp, w8):
+            # rebuilds and fleet replicas share the placed arrays (and
+            # the jit cache never pays a per-call reshard)
+            from .decode import place_tp_params
+            placed = model.__dict__.setdefault("_tp_params", {})
+            pkey = (self._tp, self._wq8)
+            if pkey not in placed:
+                placed[pkey] = place_tp_params(self._params, self._tp,
+                                               self._wq8)
+            self._params = placed[pkey]
         dtype = self._params["embed"].dtype
         from .block_manager import BlockManager
         from .prefix_cache import PrefixCache
@@ -248,6 +304,10 @@ class ContinuousBatchingEngine:
             # scale planes), not the model dtype — a shared pool must
             # match the engine's quantization mode exactly
             store = jnp.int8 if self._kv_quant else dtype
+            # TP partitions the pool's HEAD axis across the mesh: the
+            # BlockManager commits its arrays with that sharding once,
+            # so every sharded program adopts them zero-copy
+            tp_mesh = self._tp_mesh
             if isinstance(prefix_cache, PrefixCache):
                 pool = prefix_cache.pool
                 want = (c.num_hidden_layers, c.num_key_value_heads,
@@ -263,6 +323,13 @@ class ContinuousBatchingEngine:
                         f"not match this paged engine "
                         f"{want}/bs={bs}/{store} "
                         f"(kv_dtype={self._kv_dtype!r})")
+                if getattr(pool, "tp", 1) != self._tp:
+                    raise ValueError(
+                        f"shared PrefixCache pool is partitioned for "
+                        f"tp={getattr(pool, 'tp', 1)} but this engine "
+                        f"runs tp={self._tp}: a pool's head-axis "
+                        f"sharding must match every engine serving "
+                        f"from it")
                 if pool.num_blocks <= live:
                     raise ValueError(
                         f"shared pool of {pool.num_blocks} blocks cannot "
@@ -286,12 +353,13 @@ class ContinuousBatchingEngine:
                 pool = BlockManager(
                     c.num_hidden_layers, live + budget, bs,
                     c.num_key_value_heads, c.head_dim, dtype=dtype,
-                    kv_dtype=self._kv_dtype)
+                    kv_dtype=self._kv_dtype, mesh=tp_mesh)
                 self.prefix_cache = PrefixCache(pool, max_blocks=budget)
             else:
                 pool = BlockManager(
                     c.num_hidden_layers, live, bs, c.num_key_value_heads,
-                    c.head_dim, dtype=dtype, kv_dtype=self._kv_dtype)
+                    c.head_dim, dtype=dtype, kv_dtype=self._kv_dtype,
+                    mesh=tp_mesh)
             self.cache = PagedKVCache(
                 c.num_hidden_layers, self.num_slots, self.max_seq_len,
                 c.num_key_value_heads, c.head_dim, dtype=dtype,
@@ -541,14 +609,25 @@ class ContinuousBatchingEngine:
                     hd=c.head_dim, eps=float(c.rms_norm_eps),
                     theta=float(c.rope_theta), tied=self._tied)
 
+    def _tp_consts(self):
+        """Builder kwargs of the TP variant ({} on tp=1, so default
+        engines call the builders exactly as before)."""
+        if self._tp <= 1:
+            return {}
+        return dict(tp=self._tp, collective_dtype=self._coll_dtype,
+                    kv_quant=self._kv_quant, wq8=self._wq8)
+
     def _prefill_fn(self):
         # the weight tag (not the kv tag): the cold prefill touches the
         # params but never the pool, so two engines differing only in
         # kv_dtype SHARE this trace while a quantized-weights engine
-        # (different param pytree = different trace) keys apart
-        key = ("prefill",) + self._wtag
+        # (different param pytree = different trace) keys apart. The
+        # TP tag joins: a sharded prefill is a different program.
+        key = ("prefill",) + self._wtag + self._tptag
         if key not in self._jit:
-            self._jit[key] = build_prefill_fn(**self._fn_consts())
+            tpk = self._tp_consts()
+            tpk.pop("kv_quant", None)   # prefill never touches the pool
+            self._jit[key] = build_prefill_fn(**self._fn_consts(), **tpk)
         # host_out: the engine fetches tok0 (result 2); pk/pv feed the
         # cache writer device-side and keys stay device state
         return self._wrap_prog(key, self._jit[key], host_out=(2,))
@@ -557,13 +636,14 @@ class ContinuousBatchingEngine:
         # paged and dense suffix programs are distinct (table-indirect
         # vs slot-indexed) and may share one jit_cache dict, so they key
         # apart; the cold prefill is IDENTICAL either way and is shared.
-        # The suffix program touches params AND pool — both tags.
+        # The suffix program touches params AND pool — all three tags.
         key = (("psuffix",) if self._paged else ("suffix",)) \
-            + self._kvtag + self._wtag
+            + self._kvtag + self._wtag + self._tptag
         if key not in self._jit:
             build = (build_paged_suffix_prefill_fn if self._paged
                      else build_suffix_prefill_fn)
-            self._jit[key] = build(**self._fn_consts())
+            self._jit[key] = build(**self._fn_consts(),
+                                   **self._tp_consts())
         return self._wrap_prog(key, self._jit[key], host_out=(2,))
 
     def _decode_fn(self, n_steps):
@@ -588,12 +668,12 @@ class ContinuousBatchingEngine:
         # slots=16/chunk=56 share a token budget of 72)
         key = ("ragged", self.num_slots, self._token_budget,
                int(n_steps), self.config.decode_attention) \
-            + self._kvtag + self._wtag
+            + self._kvtag + self._wtag + self._tptag
         if key not in self._jit:
             self._jit[key] = build_ragged_step_fn(
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts())
+                **self._fn_consts(), **self._tp_consts())
         # host reads the sampled tokens and the tick-0 keys (chunk
         # installs); keys_fin is adopted device-side via jnp.where
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
@@ -606,13 +686,13 @@ class ContinuousBatchingEngine:
         # argument, so this is the engine's ONE decode program.
         key = ("mtick", self.num_slots, self._token_budget,
                self._decode_ticks, self.config.decode_attention) \
-            + self._kvtag + self._wtag
+            + self._kvtag + self._wtag + self._tptag
         if key not in self._jit:
             from .decode import build_multitick_step_fn
             self._jit[key] = build_multitick_step_fn(
                 max_ticks=self._decode_ticks,
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts())
+                **self._fn_consts(), **self._tp_consts())
         # host reads the sampled token block, the key walk (per-slot
         # adoption at each slot's trim cut) and the ticks-run scalar
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3, 4))
@@ -623,13 +703,13 @@ class ContinuousBatchingEngine:
         # trace apart from other engines sharing one jit_cache
         key = ("spec", self.num_slots, self._spec_budget,
                self._spec_len, self.config.decode_attention) \
-            + self._kvtag + self._wtag
+            + self._kvtag + self._wtag + self._tptag
         if key not in self._jit:
             from .decode import build_spec_verify_fn
             self._jit[key] = build_spec_verify_fn(
                 spec_len=self._spec_len,
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts())
+                **self._fn_consts(), **self._tp_consts())
         # host reads the sampled walk tokens AND the key walk (both are
         # np.asarray'd for acceptance)
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
@@ -653,6 +733,49 @@ class ContinuousBatchingEngine:
         single-sync-per-token step) — the public surface for
         banners/metrics. README "Multi-tick decode"."""
         return self._decode_ticks
+
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel degree: the number of mesh devices every
+        serving program shards over (1 = single-chip, no mesh) — the
+        public surface for banners/metrics (README "Tensor-parallel
+        serving")."""
+        return self._tp
+
+    @property
+    def collective_dtype(self) -> str:
+        """The EFFECTIVE wire dtype of the per-layer TP all-reduce:
+        ``"int8"`` runs it EQuARX-style block-quantized, ``"fp"`` is a
+        plain psum (and the reported value on tp=1, where no collective
+        ever runs) — the public surface for banners/metrics."""
+        return self._coll_dtype
+
+    def _record_collectives(self, co, spans):
+        """EXACT collective-byte accounting for one sharded launch —
+        called at every launch site behind the ``_co()`` guard.
+        ``spans`` is ``[(rows, repeats)]``: each entry covers
+        ``repeats`` passes over the layer stack, each pass paying the
+        per-layer all-reduce PAIR (post o-proj + post down-proj) on a
+        ``[rows, hidden]`` activation. Bytes follow the shared wire
+        model (``quantization.collective_wire_bytes``), so the
+        fp-vs-int8 counter ratio is shape-derived and deterministic —
+        the TP bench's >=3x gate reads these counters, not a network
+        probe."""
+        if self._tp <= 1:
+            return
+        from ..quantization import collective_wire_bytes
+        L = self.config.num_hidden_layers
+        hidden = self.config.hidden_size
+        fp_b = np.dtype(self._params["embed"].dtype).itemsize
+        ops, nbytes = 0, 0
+        for rows, reps in spans:
+            if rows <= 0 or reps <= 0:
+                continue
+            ops += 2 * L * reps
+            nbytes += 2 * L * reps * collective_wire_bytes(
+                rows, hidden, self._tp, self._coll_dtype,
+                fp_itemsize=fp_b)
+        co.record_collective(self._coll_dtype, ops, nbytes)
 
     @property
     def kv_dtype(self) -> str:
@@ -699,8 +822,12 @@ class ContinuousBatchingEngine:
         jit_cache count only their own programs. On the speculative
         engine the verify program IS the decode program — every step,
         chunk-carrying or not, is one spec-geometry launch — so the
-        count covers the verify geometry too."""
-        tags = self._kvtag + self._wtag
+        count covers the verify geometry too. Tag-aware INCLUSIVE of
+        the sharded geometry: a tp=N engine counts only its own
+        ``("tpN", dtype)``-tagged traces, so the pin covers the
+        shard_map program and a tp=1 sibling sharing the jit cache
+        never pollutes it (README "Tensor-parallel serving")."""
+        tags = self._kvtag + self._wtag + self._tptag
         if self._spec:
             # spec_len is CONFIG (spec_k + 1), not a runtime variant
             # like the ragged key's n_steps — two engines differing
@@ -746,9 +873,11 @@ class ContinuousBatchingEngine:
         quantization variant counts."""
         sfx = "psuffix" if self._paged else "suffix"
         return sum(fn._cache_size() for key, fn in self._jit.items()
-                   if (key[0] == "prefill" and key[1:] == self._wtag)
+                   if (key[0] == "prefill"
+                       and key[1:] == self._wtag + self._tptag)
                    or (key[0] == sfx
-                       and key[1:] == self._kvtag + self._wtag))
+                       and key[1:] == self._kvtag + self._wtag
+                       + self._tptag))
 
     # ------------------------------------------------------------- intake
     def _key_for(self, request):
@@ -925,6 +1054,10 @@ class ContinuousBatchingEngine:
                 pk, pv, tok0s, keys2 = self._prefill_fn()(
                     self._params, ids, lens, keys, temps, topks)
                 tok0s = np.asarray(tok0s)
+            co = self._co()
+            if co is not None:
+                # sharded cold prefill: one pass over the padded group
+                self._record_collectives(co, [(Gp * s_pad, 1)])
             for i, seq in enumerate(group):
                 seq.launches += 1       # rode this bucket's prefill
                 slot = self.cache.alloc()
@@ -1038,6 +1171,10 @@ class ContinuousBatchingEngine:
                 keys, temps, topks)
             self.cache.update(nk, nv)
             tok0s = np.asarray(tok0s)
+        co = self._co()
+        if co is not None:
+            # sharded suffix/chunk prefill: one pass, padded group
+            self._record_collectives(co, [(Gp * s_pad, 1)])
         return tok0s, keys2
 
     def _run_prefill_chunks(self, plan, finished):
@@ -1614,6 +1751,11 @@ class ContinuousBatchingEngine:
         keys_t0_np = np.asarray(keys_t0)
         self.stats["unified_steps"] += 1
         if co is not None:
+            # sharded launch: tick 0 all-reduces the PADDED packed
+            # buffer (the device computes full shapes), each fused tail
+            # tick the per-slot row block — exact, shape-derived
+            self._record_collectives(
+                co, [(self._token_budget, 1), (self.num_slots, n - 1)])
             co.set_phase("host-accept")
         if tr is not None:
             tr.complete("launch", tl0,
@@ -1795,6 +1937,12 @@ class ContinuousBatchingEngine:
         ticks = int(ticks_run)              # <= n: early exit when all
         self.stats["unified_steps"] += 1    # rows retire on device
         if co is not None:
+            # multi-tick sharded launch: tick 0 on the padded packed
+            # buffer + the ticks the while_loop ACTUALLY ran (early
+            # exit spends no wire) on the per-slot row block
+            self._record_collectives(
+                co, [(self._token_budget, 1),
+                     (self.num_slots, ticks - 1)])
             co.set_phase("host-accept")
         if tr is not None:
             tr.complete("launch", tl0,
@@ -1986,6 +2134,8 @@ class ContinuousBatchingEngine:
         kwalk_np = np.asarray(kwalk)        # [spec_len, R, 2]
         self.stats["spec_steps"] += 1
         if co is not None:
+            # one packed verify forward per spec step (no decode tail)
+            self._record_collectives(co, [(self._spec_budget, 1)])
             co.set_phase("host-accept")
         if tr is not None:
             tr.complete("launch", tl0,

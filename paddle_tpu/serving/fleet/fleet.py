@@ -97,6 +97,7 @@ class EngineFleet:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  spec_decode=False, spec_k=4, drafter=None,
                  decode_ticks=1, kv_dtype=None, quantize_weights=False,
+                 tp=1, collective_dtype="fp",
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -152,11 +153,17 @@ class EngineFleet:
             # different pytree — per-geometry jit caches must not
             # collide or both engines' compile pins break (the
             # pool-geometry-keyed-cache rule)
+            # tp and collective_dtype are geometry the same way: a
+            # sharded program is a different trace (different mesh,
+            # different collectives), so replicas with different TP
+            # degrees get isolated jit-cache dicts — the same
+            # discipline as the kv8/w8 tags
             geom = (slots[i], smax[i], chunk[i], bool(paged_attn),
                     bool(ragged_step), bool(spec_decode), int(spec_k),
                     int(decode_chunk), int(prefix_block_size),
                     bool(prefix_cache), pblocks[i], int(decode_ticks),
-                    kv_dtype, bool(quantize_weights))
+                    kv_dtype, bool(quantize_weights),
+                    int(tp), str(collective_dtype))
             jit = jits.setdefault(geom, {})
 
             def factory(i=i, jit=jit):
@@ -174,6 +181,7 @@ class EngineFleet:
                     drafter=drafter, decode_ticks=decode_ticks,
                     kv_dtype=kv_dtype,
                     quantize_weights=quantize_weights,
+                    tp=tp, collective_dtype=collective_dtype,
                     jit_cache=jit)
 
             gw = ServingGateway(

@@ -174,14 +174,37 @@ def _writer(donate):
 
 
 @functools.lru_cache(maxsize=None)
-def _paged_writer(donate, quantized=False):
+def _paged_writer(donate, quantized=False, tp=1):
     # donate the POOL arrays (the pool is the cache being updated);
-    # the quantized writer donates the scale planes too
+    # the quantized writer donates the scale planes too. On a
+    # tensor-parallel pool (tp > 1) the writer runs under shard_map
+    # with the pool (and the prefill K/V it scatters) partitioned on
+    # the head axis — NOT auto-GSPMD: the scatter must hand the pool
+    # back with exactly the sharding the sharded step programs expect,
+    # or the first post-prefill step pays a re-specialization and the
+    # compile-once pin breaks (README "Tensor-parallel serving").
+    impl = _paged_write_prefill_q if quantized else _paged_write_prefill
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+        from .decode import _pool_pspec, _tp_mesh
+        # THE pool spec, not a local re-spelling: the scatter must hand
+        # the pool back under exactly the sharding the sharded step
+        # programs expect (scale planes shard on the same head axis)
+        kv = P(None, None, "tp")            # pk/pv [L, S, Hkv, D]
+        rep = P()
+        if quantized:
+            pool, sc = _pool_pspec(True)
+            in_specs = (pool, pool, sc, sc, kv, kv, rep, rep)
+            out_specs = (pool, pool, sc, sc)
+        else:
+            pool = _pool_pspec(False)
+            in_specs = (pool, pool, kv, kv, rep, rep)
+            out_specs = (pool, pool)
+        impl = jax.shard_map(impl, mesh=_tp_mesh(tp), in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
     if quantized:
-        return jax.jit(_paged_write_prefill_q,
-                       donate_argnums=(0, 1, 2, 3) if donate else ())
-    return jax.jit(_paged_write_prefill,
-                   donate_argnums=(0, 1) if donate else ())
+        return jax.jit(impl, donate_argnums=(0, 1, 2, 3) if donate else ())
+    return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
 
 @functools.lru_cache(maxsize=None)
@@ -598,13 +621,14 @@ class PagedKVCache:
                 f"{self.max_seq_len}")
         self.ensure_capacity(slot, int(prompt_len))
         p = self.pool
+        tp = getattr(p, "tp", 1)
         if self.quantized:
             p.k, p.v, p.k_scale, p.v_scale = \
-                _paged_writer(self._donate, True)(
+                _paged_writer(self._donate, True, tp)(
                     p.k, p.v, p.k_scale, p.v_scale, pk, pv,
                     jnp.asarray(self.tables[slot]), np.int32(prompt_len))
         else:
-            p.k, p.v = _paged_writer(self._donate)(
+            p.k, p.v = _paged_writer(self._donate, False, tp)(
                 p.k, p.v, pk, pv,
                 jnp.asarray(self.tables[slot]), np.int32(prompt_len))
         self.lengths[slot] = int(prompt_len)

@@ -137,6 +137,25 @@ def main(argv=None):
                          "fused into the matmul) — weight HBM traffic "
                          "drops ~4x vs fp32 at a measured-not-assumed "
                          "quality cost")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (README 'Tensor-"
+                         "parallel serving'): shard every serving "
+                         "program over this many devices on a heads-"
+                         "sharded mesh with the paged KV pool "
+                         "partitioned per shard (unified ragged paged "
+                         "engine only; must divide the model's head "
+                         "counts). On CPU set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N "
+                         "before launch. 1 = single-chip (the "
+                         "baseline)")
+    ap.add_argument("--collective-dtype", choices=("fp", "int8"),
+                    default="fp",
+                    help="wire dtype of the per-layer tensor-parallel "
+                         "all-reduce: 'fp' is a plain psum, 'int8' "
+                         "runs it EQuARX-style block-quantized (~3.5x "
+                         "fewer cross-chip bytes; greedy divergence "
+                         "measured in TP_BENCH.json, not assumed). "
+                         "Ignored (no collectives) at --tp 1")
     ap.add_argument("--spec-decode", action=argparse.BooleanOptionalAction,
                     default=False,
                     help="speculative multi-token decode (paged only): "
@@ -214,6 +233,7 @@ def main(argv=None):
             spec_decode=args.spec_decode, spec_k=args.spec_k,
             decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
             quantize_weights=args.quantize_weights,
+            tp=args.tp, collective_dtype=args.collective_dtype,
             trace=args.trace, trace_buffer=args.trace_buffer,
             cost=args.cost,
             watchdog_deadline_s=args.watchdog_deadline or None,
@@ -239,6 +259,14 @@ def main(argv=None):
             "kv_dtype": fleet.replicas[0].gateway.engine.kv_dtype,
             "quantize_weights":
                 fleet.replicas[0].gateway.engine.quantize_weights,
+            # effective-value idiom: the engines' ACTUAL mesh shape
+            # (devices per replica on the "tp" axis) and the wire
+            # dtype their per-layer all-reduce really runs
+            "tp": fleet.replicas[0].gateway.engine.tp,
+            "mesh_shape":
+                {"tp": fleet.replicas[0].gateway.engine.tp},
+            "collective_dtype":
+                fleet.replicas[0].gateway.engine.collective_dtype,
             "trace": fleet.tracer.enabled,
             "cost": fleet.replicas[0].gateway.cost is not None,
             "endpoints": ["/v1/completions", "/healthz", "/metrics",
@@ -266,6 +294,7 @@ def main(argv=None):
         spec_decode=args.spec_decode, spec_k=args.spec_k,
         decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
         quantize_weights=args.quantize_weights,
+        tp=args.tp, collective_dtype=args.collective_dtype,
         trace=args.trace, trace_buffer=args.trace_buffer,
         cost=args.cost,
         watchdog_deadline_s=args.watchdog_deadline or None,
@@ -295,6 +324,14 @@ def main(argv=None):
                       "kv_dtype": server.gateway.engine.kv_dtype,
                       "quantize_weights":
                       server.gateway.engine.quantize_weights,
+                      # effective-value idiom: the EFFECTIVE mesh
+                      # shape (the "tp" axis the programs actually
+                      # shard over; 1 = no mesh) and the wire dtype
+                      # of the per-layer all-reduce
+                      "tp": server.gateway.engine.tp,
+                      "mesh_shape": {"tp": server.gateway.engine.tp},
+                      "collective_dtype":
+                      server.gateway.engine.collective_dtype,
                       # report what actually runs: whether the tracer
                       # is RECORDING now (the persistent --trace mode)
                       # and the effective ring capacity

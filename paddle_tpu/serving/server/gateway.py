@@ -659,6 +659,23 @@ class ServingGateway:
                 "host). No device sync; monotonic across rebuilds.")
             xfer.set_fn(lambda: co.totals["h2d_bytes"], direction="h2d")
             xfer.set_fn(lambda: co.totals["d2h_bytes"], direction="d2h")
+            # tensor-parallel collective surface (README "Tensor-
+            # parallel serving"): cross-chip all-reduce wire bytes by
+            # wire dtype — a SEPARATE ledger from h2d/d2h (all-reduce
+            # traffic never crosses the host boundary, and logical
+            # per-shard arg leaves are never double-counted into it).
+            # Registered up front for both dtypes so tp=1 engines
+            # scrape explicit zeros, not absent series.
+            coll = r.counter(
+                "serving_collective_bytes_total",
+                "Cross-chip tensor-parallel all-reduce wire bytes per "
+                "device by collective dtype (exact, shape-derived — "
+                "the EQuARX int8 wire cut is this counter's fp/int8 "
+                "ratio). 0 on tp=1 engines. Monotonic across engine "
+                "rebuilds.")
+            for cdt in ("fp", "int8"):
+                coll.set_fn((lambda d: lambda: co.collective_bytes(d))(
+                    cdt), dtype=cdt)
             r.counter("serving_program_compiles_total",
                       "Program compile (trace) events observed at the "
                       "jit-cache chokepoint — stays flat once warm "
@@ -1442,6 +1459,24 @@ class ServingGateway:
                 "used_scale_bytes": used * sc_b,
                 "capacity_bytes": eng.cache.pool.num_blocks * per_block,
                 "bytes_per_token": eng.cache.bytes_per_token(),
+            }
+        if getattr(eng, "tp", 1) > 1:
+            # per-layer collective-bytes column (README "Tensor-
+            # parallel serving"): annotate the window's all-reduce
+            # wire traffic (already delta'd by co.export) per layer
+            # and per decoded token, so the EQuARX int8 win reads
+            # directly off the profile
+            L = max(int(eng.config.num_hidden_layers), 1)
+            doc["collectives"] = {
+                "tp": eng.tp,
+                "per_dtype": {
+                    dtype: dict(
+                        rec,
+                        bytes_per_layer=round(rec["bytes"] / L, 3),
+                        bytes_per_decoded_token=round(
+                            rec["bytes"] / max(tokens, 1), 3))
+                    for dtype, rec in doc.get("collectives", {}).items()
+                },
             }
         return doc
 

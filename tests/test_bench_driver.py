@@ -81,6 +81,8 @@ class TestBenchDriverFlow:
         assert art["chaos"]["ok"] is False
         assert art["trace_overhead"]["ok"] is False
         assert art["dispatch"]["ok"] is False
+        assert art["density"]["ok"] is False
+        assert art["tp"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -178,6 +180,18 @@ class TestBenchDriverFlow:
                      "int8_deterministic": True,
                      "default_streams_unchanged": True,
                      "accepted": True}), ""
+            if leg == "--tp":
+                # tensor-parallel leg: same hang-proof contract (the
+                # child forces its own virtual-mesh device count)
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps(
+                    {"name": "tp", "ok": True,
+                     "tokens_equal": True,
+                     "compile_once": {"tp1": 1, "tp2": 1},
+                     "collective_bytes_reduction": 3.92,
+                     "greedy_divergence": {"divergence_rate": 0.0},
+                     "int8_deterministic": True,
+                     "accepted": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -212,11 +226,11 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:11] == ["--decode-cb", "--serve-http",
+        assert order[:12] == ["--decode-cb", "--serve-http",
                               "--prefix-cache", "--paged-attn",
                               "--chunked-prefill", "--ragged", "--spec",
                               "--chaos", "--trace-overhead",
-                              "--dispatch", "--density"]
+                              "--dispatch", "--density", "--tp"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -243,6 +257,11 @@ class TestBenchDriverFlow:
         assert art["density"]["slot_capacity_ratio"] == 3.5
         assert art["density"][
             "greedy_divergence"]["divergence_rate"] == 0.0
+        # the tensor-parallel leg rides the same banked artifact
+        assert art["tp"]["accepted"] is True
+        assert art["tp"]["tokens_equal"] is True
+        assert art["tp"]["compile_once"] == {"tp1": 1, "tp2": 1}
+        assert art["tp"]["collective_bytes_reduction"] == 3.92
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

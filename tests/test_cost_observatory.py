@@ -626,6 +626,48 @@ class TestGuardDiscipline:
             assert "_wrap_prog" in body, fn_name
             assert "_kvtag" in body or "_wtag" in body, fn_name
 
+    def test_sweep_sees_the_tp_launch_path(self):
+        """ISSUE 15 satellite: the tensor-parallel launch path stays
+        guard-disciplined. Collective-byte accounting (the one NEW
+        instrumentation the sharded path adds) routes through ONE
+        engine helper (``_record_collectives``) and every call site
+        sits behind the ``co = self._co()`` guard — the sweep above
+        would flag a raw touch; this test makes sure the TP sites are
+        actually inside the swept tree. The sharded programs ride the
+        SAME ``_wrap_prog`` chokepoint (the tp tag joins the key, so
+        dispatch attribution stays exact per variant), and the
+        builders' shard_map wiring lives in decode.py where the
+        quantized-path sweep already looks."""
+        eng = (SERVING_DIR / "engine.py").read_text()
+        assert "_record_collectives" in eng
+        # every _record_collectives call site is co-guarded: the call
+        # always receives the guarded `co` local, never self.cost
+        sites = list(re.finditer(
+            r"self\._record_collectives\(\s*([a-z_]+)", eng))
+        assert len(sites) >= 5      # unified/mtick/spec/cold/suffix
+        assert all(m.group(1) == "co" for m in sites)
+        assert "self.cost.record_collective" not in eng
+        # the sharded program handout rides the counted chokepoint
+        # with the tp tag in the key
+        for fn_name in ("_ragged_fn", "_mtick_fn", "_spec_fn",
+                        "_suffix_fn", "_prefill_fn"):
+            body = eng.split(f"def {fn_name}(")[1].split("\n    def ")[0]
+            assert "_wrap_prog" in body, fn_name
+            assert "_tptag" in body, fn_name
+        # the TP wiring lives in the swept decode module: shard_map
+        # wrapper + param/pool partition specs + the per-layer reduce
+        dec = (SERVING_DIR / "decode.py").read_text()
+        for name in ("_tp_shard", "_params_pspec", "_pool_pspec",
+                     "_tp_allreduce"):
+            assert f"def {name}(" in dec, name
+        # every layer body applies tp_reduce at BOTH sites (o-proj +
+        # down-proj) — the one-all-reduce-pair-per-layer contract
+        for fn_name in ("_packed_span_forward", "_fused_decode_tick",
+                        "_paged_suffix_prefill_impl", "_prefill_impl"):
+            body = dec.split(f"def {fn_name}(")[1].split("\ndef ")[0]
+            assert body.count("tp_reduce(o)") == 1, fn_name
+            assert body.count("tp_reduce(m)") == 1, fn_name
+
     def test_sweep_covers_the_fleet_package(self):
         """ISSUE 12 satellite: the rglob sweep must keep covering
         ``serving/fleet/`` — the fleet's router-decision/failover/
