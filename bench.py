@@ -547,6 +547,15 @@ def watchdog():
     tpj = _parse_result(rc, out)
     cb_extra["tp"] = tpj if tpj is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Tiered-prefix-cache leg: host-RAM spill tier hit-rate recovery +
+    # tier-hit vs recompute TTFT (scripts/bench_tier.py). Same
+    # hang-proof contract: CPU-forced, exact counters, byte-identical
+    # streams, banked before the tunnel can wedge.
+    rc, out, err = _run([me, "--tier"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    tj = _parse_result(rc, out)
+    cb_extra["tier"] = tj if tj is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -750,6 +759,13 @@ if __name__ == "__main__":
         from bench_tp import measure_tp
         print(json.dumps({"name": "tp", "ok": True,
                           **measure_tp(quick=True)}))
+        sys.exit(0)
+    if "--tier" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_tier import measure_tier
+        print(json.dumps({"name": "tier", "ok": True,
+                          **measure_tier(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

@@ -122,6 +122,15 @@ class CostObservatory:
         # the host boundary, and folding them into transfer totals
         # would corrupt the banked dispatch-bench baselines
         self.collectives = {}
+        # KV-tier traffic by direction (host-RAM spill tier; README
+        # "Tiered KV prefix cache") — the same separate-ledger rule as
+        # collectives: spill/readmit bytes ARE host-boundary transfers,
+        # but they are cache-plane traffic, not per-program compute
+        # I/O, and folding them into the per-program h2d/d2h records
+        # would corrupt the banked DISPATCH_BENCH.json baselines.
+        # Directions: "d2h" (spill), "h2d" (readmit), "peer" (fleet
+        # host-to-host transfer in).
+        self.tiers = {}
 
     # ------------------------------------------------------------- control
     def enable(self):
@@ -202,6 +211,27 @@ class CostObservatory:
         rec = self.collectives.get(dtype)
         return int(rec["bytes"]) if rec else 0
 
+    def record_tier(self, direction, blocks, nbytes):
+        """Account KV-tier cache-plane traffic: ``blocks`` pool blocks
+        moving ``nbytes`` bytes under ``direction`` (``d2h`` spill |
+        ``h2d`` readmit | ``peer`` fleet transfer in). Shape-derived by
+        the caller (the prefix cache's spill/readmit paths) — exact and
+        deterministic. The ``serving_tier_bytes_total{direction}``
+        counter and the ``/debug/profile`` tiers section read this."""
+        rec = self.tiers.get(direction)
+        if rec is None:
+            rec = {"blocks": 0, "bytes": 0}
+            self.tiers[direction] = rec
+        rec["blocks"] += int(blocks)
+        rec["bytes"] += int(nbytes)
+
+    def tier_bytes(self, direction) -> int:
+        """Total bytes recorded under one tier direction (0 for a
+        direction that never moved — tierless engines scrape explicit
+        zeros)."""
+        rec = self.tiers.get(direction)
+        return int(rec["bytes"]) if rec else 0
+
     # -------------------------------------------------------------- reading
     def kind_calls(self, kind) -> int:
         """Total dispatches of one program kind (the
@@ -236,7 +266,9 @@ class CostObservatory:
                 "totals": dict(self.totals),
                 "collectives": {k: dict(v)
                                 for k, v in list(
-                                    self.collectives.items())}}
+                                    self.collectives.items())},
+                "tiers": {k: dict(v)
+                          for k, v in list(self.tiers.items())}}
 
     def export(self, base=None, at=None) -> dict:
         """The cost-attribution document: aggregate, the delta since
@@ -295,8 +327,17 @@ class CostObservatory:
             if d_ops <= 0 and d_bytes <= 0:
                 continue
             collectives[dtype] = {"ops": d_ops, "bytes": d_bytes}
+        base_tr = (base or {}).get("tiers", {})
+        tiers = {}
+        for direction, rec in state.get("tiers", {}).items():
+            b = base_tr.get(direction, {})
+            d_blocks = rec["blocks"] - b.get("blocks", 0)
+            d_bytes = rec["bytes"] - b.get("bytes", 0)
+            if d_blocks <= 0 and d_bytes <= 0:
+                continue
+            tiers[direction] = {"blocks": d_blocks, "bytes": d_bytes}
         return {"programs": programs, "phases": phases, "totals": totals,
-                "collectives": collectives}
+                "collectives": collectives, "tiers": tiers}
 
 
 class _CountedProgram:

@@ -62,7 +62,7 @@ from .engine import ContinuousBatchingEngine
 from .faults import (FatalFault, FaultError, FaultPlan, TransientFault,
                      VirtualClock)
 from .kv_cache import PagedKVCache, PoolExhausted, SlotKVCache
-from .prefix_cache import PrefixCache
+from .prefix_cache import HostTier, PrefixCache
 from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
                       Sequence)
 from .scheduler import FIFOScheduler
@@ -71,6 +71,7 @@ __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
     "Sequence", "SlotKVCache", "PagedKVCache", "PoolExhausted",
     "FIFOScheduler", "FINISH_REASONS", "BlockManager", "PrefixCache",
+    "HostTier",
     "FaultPlan", "FaultError", "TransientFault", "FatalFault",
     "VirtualClock", "Drafter", "NgramDrafter", "ModelDrafter",
 ]

@@ -189,6 +189,46 @@ class BlockManager:
     def refcount(self, block: int) -> int:
         return int(self._ref[block])
 
+    # --------------------------------------------------- tier transfers
+    def read_block(self, block: int) -> dict:
+        """Copy one block device→host for the spill tier: numpy buffers
+        keyed like the pool's own arrays (``k``/``v`` and, on an int8
+        pool, ``k_scale``/``v_scale`` — the scale planes ride the same
+        block id, README "Quantized serving"). One jitted fetch program
+        per (quantized, tp) — the block id is a runtime scalar
+        (``kv_cache._tier_fetch``), so spilling never adds a trace."""
+        from .kv_cache import _tier_fetch
+        bid = np.int32(block)
+        if self.quantized:
+            bk, bv, bks, bvs = _tier_fetch(True, self.tp)(
+                self.k, self.v, self.k_scale, self.v_scale, bid)
+            return {"k": np.asarray(bk), "v": np.asarray(bv),
+                    "k_scale": np.asarray(bks), "v_scale": np.asarray(bvs)}
+        bk, bv = _tier_fetch(False, self.tp)(self.k, self.v, bid)
+        return {"k": np.asarray(bk), "v": np.asarray(bv)}
+
+    def write_block(self, block: int, bufs: dict):
+        """Stream one spilled block's host buffers back h2d into pool
+        block ``block`` (readmission). Donates the pool arrays off-CPU —
+        an in-place scatter, same discipline as the paged prefill
+        writer; on a tensor-parallel pool the program runs under
+        shard_map so the pool comes back exactly as the sharded step
+        programs expect it."""
+        from .kv_cache import _tier_inject
+        donate = jax.default_backend() != "cpu"
+        bid = np.int32(block)
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale = _tier_inject(
+                donate, True, self.tp)(
+                    self.k, self.v, self.k_scale, self.v_scale,
+                    jnp.asarray(bufs["k"]), jnp.asarray(bufs["v"]),
+                    jnp.asarray(bufs["k_scale"]),
+                    jnp.asarray(bufs["v_scale"]), bid)
+        else:
+            self.k, self.v = _tier_inject(donate, False, self.tp)(
+                self.k, self.v, jnp.asarray(bufs["k"]),
+                jnp.asarray(bufs["v"]), bid)
+
     def drop(self, block: int) -> bool:
         """Release one pin and return the block to the free heap iff the
         count hit zero. The paged cache's private-tail release: the heap

@@ -177,8 +177,18 @@ class ContinuousBatchingEngine:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  step_clock=None, spec_decode=False, spec_k=4,
                  drafter=None, decode_ticks=1, kv_dtype=None,
-                 quantize_weights=False, tp=1, collective_dtype="fp"):
+                 quantize_weights=False, tp=1, collective_dtype="fp",
+                 host_tier_bytes=0):
         c = model.config
+        # host-RAM spill tier behind the prefix trie (README "Tiered KV
+        # prefix cache"): policy, not geometry — it changes no traced
+        # shape and adds no jit key, so it never joins a jit-cache or
+        # fleet geometry tuple. 0 (default) = off, byte-identical to
+        # every banked baseline.
+        self._host_tier_bytes = int(host_tier_bytes)
+        if self._host_tier_bytes < 0:
+            raise ValueError(
+                f"host_tier_bytes must be >= 0, got {host_tier_bytes}")
         if c.decode_attention not in ("pallas", "jnp"):
             raise ValueError(
                 f"decode_attention must be 'pallas' or 'jnp', got "
@@ -354,7 +364,9 @@ class ContinuousBatchingEngine:
                     c.num_hidden_layers, live + budget, bs,
                     c.num_key_value_heads, c.head_dim, dtype=dtype,
                     kv_dtype=self._kv_dtype, mesh=tp_mesh)
-                self.prefix_cache = PrefixCache(pool, max_blocks=budget)
+                self.prefix_cache = PrefixCache(
+                    pool, max_blocks=budget,
+                    host_tier_bytes=self._host_tier_bytes)
             else:
                 pool = BlockManager(
                     c.num_hidden_layers, live, bs, c.num_key_value_heads,
@@ -395,7 +407,8 @@ class ContinuousBatchingEngine:
                         # raises rather than silently falling back to default
                     self.prefix_cache = PrefixCache(BlockManager(
                         c.num_hidden_layers, nb, bs, c.num_key_value_heads,
-                        c.head_dim, dtype=dtype))
+                        c.head_dim, dtype=dtype),
+                        host_tier_bytes=self._host_tier_bytes)
         # chunked prefill (paged only — the dense per-slot cache has no
         # block tables to resume through; its prefill stays one-shot).
         # The chunk is rounded UP to a block multiple so every non-final
@@ -561,9 +574,18 @@ class ContinuousBatchingEngine:
     def _co(self):
         """The active cost observatory, or None — THE guard every cost
         site uses (``_tr()``'s twin), so a disabled/absent observatory
-        costs one attribute check and no accounting work."""
+        costs one attribute check and no accounting work. Also the
+        chokepoint that keeps the prefix cache's tier ledger pointed at
+        the live observatory: the gateway installs ``engine.cost``
+        AFTER construction (and swaps it on rebuild), and the trie's
+        spill/readmit paths record through ``prefix_cache.cost`` — one
+        identity check per step keeps the two in sync."""
         c = self.cost
-        return c if (c is not None and c.enabled) else None
+        co = c if (c is not None and c.enabled) else None
+        pc = self.prefix_cache
+        if pc is not None and pc.cost is not co:
+            pc.cost = co
+        return co
 
     def _wrap_prog(self, key, fn, host_out):
         """The jit-cache hand-out chokepoint: every program accessor

@@ -48,8 +48,11 @@ from __future__ import annotations
 import collections
 import threading
 
+import numpy as np
+
 from ...profiler.metrics import MetricsRegistry
 from ...profiler.tracing import SpanTracer
+from ..prefix_cache import HostTier
 from ..server.gateway import GatewayClosedError, QueueFullError, \
     ServingGateway
 from .replica import FleetReplica
@@ -97,7 +100,7 @@ class EngineFleet:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  spec_decode=False, spec_k=4, drafter=None,
                  decode_ticks=1, kv_dtype=None, quantize_weights=False,
-                 tp=1, collective_dtype="fp",
+                 tp=1, collective_dtype="fp", host_tier_bytes=0,
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -129,6 +132,13 @@ class EngineFleet:
         chunk = _per_replica(prefill_chunk, n, "prefill_chunk")
         queues = _per_replica(max_queue, n, "max_queue")
         pblocks = _per_replica(prefix_blocks, n, "prefix_blocks")
+        # host_tier_bytes is POLICY, not geometry: it changes no traced
+        # shape and adds no jit key, so it never joins the geom tuple
+        # below — replicas with different tier budgets still share one
+        # jit-cache dict. With any replica tiered, submit() runs the
+        # fleet cache plane: spilled chains move host-to-host from the
+        # replica that evicted them to the replica about to need them.
+        tiers = _per_replica(host_tier_bytes, n, "host_tier_bytes")
         hooks = _per_replica(None, n, "fault_hooks") \
             if fault_hooks is None else list(fault_hooks)
         if len(hooks) != n:
@@ -182,6 +192,7 @@ class EngineFleet:
                     kv_dtype=kv_dtype,
                     quantize_weights=quantize_weights,
                     tp=tp, collective_dtype=collective_dtype,
+                    host_tier_bytes=tiers[i],
                     jit_cache=jit)
 
             gw = ServingGateway(
@@ -242,6 +253,20 @@ class EngineFleet:
             "serving_fleet_migrated_requests_total",
             "Requests moved between replicas, by cause "
             "(cause = failover|migration).")
+        self._m_tier_transfers = r.counter(
+            "serving_fleet_tier_transfers_total",
+            "Spilled prefix blocks moved host-to-host between replica "
+            "tiers by the fleet cache plane (a routed request about to "
+            "miss on its replica pulled the chain from the sibling "
+            "that spilled it).")
+        self._m_tier_transfer_bytes = r.counter(
+            "serving_fleet_tier_transfer_bytes_total",
+            "Host bytes the fleet cache plane moved between replica "
+            "tiers.")
+        # plain carried ints for /fleet/cacheplane (scrape-style reads
+        # under the submit lock, like the decisions log)
+        self._tier_transfers = 0
+        self._tier_transfer_bytes = 0
 
     # ---------------------------------------------------------- front door
     def submit(self, request):
@@ -263,6 +288,7 @@ class EngineFleet:
         last = None
         for k, rep in enumerate(order):
             try:
+                self._tier_warm(rep, request)
                 stream = rep.gateway.submit(request)
             except (QueueFullError, GatewayClosedError) as e:
                 last = e
@@ -280,6 +306,104 @@ class EngineFleet:
                           "load": rep.load()})
             return stream
         raise last
+
+    # -------------------------------------------------- fleet cache plane
+    def _tier_warm(self, rep, request):
+        """The fleet cache plane (README "Tiered KV prefix cache"):
+        before a routed request submits to ``rep``, pull any spilled
+        prefix chain it will need from a sibling replica's host tier
+        into ``rep``'s — host-to-host, by reference (tier buffers are
+        immutable by convention), addressed by content digests
+        (:meth:`HostTier.chain_digests`), so a miss on replica A that
+        hits replica B's tier becomes a local tier hit at admission:
+        prefix affinity upgraded from a routing heuristic to a
+        distributed prefix cache. Transfers extend the target's
+        coverage contiguously from its resident+tier frontier and stop
+        at the first block no sibling holds. Returns blocks moved;
+        never raises (racing a driver-side trie mutation degrades to a
+        cold route, exactly like the affinity probe)."""
+        pc = getattr(rep.gateway.engine, "prefix_cache", None)
+        if pc is None or pc.tier is None \
+                or getattr(request, "prompt", None) is None:
+            return 0
+        try:
+            prompt = np.asarray(request.prompt).reshape(-1)
+            keys = pc._blocks_of(prompt, len(prompt) - 1)
+            if not keys:
+                return 0
+            digests = HostTier.chain_digests(keys)
+            covered = len(pc.lookup(prompt, record=False))
+        except Exception:
+            return 0                # malformed prompt / racing rebuild
+        moved = moved_bytes = 0
+        path = tuple(keys[:covered])
+        for depth in range(covered, len(keys)):
+            path = path + (keys[depth],)
+            if pc.tier.has(path):
+                continue            # already local
+            entry = None
+            for donor in self.replicas:
+                if donor is rep or not donor.alive:
+                    continue
+                dpc = getattr(donor.gateway.engine, "prefix_cache", None)
+                if dpc is None or dpc.tier is None:
+                    continue
+                entry = dpc.tier.export_digest(digests[depth])
+                if entry is not None:
+                    break
+            if entry is None:
+                break               # chain must stay contiguous
+            _, bufs, nbytes = entry
+            pc.tier.put(path, bufs)
+            pc.stats["tier_transfers"] += 1
+            moved += 1
+            moved_bytes += nbytes
+            co = rep.gateway.cost
+            if co is not None:
+                co.record_tier("peer", 1, nbytes)
+        if moved:
+            with self._lock:
+                self._tier_transfers += moved
+                self._tier_transfer_bytes += moved_bytes
+            self._m_tier_transfers.inc(moved)
+            self._m_tier_transfer_bytes.inc(moved_bytes)
+            tr = self._tr()
+            if tr is not None:
+                tr.instant(
+                    "tier_transfer", tid=TID_FLEET,
+                    args={"to": rep.index, "blocks": moved,
+                          "bytes": moved_bytes})
+        return moved
+
+    def cache_plane_doc(self) -> dict:
+        """The ``GET /fleet/cacheplane`` body: per-replica tier
+        occupancy + published digest counts, and the fleet's transfer
+        totals — the distributed-prefix-cache debug surface."""
+        rows = []
+        for r in self.replicas:
+            pc = getattr(r.gateway.engine, "prefix_cache", None)
+            tier = pc.tier if pc is not None else None
+            row = {"replica": r.index, "enabled": tier is not None}
+            if tier is not None:
+                row.update(
+                    tier_blocks=tier.num_blocks,
+                    tier_bytes=tier.bytes_used,
+                    capacity_bytes=pc.host_tier_bytes,
+                    digests=len(tier.digest_table()),
+                    spilled_blocks=int(
+                        r.gateway._pc_stat("spilled_blocks")),
+                    tier_hits=int(r.gateway._pc_stat("tier_hits")),
+                    readmitted_blocks=int(
+                        r.gateway._pc_stat("readmitted_blocks")),
+                    tier_transfers_in=int(
+                        r.gateway._pc_stat("tier_transfers")))
+            rows.append(row)
+        with self._lock:
+            transfers = self._tier_transfers
+            transfer_bytes = self._tier_transfer_bytes
+        return {"replicas": rows,
+                "transfers_total": transfers,
+                "transfer_bytes_total": transfer_bytes}
 
     # ------------------------------------------------------------ failover
     def _on_replica_fatal(self, gateway, pairs):

@@ -142,6 +142,13 @@ class FleetReplica:
             row["prefix_hits"] = int(hits)
             row["prefix_hit_rate"] = round(
                 hits / max(hits + misses, 1), 4)
+            if eng.prefix_cache.tier is not None:
+                # the cache-plane columns (README "Tiered KV prefix
+                # cache"), same carried reads as /fleet/cacheplane
+                row["tier_blocks"] = eng.prefix_cache.tier.num_blocks
+                row["tier_hits"] = int(gw._pc_stat("tier_hits"))
+                row["tier_transfers_in"] = int(
+                    gw._pc_stat("tier_transfers"))
         return row
 
     def __repr__(self):

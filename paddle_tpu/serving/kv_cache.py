@@ -228,6 +228,116 @@ def copy_compilations() -> int:
                           _block_out(True), _block_out(False)))
 
 
+# ------------------------------------------------- tier transfer programs
+# The host-RAM spill tier's device side (README "Tiered KV prefix
+# cache"): fetch slices one pool block out for the d2h spill, inject
+# scatters a readmitted block back. Same compile-once rule as the block
+# copy programs above: the block id is a runtime np.int32 scalar
+# (dynamic_slice / dynamic_update_slice), so one trace per (quantized,
+# tp[, donate]) serves every block — a python-int index would bake into
+# the dispatch-cache key and compile once per block id.
+
+def _tier_fetch_impl(pool_k, pool_v, block_id):
+    # pool block [L, 1, bs, Hkv, D] -> standalone device buffers the
+    # host tier copies down (np.asarray is the d2h)
+    L, _, bs, Hkv, D = pool_k.shape
+    bk = jax.lax.dynamic_slice(pool_k, (0, block_id, 0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    bv = jax.lax.dynamic_slice(pool_v, (0, block_id, 0, 0, 0),
+                               (L, 1, bs, Hkv, D))
+    return bk, bv
+
+
+def _tier_fetch_q_impl(pool_k, pool_v, pool_ks, pool_vs, block_id):
+    # quantized twin: the int8 data block travels WITH its fp32 scale
+    # planes [L, 1, bs, Hkv] — same block id, no separate bookkeeping
+    bk, bv = _tier_fetch_impl(pool_k, pool_v, block_id)
+    L, _, bs, Hkv = pool_ks.shape
+    bks = jax.lax.dynamic_slice(pool_ks, (0, block_id, 0, 0),
+                                (L, 1, bs, Hkv))
+    bvs = jax.lax.dynamic_slice(pool_vs, (0, block_id, 0, 0),
+                                (L, 1, bs, Hkv))
+    return bk, bv, bks, bvs
+
+
+def _tier_inject_impl(pool_k, pool_v, bk, bv, block_id):
+    # readmission: one spilled block's buffers -> pool block ``block_id``
+    pk = jax.lax.dynamic_update_slice(pool_k, bk, (0, block_id, 0, 0, 0))
+    pv = jax.lax.dynamic_update_slice(pool_v, bv, (0, block_id, 0, 0, 0))
+    return pk, pv
+
+
+def _tier_inject_q_impl(pool_k, pool_v, pool_ks, pool_vs,
+                        bk, bv, bks, bvs, block_id):
+    pk, pv = _tier_inject_impl(pool_k, pool_v, bk, bv, block_id)
+    pks = jax.lax.dynamic_update_slice(pool_ks, bks, (0, block_id, 0, 0))
+    pvs = jax.lax.dynamic_update_slice(pool_vs, bvs, (0, block_id, 0, 0))
+    return pk, pv, pks, pvs
+
+
+_TIER_PROGRAMS = []   # every distinct jitted tier program, for the counter
+
+
+def _tier_pspecs(quantized, tp):
+    # the block buffer [L, 1, bs, Hkv, D] partitions on the SAME head
+    # axis as the pool (serving/decode._pool_pspec — THE spec, not a
+    # re-spelling), so fetch hands out shards the host gathers and
+    # inject hands the pool back exactly as the sharded step programs
+    # expect it
+    from jax.sharding import PartitionSpec as P
+    from .decode import _pool_pspec
+    if quantized:
+        pool, sc = _pool_pspec(True)
+        return (pool, pool, sc, sc), (pool, pool, sc, sc)
+    pool = _pool_pspec(False)
+    return (pool, pool), (pool, pool)
+
+
+@functools.lru_cache(maxsize=None)
+def _tier_fetch(quantized=False, tp=1):
+    # no donation: the spill READS the pool (eviction frees the block's
+    # id, not its storage — pool arrays are dense and preallocated)
+    impl = _tier_fetch_q_impl if quantized else _tier_fetch_impl
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+        from .decode import _tp_mesh
+        pool_specs, block_specs = _tier_pspecs(quantized, tp)
+        impl = jax.shard_map(impl, mesh=_tp_mesh(tp),
+                             in_specs=pool_specs + (P(),),
+                             out_specs=block_specs, check_vma=False)
+    fn = jax.jit(impl)
+    _TIER_PROGRAMS.append(fn)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _tier_inject(donate, quantized=False, tp=1):
+    # donate the POOL arrays (readmission updates the pool in place)
+    impl = _tier_inject_q_impl if quantized else _tier_inject_impl
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+        from .decode import _tp_mesh
+        pool_specs, block_specs = _tier_pspecs(quantized, tp)
+        impl = jax.shard_map(impl, mesh=_tp_mesh(tp),
+                             in_specs=pool_specs + block_specs + (P(),),
+                             out_specs=pool_specs, check_vma=False)
+    nargs = 4 if quantized else 2
+    fn = jax.jit(impl,
+                 donate_argnums=tuple(range(nargs)) if donate else ())
+    _TIER_PROGRAMS.append(fn)
+    return fn
+
+
+def tier_compilations() -> int:
+    """Total traces of the tier transfer programs — the spill/readmit
+    half of the bounded-compile contract: stays at one per (geometry,
+    quantized, tp, donate) no matter how many blocks spill or readmit,
+    and none of them is an engine jit-cache key, so
+    ``decode_compilations() == 1`` holds inclusive of readmitted
+    chains."""
+    return sum(fn._cache_size() for fn in list(_TIER_PROGRAMS))
+
+
 class SlotKVCache:
     """Dense per-slot KV cache — the LEGACY compatibility path.
 

@@ -86,6 +86,12 @@ def main(argv=None):
                          "num_slots * max_seq_len / block_size)")
     ap.add_argument("--prefix-block-size", type=int, default=32,
                     help="tokens per cached KV block")
+    ap.add_argument("--host-tier-bytes", type=int, default=0,
+                    help="host-RAM spill tier behind the prefix trie, in "
+                         "bytes (0 disables; needs --prefix-cache): "
+                         "evicted chains spill d2h and readmit on a hit; "
+                         "with --replicas the per-replica tiers form the "
+                         "fleet cache plane (/fleet/cacheplane)")
     ap.add_argument("--paged-attn", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="block-table paged attention (DEFAULT: the block "
@@ -227,6 +233,7 @@ def main(argv=None):
             prefix_cache=args.prefix_cache,
             prefix_blocks=args.prefix_blocks,
             prefix_block_size=args.prefix_block_size,
+            host_tier_bytes=args.host_tier_bytes,
             paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
             ragged_step=args.ragged_step,
             headroom_mult=args.headroom_mult or None,
@@ -272,7 +279,8 @@ def main(argv=None):
             "endpoints": ["/v1/completions", "/healthz", "/metrics",
                           "/debug/trace", "/debug/requests",
                           "/debug/profile", "/debug/fleet",
-                          "/fleet/drain", "/fleet/rebalance"]}),
+                          "/fleet/drain", "/fleet/rebalance",
+                          "/fleet/cacheplane"]}),
             flush=True)
         stop = threading.Event()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -288,6 +296,7 @@ def main(argv=None):
         max_queue=args.max_queue, model_name=f"llama-{args.preset}",
         prefix_cache=args.prefix_cache, prefix_blocks=args.prefix_blocks,
         prefix_block_size=args.prefix_block_size,
+        host_tier_bytes=args.host_tier_bytes,
         paged_attn=args.paged_attn, prefill_chunk=args.prefill_chunk,
         ragged_step=args.ragged_step,
         headroom_mult=args.headroom_mult or None,

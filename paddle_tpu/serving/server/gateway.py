@@ -100,8 +100,11 @@ CARRIED_ENGINE_STATS = (
     "mtick_syncs", "mtick_ticks")
 
 #: same carry for the prefix cache's own stats dict (a rebuild builds a
-#: fresh trie, zeroing hits/misses/evictions).
-CARRIED_PREFIX_STATS = ("hits", "misses", "evictions")
+#: fresh trie — and a fresh host tier — zeroing every counter here).
+CARRIED_PREFIX_STATS = ("hits", "misses", "evictions",
+                        "spilled_blocks", "tier_hits",
+                        "readmitted_blocks", "tier_evictions",
+                        "tier_transfers")
 
 
 class QueueFullError(RuntimeError):
@@ -636,6 +639,55 @@ class ServingGateway:
             r.gauge("kv_prefix_blocks_capacity",
                     "Prefix-cache pool size in blocks.").set_fn(
                 lambda: self.engine.prefix_cache.pool.num_blocks)
+            r.gauge("serving_prefix_cached_blocks",
+                    "Trie-resident cached blocks (live nodes) — the "
+                    "HBM half of the tiered cache.").set_fn(
+                lambda: self.engine.prefix_cache.num_cached_blocks)
+            # host-RAM spill tier (README "Tiered KV prefix cache"):
+            # counters registered unconditionally so a tierless engine
+            # scrapes explicit zeros; occupancy gauges read 0 with the
+            # tier off.
+            r.counter("serving_prefix_spilled_blocks_total",
+                      "Evicted trie blocks spilled device->host into "
+                      "the tier instead of deleted. Monotonic across "
+                      "engine rebuilds.").set_fn(
+                lambda: self._pc_stat("spilled_blocks"))
+            r.counter("serving_prefix_tier_hits_total",
+                      "Recording lookups that readmitted at least one "
+                      "spilled block from the host tier. Monotonic "
+                      "across engine rebuilds.").set_fn(
+                lambda: self._pc_stat("tier_hits"))
+            r.counter("serving_prefix_readmitted_blocks_total",
+                      "Spilled blocks streamed back host->device and "
+                      "re-linked as live trie nodes. Monotonic across "
+                      "engine rebuilds.").set_fn(
+                lambda: self._pc_stat("readmitted_blocks"))
+            r.counter("serving_prefix_tier_evictions_total",
+                      "Tier entries dropped by the host-side LRU under "
+                      "the host_tier_bytes budget. Monotonic across "
+                      "engine rebuilds.").set_fn(
+                lambda: self._pc_stat("tier_evictions"))
+            r.counter("serving_prefix_tier_transfers_total",
+                      "Spilled chains admitted host-to-host from a "
+                      "sibling replica's tier (the fleet cache plane). "
+                      "Monotonic across engine rebuilds.").set_fn(
+                lambda: self._pc_stat("tier_transfers"))
+            r.gauge("serving_prefix_tier_blocks",
+                    "Blocks resident in the host-RAM spill tier."
+                    ).set_fn(
+                lambda: (self.engine.prefix_cache.tier.num_blocks
+                         if self.engine.prefix_cache.tier is not None
+                         else 0))
+            r.gauge("serving_prefix_tier_bytes",
+                    "Host bytes the spill tier currently holds."
+                    ).set_fn(
+                lambda: (self.engine.prefix_cache.tier.bytes_used
+                         if self.engine.prefix_cache.tier is not None
+                         else 0))
+            r.gauge("serving_prefix_tier_bytes_capacity",
+                    "The host_tier_bytes budget (0 = tier off)."
+                    ).set_fn(
+                lambda: (self.engine.prefix_cache.host_tier_bytes))
         # device-boundary cost surface (README "Cost attribution &
         # /debug/profile"): observatory-owned, so every series is
         # monotonic across engine rebuilds by construction. One series
@@ -676,6 +728,22 @@ class ServingGateway:
             for cdt in ("fp", "int8"):
                 coll.set_fn((lambda d: lambda: co.collective_bytes(d))(
                     cdt), dtype=cdt)
+            # KV-tier cache-plane traffic by direction — the same
+            # separate-ledger rule as collectives: spill/readmit bytes
+            # never land in the per-program h2d/d2h records, so the
+            # banked DISPATCH_BENCH.json baselines stay clean.
+            # Registered up front for all three directions so tierless
+            # engines scrape explicit zeros.
+            tier = r.counter(
+                "serving_tier_bytes_total",
+                "KV prefix-tier bytes moved by direction (d2h: spill, "
+                "h2d: readmission, peer: fleet host-to-host transfer "
+                "in). A separate ledger from serving_transfer_bytes_"
+                "total — cache-plane traffic never pollutes per-program "
+                "transfer baselines. Monotonic across engine rebuilds.")
+            for tdir in ("d2h", "h2d", "peer"):
+                tier.set_fn((lambda d: lambda: co.tier_bytes(d))(tdir),
+                            direction=tdir)
             r.counter("serving_program_compiles_total",
                       "Program compile (trace) events observed at the "
                       "jit-cache chokepoint — stays flat once warm "
@@ -1476,6 +1544,25 @@ class ServingGateway:
                         bytes_per_decoded_token=round(
                             rec["bytes"] / max(tokens, 1), 3))
                     for dtype, rec in doc.get("collectives", {}).items()
+                },
+            }
+        pc = getattr(eng, "prefix_cache", None)
+        if pc is not None and pc.tier is not None:
+            # tier columns (README "Tiered KV prefix cache"): the
+            # window's spill/readmit/peer traffic (already delta'd by
+            # co.export) annotated per decoded token, plus the tier's
+            # current occupancy — so tier pressure reads directly off
+            # the profile without touching the per-program baselines
+            doc["tiers"] = {
+                "host_tier_bytes": pc.host_tier_bytes,
+                "tier_blocks": pc.tier.num_blocks,
+                "tier_bytes": pc.tier.bytes_used,
+                "per_direction": {
+                    d: dict(
+                        rec,
+                        bytes_per_decoded_token=round(
+                            rec["bytes"] / max(tokens, 1), 3))
+                    for d, rec in doc.get("tiers", {}).items()
                 },
             }
         return doc

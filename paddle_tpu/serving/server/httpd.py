@@ -210,8 +210,10 @@ class _Handler(BaseHTTPRequestHandler):
         ``replica`` column, ``/debug/trace`` snapshots the merged
         fleet+replica timeline (step-bounded windows are a
         single-engine feature — the N drivers share no step counter),
-        and ``/debug/profile`` returns per-replica cost attribution
-        plus fleet totals."""
+        ``/debug/profile`` returns per-replica cost attribution plus
+        fleet totals, and ``/fleet/cacheplane`` is the distributed
+        prefix-cache surface (per-replica tier occupancy/digests plus
+        host-to-host transfer totals)."""
         fl = self.fleet
         if path == "/healthz":
             st = fl.health_state
@@ -256,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, fl.trace_doc())
         elif path == "/debug/profile":
             self._send_json(200, fl.profile_doc())
+        elif path == "/fleet/cacheplane":
+            self._send_json(200, fl.cache_plane_doc())
         else:
             self._error(404, f"no route for GET {path}",
                         "invalid_request")
@@ -506,7 +510,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
           drafter=None, trace=False, trace_buffer=65536, cost=True,
           decode_ticks=1, kv_dtype=None, quantize_weights=False,
-          tp=1, collective_dtype="fp"):
+          tp=1, collective_dtype="fp", host_tier_bytes=0):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -615,6 +619,17 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     ``serving_collective_bytes_total{dtype}``; ``/debug/profile``
     gains the per-layer collective-bytes section. On CPU develop with
     ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+
+    ``host_tier_bytes=N`` (prefix-cache engines only, default 0 so
+    every banked baseline stays byte-identical) backs the prefix trie
+    with a host-RAM spill tier (README "Tiered KV prefix cache"):
+    evicted chains spill device→host under this byte budget with
+    their own LRU, and a later lookup that lands on a spilled chain
+    streams it back h2d and readmits through the normal allocation
+    path — streams byte-identical to the tier off, no new jit keys.
+    ``/metrics`` grows the ``serving_prefix_*`` tier counters/gauges
+    and ``serving_tier_bytes_total{direction}``; ``/debug/profile``
+    gains the tiers section.
     """
     from ..engine import ContinuousBatchingEngine
 
@@ -634,6 +649,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             decode_ticks=decode_ticks, kv_dtype=kv_dtype,
             quantize_weights=quantize_weights,
             tp=tp, collective_dtype=collective_dtype,
+            host_tier_bytes=host_tier_bytes,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
     gateway = ServingGateway(
@@ -659,7 +675,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
                 spec_k=4, drafter=None, trace=False, trace_buffer=65536,
                 cost=True, affinity_band=16, decode_ticks=1,
                 kv_dtype=None, quantize_weights=False, tp=1,
-                collective_dtype="fp"):
+                collective_dtype="fp", host_tier_bytes=0):
     """Build an engine fleet → HTTP server and start listening (README
     "Engine fleet"): ``replicas`` supervised engines — each its own
     paged pool, prefix trie and scheduler, sharing compiled programs
@@ -686,6 +702,16 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
     by ``restore()`` recompute and the streams continue
     byte-identically — zero requests lost (the fleet chaos matrix,
     tests/test_fleet.py).
+
+    ``host_tier_bytes=N`` (scalar or per-replica, default 0) gives
+    each replica a host-RAM spill tier AND turns on the fleet cache
+    plane (README "Tiered KV prefix cache"): before a routed request
+    submits, any spilled prefix chain it needs moves host-to-host
+    from the sibling tier that holds it (content-digest addressed),
+    so prefix affinity becomes a distributed prefix cache.
+    ``GET /fleet/cacheplane`` is the debug surface; ``/metrics``
+    grows ``serving_fleet_tier_transfers_total`` and
+    ``serving_fleet_tier_transfer_bytes_total``.
     """
     from ..fleet import EngineFleet, PrefixAffinityRouter
     if router == "affinity":
@@ -701,6 +727,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
         spec_k=spec_k, drafter=drafter, decode_ticks=decode_ticks,
         kv_dtype=kv_dtype, quantize_weights=quantize_weights,
         tp=tp, collective_dtype=collective_dtype,
+        host_tier_bytes=host_tier_bytes,
         registry=registry, clock=clock,
         watchdog_deadline_s=watchdog_deadline_s,
         max_restarts=max_restarts, fault_hooks=fault_hooks,

@@ -83,6 +83,7 @@ class TestBenchDriverFlow:
         assert art["dispatch"]["ok"] is False
         assert art["density"]["ok"] is False
         assert art["tp"]["ok"] is False
+        assert art["tier"]["ok"] is False
         assert any(c["mfu"] == pytest.approx(0.4548)
                    for c in art["prior_configs"])
 
@@ -192,6 +193,16 @@ class TestBenchDriverFlow:
                      "greedy_divergence": {"divergence_rate": 0.0},
                      "int8_deterministic": True,
                      "accepted": True}), ""
+            if leg == "--tier":
+                # tiered-prefix-cache leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps(
+                    {"name": "tier", "ok": True,
+                     "tokens_equal": True,
+                     "compile_once": True,
+                     "hit_rate_ratio": 5.0,
+                     "ttft_recompute_over_tier_hit": 2.01,
+                     "accepted": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -226,11 +237,12 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:12] == ["--decode-cb", "--serve-http",
+        assert order[:13] == ["--decode-cb", "--serve-http",
                               "--prefix-cache", "--paged-attn",
                               "--chunked-prefill", "--ragged", "--spec",
                               "--chaos", "--trace-overhead",
-                              "--dispatch", "--density", "--tp"]
+                              "--dispatch", "--density", "--tp",
+                              "--tier"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -262,6 +274,10 @@ class TestBenchDriverFlow:
         assert art["tp"]["tokens_equal"] is True
         assert art["tp"]["compile_once"] == {"tp1": 1, "tp2": 1}
         assert art["tp"]["collective_bytes_reduction"] == 3.92
+        # the tiered-prefix-cache leg rides the same banked artifact
+        assert art["tier"]["accepted"] is True
+        assert art["tier"]["hit_rate_ratio"] == 5.0
+        assert art["tier"]["ttft_recompute_over_tier_hit"] == 2.01
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

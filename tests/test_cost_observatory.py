@@ -156,6 +156,196 @@ class TestCostObservatoryUnit:
         assert isinstance(eng._prefill_fn(), _CountedProgram)
 
 
+# ----------------------------------------------------------- tier ledger
+class TestTierLedger:
+    """ISSUE 16 satellite: KV-tier traffic (spill d2h / readmit h2d /
+    fleet peer transfer) gets its OWN ledger — mirroring the PR-15
+    collectives rule — so cache-plane bytes never pollute the
+    per-program h2d/d2h baselines DISPATCH_BENCH.json banks."""
+
+    def test_record_tier_unit_and_separation(self):
+        co = CostObservatory(clock=VirtualClock())
+        co.record_tier("d2h", 2, 4096)
+        co.record_tier("d2h", 1, 2048)
+        co.record_tier("h2d", 1, 2048)
+        assert co.tier_bytes("d2h") == 6144
+        assert co.tier_bytes("h2d") == 2048
+        assert co.tier_bytes("peer") == 0      # unseen: explicit zero
+        assert co.tiers["d2h"] == {"blocks": 3, "bytes": 6144}
+        # THE SEPARATE-LEDGER RULE: tier traffic never touches the
+        # per-program transfer totals or the dispatch count
+        assert co.totals["h2d_bytes"] == 0
+        assert co.totals["d2h_bytes"] == 0
+        assert co.totals["dispatches"] == 0
+
+    def test_export_delta_and_snapshot_carry_tiers(self):
+        co = CostObservatory(clock=VirtualClock())
+        co.record_tier("d2h", 1, 100)
+        base = co.snapshot_full()
+        assert base["tiers"]["d2h"] == {"blocks": 1, "bytes": 100}
+        co.record_tier("d2h", 2, 200)
+        co.record_tier("peer", 1, 50)
+        doc = co.export(base=base)
+        assert doc["tiers"] == {"d2h": {"blocks": 2, "bytes": 200},
+                                "peer": {"blocks": 1, "bytes": 50}}
+        full = co.export()
+        assert full["tiers"]["d2h"] == {"blocks": 3, "bytes": 300}
+        json.dumps(full)                       # JSON-serializable
+
+    def test_engine_tier_traffic_never_pollutes_program_baselines(
+            self, model):
+        """A thrashed tiered engine moves real spill/readmit bytes —
+        and the per-program totals still equal exactly the sum over
+        the program records, as if the tier did not exist."""
+        fams = [np.random.RandomState(900 + f).randint(
+            0, 256, (16,)).astype(np.int32) for f in range(2)]
+        reqs = []
+        for i in range(3):
+            for f in range(2):
+                tail = np.random.RandomState(10 * f + i).randint(
+                    0, 256, (5,)).astype(np.int32)
+                reqs.append(GenerationRequest(
+                    prompt=np.concatenate([fams[f], tail]),
+                    max_new_tokens=3))
+        eng = ContinuousBatchingEngine(
+            model, num_slots=NUM_SLOTS, max_seq_len=S_MAX,
+            decode_chunk=1, paged_attn=False, prefix_cache=True,
+            prefix_block_size=8, prefix_blocks=2,
+            host_tier_bytes=1 << 24, jit_cache={})
+        co = CostObservatory()
+        eng.cost = co
+        for r in reqs:     # serial: each publish thrashes the 2-block pool
+            eng.generate([r])
+        pc = eng.prefix_cache
+        assert pc.stats["spilled_blocks"] > 0
+        assert pc.stats["readmitted_blocks"] > 0
+        assert co.tier_bytes("d2h") > 0 and co.tier_bytes("h2d") > 0
+        # bytes moved match the ledger's own block count × block bytes
+        assert co.tiers["d2h"]["bytes"] == \
+            pc.stats["spilled_blocks"] * pc.pool.block_nbytes
+        assert co.tiers["h2d"]["bytes"] == \
+            pc.stats["readmitted_blocks"] * pc.pool.block_nbytes
+        # separation: totals are exactly the per-program sums
+        progs = list(co.programs.values())
+        assert co.totals["h2d_bytes"] == \
+            sum(rec["h2d_bytes"] for rec in progs)
+        assert co.totals["d2h_bytes"] == \
+            sum(rec["d2h_bytes"] for rec in progs)
+
+    def test_gateway_tier_series_and_profile_doc(self, model):
+        """``serving_tier_bytes_total{direction}`` scrapes from a
+        tiered gateway (d2h/h2d > 0, peer an explicit 0 — all three
+        series exist), the ``serving_prefix_*`` tier counters/gauges
+        agree with the trie's stats, and ``/debug/profile`` carries
+        the tiers section without touching per-program columns."""
+        fams = [np.random.RandomState(910 + f).randint(
+            0, 256, (16,)).astype(np.int32) for f in range(2)]
+        eng = ContinuousBatchingEngine(
+            model, num_slots=NUM_SLOTS, max_seq_len=S_MAX,
+            decode_chunk=1, paged_attn=False, prefix_cache=True,
+            prefix_block_size=8, prefix_blocks=2,
+            host_tier_bytes=1 << 24, jit_cache={})
+        gw = ServingGateway(eng, start=False)  # installs gw.cost on eng
+        for i in range(3):
+            for f in range(2):
+                tail = np.random.RandomState(20 * f + i).randint(
+                    0, 256, (5,)).astype(np.int32)
+                eng.generate([GenerationRequest(
+                    prompt=np.concatenate([fams[f], tail]),
+                    max_new_tokens=3)])
+        pc = eng.prefix_cache
+        assert pc.stats["spilled_blocks"] > 0
+        fams_p = parse_prometheus(gw.registry.render())
+
+        def val(name, **labels):
+            key = tuple(sorted(labels.items()))
+            return fams_p[name]["samples"][(name, key)]
+
+        assert val("serving_tier_bytes_total", direction="d2h") == \
+            gw.cost.tier_bytes("d2h") > 0
+        assert val("serving_tier_bytes_total", direction="h2d") == \
+            gw.cost.tier_bytes("h2d") > 0
+        assert val("serving_tier_bytes_total", direction="peer") == 0
+        assert val("serving_prefix_spilled_blocks_total") == \
+            pc.stats["spilled_blocks"]
+        assert val("serving_prefix_tier_hits_total") == \
+            pc.stats["tier_hits"] > 0
+        assert val("serving_prefix_readmitted_blocks_total") == \
+            pc.stats["readmitted_blocks"] > 0
+        assert val("serving_prefix_tier_blocks") == pc.tier.num_blocks > 0
+        assert val("serving_prefix_tier_bytes") == pc.tier.bytes_used > 0
+        assert val("serving_prefix_tier_bytes_capacity") == 1 << 24
+        assert val("serving_prefix_cached_blocks") == \
+            pc.num_cached_blocks
+        doc = gw.profile_doc()
+        tiers = doc["tiers"]
+        assert tiers["host_tier_bytes"] == 1 << 24
+        assert tiers["tier_blocks"] == pc.tier.num_blocks
+        assert tiers["per_direction"]["d2h"]["bytes"] == \
+            gw.cost.tier_bytes("d2h")
+        assert "bytes_per_decoded_token" in tiers["per_direction"]["d2h"]
+
+    def test_tierless_gateway_scrapes_explicit_zeros(self, model):
+        gw = ServingGateway(ContinuousBatchingEngine(
+            model, num_slots=NUM_SLOTS, max_seq_len=S_MAX,
+            decode_chunk=1, prefix_cache=True, prefix_block_size=8,
+            jit_cache={}), start=False)
+        fams_p = parse_prometheus(gw.registry.render())
+        s = fams_p["serving_tier_bytes_total"]["samples"]
+        for tdir in ("d2h", "h2d", "peer"):
+            assert s[("serving_tier_bytes_total",
+                      (("direction", tdir),))] == 0
+        assert fams_p["serving_prefix_tier_bytes_capacity"]["samples"][
+            ("serving_prefix_tier_bytes_capacity", ())] == 0
+        # same idiom as collectives on a tp=1 engine: the export key
+        # exists, empty — no occupancy section is synthesized
+        assert gw.profile_doc()["tiers"] == {}
+
+    def test_tier_counters_monotonic_across_rebuild(self, model):
+        """A crash-recovery rebuild starts a fresh trie AND a fresh
+        host tier, zeroing their stats — the gateway banks the dead
+        incarnation's tier counts (CARRIED_PREFIX_STATS) so the
+        ``serving_prefix_*`` tier series stay monotonic."""
+        jit = {}
+        fams = [np.random.RandomState(920 + f).randint(
+            0, 256, (16,)).astype(np.int32) for f in range(2)]
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, num_slots=NUM_SLOTS, max_seq_len=S_MAX,
+                decode_chunk=1, paged_attn=False, prefix_cache=True,
+                prefix_block_size=8, prefix_blocks=2,
+                host_tier_bytes=1 << 24, jit_cache=jit)
+
+        plan = FaultPlan().at_step(8, "fatal")
+        gw = ServingGateway(factory(), engine_factory=factory,
+                            fault_hook=plan, retry_backoff_s=0.0,
+                            start=False)
+        reqs = []
+        for i in range(3):
+            for f in range(2):
+                tail = np.random.RandomState(30 * f + i).randint(
+                    0, 256, (5,)).astype(np.int32)
+                reqs.append(GenerationRequest(
+                    prompt=np.concatenate([fams[f], tail]),
+                    max_new_tokens=3))
+        gw.start()
+        for r in reqs:             # serial: publishes land in order
+            gw.submit(r).result()
+        assert gw.restarts >= 1
+        # the dead incarnation spilled before dying, and its counts
+        # were banked into the carried base at the rebuild
+        pc_base = gw._counter_state[1]
+        assert pc_base["spilled_blocks"] > 0
+        total = gw._pc_stat("spilled_blocks")
+        assert total == pc_base["spilled_blocks"] + \
+            gw.engine.prefix_cache.stats["spilled_blocks"]
+        fams_p = parse_prometheus(gw.registry.render())
+        assert fams_p["serving_prefix_spilled_blocks_total"]["samples"][
+            ("serving_prefix_spilled_blocks_total", ())] == total
+        gw.shutdown(drain=True, timeout=30)
+
+
 # ------------------------------------------------------------ exactness
 class TestExactAccounting:
     CONFIGS = (
@@ -667,6 +857,40 @@ class TestGuardDiscipline:
             body = dec.split(f"def {fn_name}(")[1].split("\ndef ")[0]
             assert body.count("tp_reduce(o)") == 1, fn_name
             assert body.count("tp_reduce(m)") == 1, fn_name
+
+    def test_sweep_sees_the_tier_path(self):
+        """ISSUE 16 satellite: the KV-tier spill/readmit/transfer call
+        sites live inside the swept tree and stay guard-disciplined.
+        The trie has no driver-installed tracer of its own, so the
+        engine's ``_co()`` is the ONE chokepoint that hands it the
+        observatory (``pc.cost`` sync) — and every ``record_tier``
+        site reads a None-guarded local, never ``self.cost`` raw. The
+        transfer programs ride the compile-once lru-cache registry
+        (``kv_cache.tier_compilations``), so spilling a block can
+        never add a jit key a future refactor would miss."""
+        pcs = (SERVING_DIR / "prefix_cache.py").read_text()
+        # spill (d2h) and readmit (h2d) both record through the
+        # guarded local; no raw self.cost touch anywhere in the trie
+        assert "co = self.cost" in pcs
+        assert "self.cost.record_tier" not in pcs
+        assert len(re.findall(r"co\.record_tier\(", pcs)) >= 2
+        flt = (SERVING_DIR / "fleet" / "fleet.py").read_text()
+        assert "self.cost.record_tier" not in flt
+        assert re.search(r"co\.record_tier\(\s*\"peer\"", flt)
+        # the engine's _co() guard is where the trie gets (and loses)
+        # its observatory — one attribute sync, same discipline as the
+        # handout guards
+        eng = (SERVING_DIR / "engine.py").read_text()
+        co_fn = eng.split("def _co(")[1].split("\n    def ")[0]
+        assert "prefix_cache" in co_fn and "pc.cost" in co_fn
+        # compile-once transfer pair: runtime-scalar block ids through
+        # the registered lru-cached programs, counted by the accessor
+        kvc = (SERVING_DIR / "kv_cache.py").read_text()
+        for name in ("_tier_fetch", "_tier_inject", "tier_compilations"):
+            assert f"def {name}(" in kvc, name
+        assert "_TIER_PROGRAMS" in kvc
+        bm = (SERVING_DIR / "block_manager.py").read_text()
+        assert "_tier_fetch" in bm and "_tier_inject" in bm
 
     def test_sweep_covers_the_fleet_package(self):
         """ISSUE 12 satellite: the rglob sweep must keep covering

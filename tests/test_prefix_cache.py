@@ -17,6 +17,9 @@ The load-bearing properties:
 - **LRU eviction** under pool pressure degrades hit-rate, never
   correctness; exhausted-pool publishes skip instead of failing.
 """
+import collections
+import zlib
+
 import numpy as np
 import pytest
 
@@ -344,6 +347,120 @@ class TestConstruction:
     def test_prefix_blocks_zero_rejected_not_defaulted(self, model):
         with pytest.raises(ValueError, match="num_blocks"):
             _engine(model, prefix_blocks=0)
+
+
+class TestTrieInvariantsRandomized:
+    """ISSUE 16 satellite: randomized interleavings of publish /
+    acquire / release / evict — with the host tier spilling and
+    readmitting underneath — uphold the trie's structural invariants
+    at every step:
+
+    - no orphaned interior node (every resident node is reachable from
+      the root with consistent parent/child links, and node count ==
+      pool occupancy — nothing leaks, nothing aliases);
+    - a pinned chain is never evicted (its nodes stay reachable while
+      held);
+    - refcounts equal the live pins exactly, and drain to zero;
+    - the tier never exceeds its byte budget;
+    - spill/readmit preserves block CONTENT: each published block
+      carries a value derived from its full token path, and whatever
+      is resident after any amount of churn still holds its path's
+      exact bytes.
+    """
+
+    NB, BSU = 6, 4          # 6-block pool, 4-token blocks
+    SHAPE = (1, 1, BSU, 1, 2)   # one block: [L, 1, bs, Hkv, D]
+
+    def _expected(self, path):
+        v = float(zlib.crc32(repr(path).encode()) % 65536)
+        return {"k": np.full(self.SHAPE, v, np.float32),
+                "v": np.full(self.SHAPE, v + 0.5, np.float32)}
+
+    class _ContentKV:
+        """publish()-facing stand-in whose copy_block_out writes the
+        path-derived content through the pool's own h2d program."""
+
+        def __init__(self, test, pc):
+            self.test, self.pc, self.tokens = test, pc, None
+
+        def copy_block_out(self, slot, row0, pool, block):
+            i = row0 // pool.block_size
+            path = tuple(self.pc._blocks_of(self.tokens,
+                                            len(self.tokens))[:i + 1])
+            pool.write_block(block, self.test._expected(path))
+
+    def _check(self, pc, pool, held, content=False):
+        nodes, stack = [], [(None, pc._root)]
+        while stack:
+            parent, children = stack.pop()
+            for key, node in children.items():
+                assert node.tokens == key          # key/identity agree
+                assert node.parent is parent       # no orphaned interior
+                nodes.append(node)
+                stack.append((node, node.children))
+        assert len(nodes) == pc._nodes == pool.num_used
+        ids = [n.block_id for n in nodes]
+        assert len(set(ids)) == len(ids)           # no block aliased
+        want = collections.Counter()
+        for chain in held:
+            for n in chain:
+                want[n.block_id] += 1
+        for b in range(pool.num_blocks):
+            assert pool.refcount(b) == want.get(b, 0)
+        reachable = {id(n) for n in nodes}
+        for chain in held:                         # pinned never evicted
+            for n in chain:
+                assert id(n) in reachable
+        assert pc.tier.bytes_used <= pc.tier.capacity_bytes
+        if content:
+            for n in nodes:
+                path = pc._path_of(n)
+                got = pool.read_block(n.block_id)
+                exp = self._expected(path)
+                np.testing.assert_array_equal(got["k"], exp["k"])
+                np.testing.assert_array_equal(got["v"], exp["v"])
+
+    def test_random_interleavings_uphold_invariants(self):
+        rng = np.random.RandomState(17)
+        pool = BlockManager(1, self.NB, self.BSU, 1, 2)
+        # tier budget of 4 blocks (64 B each): tier-side LRU trims and
+        # descendant cascades fire too, not just spill/readmit
+        pc = PrefixCache(pool, host_tier_bytes=4 * 64)
+        kv = self._ContentKV(self, pc)
+        # small alphabet + short lengths: prompts share prefixes often
+        prompts = [rng.randint(0, 3, (int(n),)).astype(np.int32)
+                   for n in rng.randint(4, 18, size=12)]
+        held = []
+        for step in range(150):
+            op = rng.rand()
+            prompt = prompts[rng.randint(len(prompts))]
+            if op < 0.35:
+                kv.tokens = prompt
+                pc.publish(prompt, 0, kv)
+            elif op < 0.65:
+                m = pc.lookup(prompt)       # may readmit from the tier
+                if m:
+                    pc.acquire(m)
+                    held.append(m)
+            elif op < 0.9 and held:
+                pc.release(held.pop(rng.randint(len(held))))
+            else:
+                pc._evict_one()
+            self._check(pc, pool, held, content=(step % 10 == 9))
+        # churn actually exercised every path
+        assert pc.stats["evictions"] > 0
+        assert pc.stats["spilled_blocks"] > 0
+        assert pc.stats["readmitted_blocks"] > 0
+        assert pc.stats["tier_evictions"] > 0      # tier LRU trimmed too
+        # drain: release every pin, evict everything — refs to zero,
+        # trie and pool empty, no stranded bookkeeping
+        for chain in held:
+            pc.release(chain)
+        self._check(pc, pool, [], content=True)
+        while pc._evict_one():
+            pass
+        assert pc._nodes == 0 and pool.num_used == 0
+        assert not pool._ref.any()
 
 
 class TestBlockManagerUnit:
