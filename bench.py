@@ -556,6 +556,16 @@ def watchdog():
     tj = _parse_result(rc, out)
     cb_extra["tier"] = tj if tj is not None else \
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
+    # Multi-tenant SLO leg: latency-class TTFT p95 under a batch flood,
+    # policy on vs off on a virtual-clock replay (scripts/bench_slo.py)
+    # — byte-identical streams, bounded batch tax. Same hang-proof
+    # contract: CPU-forced, deterministic, banked before the tunnel can
+    # wedge anything.
+    rc, out, err = _run([me, "--slo"], 300,
+                        env={"JAX_PLATFORMS": "cpu"})
+    sl = _parse_result(rc, out)
+    cb_extra["slo"] = sl if sl is not None else \
+        {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     _flush_self_bench([], extra=cb_extra, prior=_load_prior_configs())
 
     last_err = "unknown"
@@ -766,6 +776,13 @@ if __name__ == "__main__":
         from bench_tier import measure_tier
         print(json.dumps({"name": "tier", "ok": True,
                           **measure_tier(quick=True)}))
+        sys.exit(0)
+    if "--slo" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        from bench_slo import measure_slo
+        print(json.dumps({"name": "slo", "ok": True,
+                          **measure_slo(quick=True)}))
         sys.exit(0)
     if "--decode" in sys.argv:
         pos = sys.argv.index("--decode") + 1

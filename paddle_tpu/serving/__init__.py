@@ -23,6 +23,13 @@ Public surface:
   trie (README "Paged attention")
 - :class:`FIFOScheduler` — admission + fused-chunk step policy +
   chunked-prefill token budgeting
+- :class:`PriorityClass` / :class:`ClassTable` /
+  :class:`PolicyScheduler` — multi-tenant SLO policy (README
+  "Multi-tenant SLO serving"): priority classes with TTFT/TPOT
+  targets, deadline-aware admission with per-class headroom and
+  anti-starvation aging, SLO-driven preemption of lower-class work
+  (engine ``priority_classes=...``; the default single class keeps
+  the FIFO baseline byte-identical)
 - :class:`ContinuousBatchingEngine` — the step-function serving API
   (``cancel()``, deadline sweeps, ``on_token``/``on_finish`` streaming
   hooks; ``prefix_cache=True`` turns on automatic prefix caching;
@@ -62,6 +69,7 @@ from .engine import ContinuousBatchingEngine
 from .faults import (FatalFault, FaultError, FaultPlan, TransientFault,
                      VirtualClock)
 from .kv_cache import PagedKVCache, PoolExhausted, SlotKVCache
+from .policy import ClassTable, PolicyScheduler, PriorityClass
 from .prefix_cache import HostTier, PrefixCache
 from .request import (FINISH_REASONS, GenerationRequest, GenerationResult,
                       Sequence)
@@ -71,7 +79,7 @@ __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest", "GenerationResult",
     "Sequence", "SlotKVCache", "PagedKVCache", "PoolExhausted",
     "FIFOScheduler", "FINISH_REASONS", "BlockManager", "PrefixCache",
-    "HostTier",
+    "HostTier", "PriorityClass", "ClassTable", "PolicyScheduler",
     "FaultPlan", "FaultError", "TransientFault", "FatalFault",
     "VirtualClock", "Drafter", "NgramDrafter", "ModelDrafter",
 ]

@@ -38,6 +38,7 @@ from .decode import build_decode_steps_fn, build_paged_decode_steps_fn, \
     build_paged_suffix_prefill_fn, build_prefill_fn, build_ragged_step_fn, \
     build_suffix_prefill_fn, llama_decode_params
 from .kv_cache import PagedKVCache, PoolExhausted, SlotKVCache
+from .policy import ClassTable, PolicyScheduler, select_victims
 from .request import GenerationRequest, GenerationResult, Sequence
 from .scheduler import FIFOScheduler
 
@@ -178,8 +179,16 @@ class ContinuousBatchingEngine:
                  step_clock=None, spec_decode=False, spec_k=4,
                  drafter=None, decode_ticks=1, kv_dtype=None,
                  quantize_weights=False, tp=1, collective_dtype="fp",
-                 host_tier_bytes=0):
+                 host_tier_bytes=0, priority_classes=None):
         c = model.config
+        # multi-tenant SLO policy (README "Multi-tenant SLO serving"):
+        # like host_tier_bytes, policy not geometry — classes change
+        # admission order and preemption choices, never a traced shape
+        # or a jit key. The default None is the single neutral class:
+        # the plain FIFO scheduler is kept and every banked baseline
+        # stays byte-identical.
+        self.classes = ClassTable.coerce(priority_classes)
+        self._policy = self.classes.active
         # host-RAM spill tier behind the prefix trie (README "Tiered KV
         # prefix cache"): policy, not geometry — it changes no traced
         # shape and adds no jit key, so it never joins a jit-cache or
@@ -510,7 +519,16 @@ class ContinuousBatchingEngine:
         # ~headroom_mult x)
         self._tps_ewma = None
         self._dt_decode_ewma = None
-        self.scheduler = FIFOScheduler(decode_chunk)
+        if self._policy:
+            # clock + slot ledger bound late: the closures read the
+            # live attributes at decision time, so the injected
+            # step_clock and rebuilt slot arrays are always current
+            self.scheduler = PolicyScheduler(
+                decode_chunk, table=self.classes,
+                clock=lambda: self._clock(),
+                slot_usage=self._class_slot_usage)
+        else:
+            self.scheduler = FIFOScheduler(decode_chunk)
         self._slots = [None] * self.num_slots
         self._last_tok = np.zeros(self.num_slots, np.int32)
         self._temps = np.zeros(self.num_slots, np.float32)
@@ -535,7 +553,8 @@ class ContinuousBatchingEngine:
                       "headroom": self._chunk or 0, "headroom_tps": 0.0,
                       "last_step_duration_s": 0.0, "last_step_tokens": 0,
                       "tokens_generated": 0, "cancelled": 0, "timeouts": 0,
-                      "preemptions": 0, "restores": 0}
+                      "preemptions": 0, "restores": 0,
+                      "policy_preemptions": 0}
         # fault-injection hook (serving/faults.py): called with the
         # engine at the top of every step attempt; None in production.
         # Whatever it raises propagates to the driver — except
@@ -562,6 +581,12 @@ class ContinuousBatchingEngine:
         # thread driving step() — keep them cheap and non-reentrant.
         self.on_token = None
         self.on_finish = None
+        # policy-preemption hook: on_policy_preempt(victim_seq) fires
+        # just before an SLO-driven displacement (the gateway's per-
+        # victim-class counter). Same thread/cheapness contract as
+        # on_token/on_finish. None on a policy-off engine — the step
+        # loop never consults policy there.
+        self.on_policy_preempt = None
 
     # ------------------------------------------------------------- tracing
     def _tr(self):
@@ -934,6 +959,9 @@ class ContinuousBatchingEngine:
         if request.timeout_s is not None and float(request.timeout_s) <= 0:
             raise ValueError(
                 f"timeout_s must be > 0, got {request.timeout_s}")
+        # unknown priority_class raises here — on the caller's thread,
+        # so the HTTP front door 400s instead of poisoning the driver
+        self.classes.resolve(request.priority_class)
 
     def submit(self, request) -> Sequence:
         """Queue a request; returns its live Sequence handle."""
@@ -942,6 +970,7 @@ class ContinuousBatchingEngine:
                     if request.timeout_s is not None else None)
         seq = Sequence(request, key=self._key_for(request),
                        submit_step=self.stats["steps"], deadline=deadline)
+        seq.pclass = self.classes.resolve(request.priority_class)
         seq.t_submit = self._clock()
         tr = self._tr()
         if tr is not None:
@@ -1440,6 +1469,13 @@ class ContinuousBatchingEngine:
                 if self.fault_hook is not None:
                     self.fault_hook(self)
                 if attempt == 0:
+                    if self._policy:
+                        # policy decisions record through the step's
+                        # already-guarded tracer; displace best-effort
+                        # work BEFORE admission so the freed slots are
+                        # in num_free for this very step's admission
+                        self.scheduler.tracer = tr
+                        self._policy_preempt()
                     admitted = self.scheduler.admissions(
                         self.cache.num_free,
                         hit_len_fn=self._admission_hit_len
@@ -1539,6 +1575,57 @@ class ContinuousBatchingEngine:
                 seq.trace_phase = "queued"
                 seq.trace_mark = tr.now() if tr is not None else None
             self.scheduler.requeue_front(seq)
+
+    def _class_slot_usage(self):
+        """Running-count-per-class-name ledger for the policy
+        scheduler's headroom math: a walk of the slot array (prefilling
+        sequences hold slots and count — a reservation is about slot
+        occupancy, not decode state)."""
+        used = {}
+        for seq in self._slots:
+            if seq is None or seq.done:
+                continue
+            pclass = getattr(seq, "pclass", None)
+            if pclass is not None:
+                used[pclass.name] = used.get(pclass.name, 0) + 1
+        return used
+
+    def _policy_preempt(self):
+        """SLO-driven preemption (README "Multi-tenant SLO serving"):
+        when queued requests have burned past the urgency fraction of
+        their TTFT budget and free slots cannot cover them, displace
+        one strictly-lower-rank running sequence per uncovered urgent
+        request through the ordinary preemption-by-recompute path
+        (:meth:`_preempt` — chain donated to the trie, PRNG walk
+        snapshotted, stream byte-identical after restore). Runs before
+        admission at the top of the step, inside the step's stamp
+        window, so urgency and victim choice replay deterministically
+        under an injected clock. With nothing below the urgent rank in
+        the slots, the request keeps waiting — equals never displace
+        equals."""
+        urgent = self.scheduler.urgent(self._stamp_t)
+        if not urgent:
+            return
+        free = self.cache.num_free
+        tr = self._tr()
+        for seq in urgent[free:]:
+            pclass = getattr(seq, "pclass", None)
+            rank = pclass.rank if pclass is not None else 0
+            victims = select_victims(self._slots, 1, rank)
+            if not victims:
+                continue
+            victim = victims[0]
+            self.stats["policy_preemptions"] += 1
+            if tr is not None:
+                tr.instant(
+                    "policy_preempt",
+                    args={"urgent": seq.request_id,
+                          "victim": victim.request_id,
+                          "victim_class": getattr(
+                              victim.pclass, "name", None)})
+            if self.on_policy_preempt is not None:
+                self.on_policy_preempt(victim)
+            self._preempt(victim)
 
     def _preempt_youngest(self) -> bool:
         """PoolExhausted repair: displace the YOUNGEST slot-holding

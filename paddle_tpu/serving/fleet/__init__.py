@@ -14,7 +14,10 @@ Public surface:
   signals + the ``/debug/fleet`` row);
 - :class:`Router` / :class:`RoundRobinRouter` /
   :class:`LeastLoadedRouter` / :class:`PrefixAffinityRouter` /
-  :func:`make_router` — the pluggable routing policies.
+  :class:`ClassHeadroomRouter` / :func:`make_router` — the pluggable
+  routing policies (``class-headroom`` routes by per-replica
+  non-displaceable class pressure — README "Multi-tenant SLO
+  serving").
 
 The HTTP surface (``--replicas N`` / ``serve_fleet()``: routed
 ``/v1/completions``, ``GET /debug/fleet``, ``POST /fleet/drain`` and
@@ -23,10 +26,12 @@ The HTTP surface (``--replicas N`` / ``serve_fleet()``: routed
 """
 from .fleet import EngineFleet
 from .replica import FleetReplica
-from .router import (LeastLoadedRouter, PrefixAffinityRouter,
-                     RoundRobinRouter, Router, make_router)
+from .router import (ClassHeadroomRouter, LeastLoadedRouter,
+                     PrefixAffinityRouter, RoundRobinRouter, Router,
+                     make_router)
 
 __all__ = [
     "EngineFleet", "FleetReplica", "Router", "RoundRobinRouter",
-    "LeastLoadedRouter", "PrefixAffinityRouter", "make_router",
+    "LeastLoadedRouter", "PrefixAffinityRouter", "ClassHeadroomRouter",
+    "make_router",
 ]

@@ -101,6 +101,7 @@ class EngineFleet:
                  spec_decode=False, spec_k=4, drafter=None,
                  decode_ticks=1, kv_dtype=None, quantize_weights=False,
                  tp=1, collective_dtype="fp", host_tier_bytes=0,
+                 priority_classes=None,
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -139,6 +140,12 @@ class EngineFleet:
         # fleet cache plane: spilled chains move host-to-host from the
         # replica that evicted them to the replica about to need them.
         tiers = _per_replica(host_tier_bytes, n, "host_tier_bytes")
+        # the class table is POLICY too (the host_tier_bytes rule): one
+        # parsed table shared fleet-wide — admission/preemption policy
+        # must agree across replicas or a migrated request would change
+        # tier — and it never joins the geom tuple
+        from ..policy import ClassTable
+        self.classes = ClassTable.coerce(priority_classes)
         hooks = _per_replica(None, n, "fault_hooks") \
             if fault_hooks is None else list(fault_hooks)
         if len(hooks) != n:
@@ -193,6 +200,7 @@ class EngineFleet:
                     quantize_weights=quantize_weights,
                     tp=tp, collective_dtype=collective_dtype,
                     host_tier_bytes=tiers[i],
+                    priority_classes=self.classes,
                     jit_cache=jit)
 
             gw = ServingGateway(

@@ -105,6 +105,84 @@ class FleetReplica:
         except Exception:
             return 0        # racing a driver-side trie mutation: cold
 
+    def tier_match_tokens(self, prompt) -> int:
+        """Tokens of ``prompt`` this replica's OWN host tier could
+        readmit beyond the trie frontier: the contiguous run of
+        spilled blocks continuing the trie match (README "Tiered KV
+        prefix cache" — the PR-16 capacity-aware placement follow-on).
+        The affinity router adds it to :meth:`prefix_match_tokens`, so
+        a chain that spilled under pool pressure still attracts its
+        prefix family to the replica that HOLDS it (a host-RAM readmit)
+        instead of a sibling that would pull it host-to-host over the
+        cache plane. Side-effect-free like the trie probe; 0 on
+        tierless replicas, so every existing routing order is
+        unchanged."""
+        pc = self.gateway.engine.prefix_cache
+        if pc is None or prompt is None or pc.tier is None:
+            return 0
+        try:
+            # len-1 bound like every admission-side probe: a lookup
+            # never covers the final prompt token (the suffix prefill
+            # needs one token to sample from)
+            keys = pc._blocks_of(prompt, len(prompt) - 1)
+            covered = len(pc.lookup(prompt, record=False))
+            n = 0
+            for depth in range(covered, len(keys)):
+                if not pc.tier.has(keys[:depth + 1]):
+                    break
+                n += 1
+            return pc.block_size * n
+        except Exception:
+            return 0        # racing a driver-side tier mutation: cold
+
+    def class_counts(self) -> dict:
+        """Per-class occupancy ``{class_name: count}`` over this
+        replica's engine-held work — running/prefilling slots plus the
+        scheduler queue (the gateway intake is not yet classed). A
+        scrape-style read like :meth:`load`."""
+        eng = self.gateway.engine
+        counts = {}
+        try:
+            seqs = [s for s in eng._slots if s is not None and not s.done]
+            seqs += [s for s in eng.scheduler.queue
+                     if getattr(s, "done", False) is False]
+            for seq in seqs:
+                pclass = getattr(seq, "pclass", None)
+                name = pclass.name if pclass is not None \
+                    else eng.classes.default
+                counts[name] = counts.get(name, 0) + 1
+        except Exception:
+            return counts   # racing a driver-side mutation: partial
+        return counts
+
+    def class_pressure(self, request) -> int:
+        """The load on this replica that could NOT be displaced for
+        ``request``: engine-held work of class rank >= the request's
+        resolved rank (equals never displace equals), plus the unclassed
+        gateway intake. The class-headroom router's primary signal — a
+        latency request never lands on a replica saturated with
+        equal-or-higher-rank work while a sibling holds preemptible
+        batch load."""
+        eng = self.gateway.engine
+        try:
+            rank = eng.classes.resolve(
+                getattr(request, "priority_class", None)).rank
+        except ValueError:
+            rank = 0        # unknown class 400s at submit; rank moot
+        pressure = int(self.gateway.queue_depth) \
+            - int(eng.scheduler.num_queued)
+        pressure = max(pressure, 0)     # intake-only share of the queue
+        try:
+            seqs = [s for s in eng._slots if s is not None and not s.done]
+            seqs += list(eng.scheduler.queue)
+            for seq in seqs:
+                pclass = getattr(seq, "pclass", None)
+                if pclass is None or pclass.rank >= rank:
+                    pressure += 1
+        except Exception:
+            pass            # racing a driver-side mutation: partial
+        return pressure
+
     # --------------------------------------------------------- debug table
     def row(self) -> dict:
         """One ``/debug/fleet`` row — state + the router's live signals
@@ -149,6 +227,14 @@ class FleetReplica:
                 row["tier_hits"] = int(gw._pc_stat("tier_hits"))
                 row["tier_transfers_in"] = int(
                     gw._pc_stat("tier_transfers"))
+        if eng.classes.active:
+            # per-class occupancy + the policy counters (README
+            # "Multi-tenant SLO serving") — present only with a
+            # multi-class table, so a policy-off fleet table is
+            # unchanged
+            row["classes"] = self.class_counts()
+            row["policy_preemptions"] = int(
+                gw._stat("policy_preemptions"))
         return row
 
     def __repr__(self):

@@ -109,10 +109,48 @@ class PrefixAffinityRouter(Router):
         in_band = [r for r in reps if loads[r.index] - floor <= self.band]
         out = [r for r in reps if loads[r.index] - floor > self.band]
         prompt = getattr(request, "prompt", None)
-        in_band.sort(key=lambda r: (-r.prefix_match_tokens(prompt),
-                                    loads[r.index], r.index))
+        # capacity-aware placement (the PR-16 tiered-cache follow-on):
+        # a replica's warmth is its trie coverage PLUS what its own
+        # host tier could readmit in place — so a chain that spilled
+        # under pool pressure still attracts its prefix family to the
+        # replica HOLDING it (host-RAM readmit) instead of a sibling
+        # that would pull the chain host-to-host over the cache plane
+        # after placement. Tierless replicas probe 0, leaving every
+        # pre-tier routing order unchanged (duck-typed: router unit
+        # stubs predating the tier probe simply contribute 0).
+        def warmth(r):
+            tier = getattr(r, "tier_match_tokens", None)
+            return (r.prefix_match_tokens(prompt)
+                    + (tier(prompt) if tier is not None else 0))
+        in_band.sort(key=lambda r: (-warmth(r), loads[r.index], r.index))
         out.sort(key=lambda r: (loads[r.index], r.index))
         return in_band + out
+
+
+class ClassHeadroomRouter(Router):
+    """Class-aware placement (README "Multi-tenant SLO serving"): rank
+    by the replica's CLASS PRESSURE for this request — the load that
+    could not be displaced for it (work of equal-or-higher class rank
+    plus unclassed intake, :meth:`~.replica.FleetReplica.class_pressure`)
+    — then by total load, then index. A latency request routes to the
+    replica whose occupancy is mostly preemptible batch work (low
+    pressure) over an equally-busy sibling running latency work (high
+    pressure), so a burst lands where the policy scheduler can actually
+    clear slots for it; batch requests see every slot as pressure and
+    degrade to plain least-loaded. With no class table every request
+    resolves to one rank and this IS least-loaded routing.
+
+    ``rebalance``/``drain_replica`` are the matching actuator: drain a
+    replica of best-effort load to absorb a latency burst, and the
+    pressure signal immediately steers the burst at it.
+    """
+
+    name = "class-headroom"
+
+    def rank(self, request, replicas):
+        return sorted(replicas,
+                      key=lambda r: (r.class_pressure(request),
+                                     r.load(), r.index))
 
 
 #: CLI / serve_fleet() name -> constructor
@@ -120,13 +158,14 @@ ROUTERS = {
     RoundRobinRouter.name: RoundRobinRouter,
     LeastLoadedRouter.name: LeastLoadedRouter,
     PrefixAffinityRouter.name: PrefixAffinityRouter,
+    ClassHeadroomRouter.name: ClassHeadroomRouter,
 }
 
 
 def make_router(policy, **kw) -> Router:
     """Build a router from its policy name (``round-robin`` |
-    ``least-loaded`` | ``affinity``); a :class:`Router` instance passes
-    through unchanged."""
+    ``least-loaded`` | ``affinity`` | ``class-headroom``); a
+    :class:`Router` instance passes through unchanged."""
     if isinstance(policy, Router):
         return policy
     try:

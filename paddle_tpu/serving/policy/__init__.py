@@ -1,0 +1,21 @@
+"""Multi-tenant SLO policy layer (README "Multi-tenant SLO serving").
+
+Priority classes with TTFT/TPOT targets (:mod:`.classes`),
+deadline-aware admission with per-class headroom and anti-starvation
+aging (:mod:`.admission`), and preemption victim selection
+(:mod:`.victim`). Policy, not geometry: nothing here touches a traced
+shape or a jit key, and the default single-class table keeps the
+engine byte-identical to the FIFO baseline.
+"""
+from .classes import DEFAULT_CLASS_NAME, ClassTable, PriorityClass
+from .admission import PolicyScheduler
+from .victim import select_victims, victim_key
+
+__all__ = [
+    "DEFAULT_CLASS_NAME",
+    "ClassTable",
+    "PriorityClass",
+    "PolicyScheduler",
+    "select_victims",
+    "victim_key",
+]

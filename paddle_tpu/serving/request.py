@@ -33,6 +33,12 @@ class GenerationRequest:
     the engine retires the sequence with ``finish_reason="timeout"`` at
     the first step boundary past it — queued (never admitted) or
     mid-decode (slot freed) alike. ``None`` = no deadline.
+
+    ``priority_class`` names the request's tenant tier (README
+    "Multi-tenant SLO serving"): it must resolve against the engine's
+    class table at validate time (unknown name = ValueError = HTTP
+    400). ``None`` rides the table's default class, so legacy callers
+    never change behavior.
     """
     prompt: object
     max_new_tokens: int = 32
@@ -42,6 +48,7 @@ class GenerationRequest:
     seed: Optional[int] = None
     prng_key: object = None
     timeout_s: Optional[float] = None
+    priority_class: Optional[str] = None
 
 
 #: the closed finish_reason vocabulary (OpenAI-style names): "stop" =
@@ -73,6 +80,7 @@ class Sequence:
                  "finish_reason", "slot", "key", "submit_step", "deadline",
                  "prefix_nodes", "prefix_hit_tokens", "prefilled",
                  "work", "restore_point", "queue_tick", "launches",
+                 "pclass",
                  "t_submit", "t_admitted", "t_first_token",
                  "t_last_token", "t_finish",
                  "trace_mark", "trace_phase", "trace_chunk_i",
@@ -123,6 +131,11 @@ class Sequence:
         # and recovery (the recompute launches are real cost, and they
         # are charged too).
         self.launches = 0
+        # resolved PriorityClass (policy/classes.py), set by
+        # engine.submit from the request's priority_class name (the
+        # table default when unnamed); None only for sequences built
+        # outside an engine (unit tests), which every reader tolerates
+        self.pclass = None
         # SLO latency stamps (engine step_clock basis — injectable, so
         # chaos tests pin them deterministically): submit, FIRST slot
         # claim (kept across preemption/recovery — queue wait measures

@@ -56,13 +56,17 @@ def main(argv=None):
                          "POST /fleet/drain|rebalance, and failover-"
                          "to-sibling on replica death")
     ap.add_argument("--router",
-                    choices=("round-robin", "least-loaded", "affinity"),
+                    choices=("round-robin", "least-loaded", "affinity",
+                             "class-headroom"),
                     default="affinity",
                     help="fleet routing policy (--replicas > 1): "
                          "round-robin, least-loaded (live KV blocks + "
-                         "queue depth), or affinity (longest cached-"
+                         "queue depth), affinity (longest cached-"
                          "prefix match within a load band; the "
-                         "default)")
+                         "default), or class-headroom (lowest "
+                         "non-displaceable class pressure for the "
+                         "request's priority class — pair with "
+                         "--classes)")
     ap.add_argument("--affinity-band", type=int, default=16,
                     help="affinity router's load band (KV blocks + "
                          "queued requests): replicas loaded more than "
@@ -199,6 +203,28 @@ def main(argv=None):
                     help="engine rebuild budget after fatal/hung step "
                          "faults before the gateway gives up (0 disables "
                          "crash recovery)")
+    ap.add_argument("--classes", default=None,
+                    help="multi-tenant SLO priority classes (README "
+                         "'Multi-tenant SLO serving'): comma list of "
+                         "name[*][:reserved_slots], highest priority "
+                         "first — e.g. 'latency:1,standard,batch*'. "
+                         "'*' marks the default class for unlabeled "
+                         "requests (else the last listed). Requests "
+                         "pick a tier via the priority_class body "
+                         "field or X-Priority-Class header; unknown "
+                         "names 400. Default: one neutral class "
+                         "(policy off, FIFO baseline)")
+    ap.add_argument("--slo-ttft-ms", default=None,
+                    help="per-class TTFT SLO targets in ms, aligned "
+                         "with --classes (comma list; 0 or a missing "
+                         "tail entry = no target). An urgent waiter "
+                         "past half its target preempts strictly-"
+                         "lower-class running work by recompute")
+    ap.add_argument("--slo-tpot-ms", default=None,
+                    help="per-class TPOT SLO targets in ms, aligned "
+                         "with --classes (comma list; 0 = no target). "
+                         "Observed per finished request into "
+                         "serving_slo_misses_total{class,slo='tpot'}")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-request access logs")
@@ -241,6 +267,8 @@ def main(argv=None):
             decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
             quantize_weights=args.quantize_weights,
             tp=args.tp, collective_dtype=args.collective_dtype,
+            classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
+            slo_tpot_ms=args.slo_tpot_ms,
             trace=args.trace, trace_buffer=args.trace_buffer,
             cost=args.cost,
             watchdog_deadline_s=args.watchdog_deadline or None,
@@ -274,6 +302,11 @@ def main(argv=None):
                 {"tp": fleet.replicas[0].gateway.engine.tp},
             "collective_dtype":
                 fleet.replicas[0].gateway.engine.collective_dtype,
+            # effective-value idiom: the parsed class table the fleet's
+            # engines actually schedule with (ranks, ms targets,
+            # reserved headroom, the default marker) — not the flag
+            # spelling
+            "classes": fleet.classes.doc(),
             "trace": fleet.tracer.enabled,
             "cost": fleet.replicas[0].gateway.cost is not None,
             "endpoints": ["/v1/completions", "/healthz", "/metrics",
@@ -304,6 +337,8 @@ def main(argv=None):
         decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
         quantize_weights=args.quantize_weights,
         tp=args.tp, collective_dtype=args.collective_dtype,
+        classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
+        slo_tpot_ms=args.slo_tpot_ms,
         trace=args.trace, trace_buffer=args.trace_buffer,
         cost=args.cost,
         watchdog_deadline_s=args.watchdog_deadline or None,
@@ -341,6 +376,10 @@ def main(argv=None):
                       "mesh_shape": {"tp": server.gateway.engine.tp},
                       "collective_dtype":
                       server.gateway.engine.collective_dtype,
+                      # effective-value idiom: the EFFECTIVE class
+                      # table the engine schedules with (parsed ranks,
+                      # ms targets, reserved headroom, default marker)
+                      "classes": server.gateway.engine.classes.doc(),
                       # report what actually runs: whether the tracer
                       # is RECORDING now (the persistent --trace mode)
                       # and the effective ring capacity

@@ -94,9 +94,9 @@ from ..faults import TransientFault
 #: rebuilding re-bases only its own series (the others never move) —
 #: a fleet scrape can never observe a counter going backwards.
 CARRIED_ENGINE_STATS = (
-    "preemptions", "prefill_copy_dispatches", "prefill_chunks",
-    "prefill_tokens_saved", "spec_proposed", "spec_accepted",
-    "spec_tokens", "decode_calls", "tokens_generated",
+    "preemptions", "policy_preemptions", "prefill_copy_dispatches",
+    "prefill_chunks", "prefill_tokens_saved", "spec_proposed",
+    "spec_accepted", "spec_tokens", "decode_calls", "tokens_generated",
     "mtick_syncs", "mtick_ticks")
 
 #: same carry for the prefix cache's own stats dict (a rebuild builds a
@@ -348,6 +348,7 @@ class ServingGateway:
         engine.cost = self.cost
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
+        engine.on_policy_preempt = self._on_policy_preempt
         if fault_hook is not None:
             engine.fault_hook = fault_hook
         self._init_metrics(registry)
@@ -400,6 +401,16 @@ class ServingGateway:
         _, pc_base, eng = self._counter_state
         pc = eng.prefix_cache
         return pc_base[key] + (pc.stats[key] if pc is not None else 0)
+
+    def _class_labels(self, seq) -> dict:
+        """Label kwargs for one sequence's latency observations: the
+        ``class`` label with a MULTI-CLASS table active, {} otherwise —
+        a policy-off gateway's histogram series keep their empty label
+        sets, byte-identical to before the policy subsystem existed."""
+        if self._m_slo_miss is None:
+            return {}
+        pclass = getattr(seq, "pclass", None)
+        return {"class": pclass.name} if pclass is not None else {}
 
     # ------------------------------------------------------------- metrics
     def _init_metrics(self, registry):
@@ -557,6 +568,34 @@ class ServingGateway:
                   "pressure (PoolExhausted: chain donated to the trie, "
                   "request re-queued). Monotonic across engine rebuilds."
                   ).set_fn(lambda: self._stat("preemptions"))
+        # multi-tenant SLO surface (README "Multi-tenant SLO serving"):
+        # registered only when the engine's class table is ACTIVE, so a
+        # policy-off gateway's /metrics document — and the empty label
+        # sets on the latency histograms — stays byte-identical to
+        # before the subsystem existed. Both counters are gateway-owned
+        # inc-based (the _m_faults idiom, NOT engine-stat-backed), so
+        # they are monotonic across engine rebuilds by construction;
+        # zero-seeded per known class so dashboards can diff tenants
+        # from the first scrape.
+        self._m_slo_miss = None
+        self._m_policy_preempt = None
+        if self.engine.classes.active:
+            self._m_slo_miss = r.counter(
+                "serving_slo_misses_total",
+                "Finished first-tokens/requests that exceeded their "
+                "priority class's SLO target, by class and slo "
+                "(ttft|tpot). Classes without a target never miss.")
+            self._m_policy_preempt = r.counter(
+                "serving_policy_preemptions_total",
+                "Sequences displaced by SLO-driven policy preemption "
+                "(an urgent higher-class request claimed the slot), "
+                "by the victim's class. Streams continue "
+                "byte-identically after restore.")
+            for c in self.engine.classes:
+                self._m_policy_preempt.inc(0, victim_class=c.name)
+                for slo in ("ttft", "tpot"):
+                    self._m_slo_miss.inc(0, **{"class": c.name,
+                                               "slo": slo})
         r.gauge("serving_watchdog_last_step_age_seconds",
                 "Seconds since the last completed engine step (the "
                 "supervisor's hung-step signal; an orchestrator's "
@@ -837,8 +876,20 @@ class ServingGateway:
         if stream.first_token_time is None:
             stream.first_token_time = time.monotonic()
             self._m_ttft.observe(stream.first_token_time
-                                 - stream.submit_time)
+                                 - stream.submit_time,
+                                 **self._class_labels(seq))
             self._leave_waiting_room(stream)
+            # TTFT SLO verdict from the ENGINE-clock stamp (not the
+            # wall-clock wire latency above): deterministic under an
+            # injected clock, so chaos replays count identical misses
+            if self._m_slo_miss is not None:
+                pclass = getattr(seq, "pclass", None)
+                ttft = seq.ttft_s
+                if (pclass is not None and pclass.ttft_slo_s is not None
+                        and ttft is not None
+                        and ttft > pclass.ttft_slo_s):
+                    self._m_slo_miss.inc(**{"class": pclass.name,
+                                            "slo": "ttft"})
         stream._push_token(token)
 
     def _finish_teardown(self, seq):
@@ -854,10 +905,16 @@ class ServingGateway:
         # one-token request has no TPOT)
         qw = seq.queue_wait_s
         if qw is not None:
-            self._m_queue_wait.observe(qw)
+            self._m_queue_wait.observe(qw, **self._class_labels(seq))
         tp = seq.tpot_s
         if tp is not None:
-            self._m_tpot.observe(tp)
+            self._m_tpot.observe(tp, **self._class_labels(seq))
+            if self._m_slo_miss is not None:
+                pclass = getattr(seq, "pclass", None)
+                if (pclass is not None and pclass.tpot_slo_s is not None
+                        and tp > pclass.tpot_slo_s):
+                    self._m_slo_miss.inc(**{"class": pclass.name,
+                                            "slo": "tpot"})
         # quarantine bookkeeping: any terminal outcome clears suspicion
         self._probation.discard(seq.request_id)
         if self._suspect_ids is not None:
@@ -872,6 +929,17 @@ class ServingGateway:
         stream = self._finish_teardown(seq)
         if stream is not None:
             stream._push_finish(seq.finish_reason)
+
+    def _on_policy_preempt(self, seq):
+        """Engine hook: an SLO-urgent request displaced ``seq``. Counts
+        by victim class on the gateway-owned counter (monotonic across
+        rebuilds — the engine's own policy_preemptions stat rides the
+        CARRIED_ENGINE_STATS carry in parallel)."""
+        if self._m_policy_preempt is not None:
+            pclass = getattr(seq, "pclass", None)
+            self._m_policy_preempt.inc(
+                victim_class=pclass.name if pclass is not None
+                else "unknown")
 
     # ------------------------------------------------------- driver thread
     def _admit_intake(self):
@@ -1247,6 +1315,7 @@ class ServingGateway:
         new = self.engine_factory()
         new.on_token = self._on_token
         new.on_finish = self._on_finish
+        new.on_policy_preempt = self._on_policy_preempt
         new.tracer = self.tracer     # one timeline across incarnations
         new.cost = self.cost         # one cost account, monotonic too
         if self._fault_hook is not None:
@@ -1632,7 +1701,16 @@ class ServingGateway:
         rows = []
         wall = time.monotonic()
         for st in pending:
+            # class + TTFT-deadline slack (README "Multi-tenant SLO
+            # serving"): pending requests resolve against the live
+            # class table (they passed validate at submit, so this
+            # cannot raise); slack counts down on the same wall wait
+            # the row's queue_wait_s shows
+            pclass = eng.classes.resolve(st.request.priority_class)
+            slack = (None if pclass.ttft_slo_s is None else
+                     round(pclass.ttft_slo_s - (wall - st.submit_time), 6))
             rows.append({"id": st.id, "state": "pending", "slot": None,
+                         "class": pclass.name,
                          "prompt_tokens": len(st.request.prompt),
                          "generated_tokens": 0,
                          "max_new_tokens": int(st.request.max_new_tokens),
@@ -1645,7 +1723,8 @@ class ServingGateway:
                          "ttft_s": None,
                          "tpot_s": None, "kv_tokens": 0,
                          "kv_blocks": None,
-                         "launches": 0, "kv_bytes": 0})
+                         "launches": 0, "kv_bytes": 0,
+                         "slo_slack_s": slack})
         for st in live:
             seq = st.seq
             slot = seq.slot
@@ -1671,11 +1750,24 @@ class ServingGateway:
                 kv_bytes = eng.cache.slot_kv_bytes(slot)
                 if getattr(eng, "_paged", False):
                     kv_blocks = len(eng.cache.slot_block_ids(slot))
+            # TTFT-deadline slack on the engine clock: settled once the
+            # first token landed (negative = the miss already counted),
+            # counting down from the wait-so-far while still queued
+            pclass = seq.pclass
+            slack = None
+            if pclass is not None and pclass.ttft_slo_s is not None:
+                waited = seq.ttft_s
+                if waited is None and seq.t_submit is not None:
+                    waited = now - seq.t_submit
+                if waited is not None:
+                    slack = round(pclass.ttft_slo_s - waited, 6)
             rows.append({
                 "id": st.id,
                 "state": ("parked" if id(seq) in parked_ids
                           else seq.status),
                 "slot": slot,
+                "class": (pclass.name if pclass is not None
+                          else eng.classes.default),
                 "prompt_tokens": seq.prompt_len,
                 "generated_tokens": len(seq.tokens),
                 "max_new_tokens": int(seq.request.max_new_tokens),
@@ -1692,6 +1784,7 @@ class ServingGateway:
                 # row bytes)
                 "launches": seq.launches,
                 "kv_bytes": kv_bytes,
+                "slo_slack_s": slack,
             })
         return rows
 

@@ -384,6 +384,16 @@ class _Handler(BaseHTTPRequestHandler):
         kw = {}
         if p.get("timeout_s") is not None:
             kw["timeout_s"] = float(p["timeout_s"])
+        # priority class (README "Multi-tenant SLO serving"): body field
+        # wins, the X-Priority-Class header covers clients whose SDK
+        # cannot add body fields (a proxy can inject the header). An
+        # unknown name raises ValueError inside gateway.submit's
+        # validate — the 400 path below — never a driver crash.
+        pclass = p.get("priority_class")
+        if pclass is None:
+            pclass = self.headers.get("X-Priority-Class")
+        if pclass is not None:
+            kw["priority_class"] = str(pclass)
         eos = p.get("eos_token_id", p.get("stop_token_id"))
         return GenerationRequest(
             prompt=list(prompt),
@@ -510,7 +520,8 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
           drafter=None, trace=False, trace_buffer=65536, cost=True,
           decode_ticks=1, kv_dtype=None, quantize_weights=False,
-          tp=1, collective_dtype="fp", host_tier_bytes=0):
+          tp=1, collective_dtype="fp", host_tier_bytes=0,
+          classes=None, slo_ttft_ms=None, slo_tpot_ms=None):
     """Build engine → gateway → HTTP server and start listening.
 
     ``decode_chunk=1`` is the serving default: chunk fusion trades
@@ -630,8 +641,25 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     ``/metrics`` grows the ``serving_prefix_*`` tier counters/gauges
     and ``serving_tier_bytes_total{direction}``; ``/debug/profile``
     gains the tiers section.
+
+    ``classes`` (default None — single neutral class, every banked
+    baseline byte-identical) turns on multi-tenant SLO policy (README
+    "Multi-tenant SLO serving"): a comma list of
+    ``name[*][:reserved_slots]`` entries, highest priority first, with
+    ``slo_ttft_ms`` / ``slo_tpot_ms`` aligned per-class target lists
+    (0 = no target). Requests pick a tier via the ``priority_class``
+    body field or ``X-Priority-Class`` header (unknown name = 400);
+    admission orders by (class rank, TTFT slack), reserved headroom is
+    honored, and an urgent latency-class request preempts
+    strictly-lower-class running work by recompute — streams stay
+    byte-identical. ``/metrics`` grows the ``class`` label on the
+    latency histograms plus ``serving_slo_misses_total{class,slo}``
+    and ``serving_policy_preemptions_total{victim_class}``.
     """
     from ..engine import ContinuousBatchingEngine
+    from ..policy import ClassTable
+    priority_classes = None if classes is None else ClassTable.parse(
+        classes, slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=slo_tpot_ms)
 
     def engine_factory():
         # one factory builds the first engine AND every recovery
@@ -650,6 +678,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             quantize_weights=quantize_weights,
             tp=tp, collective_dtype=collective_dtype,
             host_tier_bytes=host_tier_bytes,
+            priority_classes=priority_classes,
             jit_cache=model.__dict__.setdefault("_serving_jit", {}))
 
     gateway = ServingGateway(
@@ -675,7 +704,8 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
                 spec_k=4, drafter=None, trace=False, trace_buffer=65536,
                 cost=True, affinity_band=16, decode_ticks=1,
                 kv_dtype=None, quantize_weights=False, tp=1,
-                collective_dtype="fp", host_tier_bytes=0):
+                collective_dtype="fp", host_tier_bytes=0,
+                classes=None, slo_ttft_ms=None, slo_tpot_ms=None):
     """Build an engine fleet → HTTP server and start listening (README
     "Engine fleet"): ``replicas`` supervised engines — each its own
     paged pool, prefix trie and scheduler, sharing compiled programs
@@ -712,8 +742,20 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
     ``GET /fleet/cacheplane`` is the debug surface; ``/metrics``
     grows ``serving_fleet_tier_transfers_total`` and
     ``serving_fleet_tier_transfer_bytes_total``.
+
+    ``classes`` / ``slo_ttft_ms`` / ``slo_tpot_ms`` configure the
+    multi-tenant class table fleet-wide (same grammar as
+    :func:`serve`; every replica shares ONE parsed table). The
+    ``class-headroom`` router routes each request by per-replica class
+    pressure — the load that COULD NOT be displaced for it — so a
+    latency request never lands on a replica saturated with equal-or-
+    higher-rank work while a sibling has displaceable batch load;
+    ``/debug/fleet`` rows grow per-class occupancy columns.
     """
     from ..fleet import EngineFleet, PrefixAffinityRouter
+    from ..policy import ClassTable
+    priority_classes = None if classes is None else ClassTable.parse(
+        classes, slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=slo_tpot_ms)
     if router == "affinity":
         router = PrefixAffinityRouter(band=affinity_band)
     fleet = EngineFleet(
@@ -728,6 +770,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
         kv_dtype=kv_dtype, quantize_weights=quantize_weights,
         tp=tp, collective_dtype=collective_dtype,
         host_tier_bytes=host_tier_bytes,
+        priority_classes=priority_classes,
         registry=registry, clock=clock,
         watchdog_deadline_s=watchdog_deadline_s,
         max_restarts=max_restarts, fault_hooks=fault_hooks,

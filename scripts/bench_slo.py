@@ -1,0 +1,207 @@
+"""Multi-tenant SLO serving benchmark (README "Multi-tenant SLO
+serving").
+
+Question answered: when a latency-class trickle shares the engine with
+a batch flood, what does the policy scheduler (priority classes +
+deadline-aware admission + SLO-driven preemption) buy the latency
+tenant, and what does it cost the batch tenant?
+
+One workload, two legs, identical requests: a batch flood wide enough
+to hold every KV slot for the whole run, plus staggered latency-class
+arrivals with an 80ms TTFT target. Both legs replay the same
+virtual-time submission schedule under a ``VirtualClock`` advanced a
+fixed ``DT`` per engine step, so every latency figure is a pure
+SCHEDULING measure (steps-waited x DT) — no wall-clock noise, and the
+whole bench replays byte-identically.
+
+- **policy off** — the FIFO baseline (no class table; the
+  ``priority_class`` labels are stripped, exactly the legacy engine):
+  a latency arrival waits for a natural slot behind the flood.
+- **policy on** — the three-way class table: the same arrival turns
+  URGENT at half its TTFT budget and displaces one batch victim by
+  recompute (chain donated, PRNG snapshotted).
+
+Acceptance (all gates must hold):
+
+- policy-on latency TTFT p95 <= the 80ms class target;
+- policy-off latency TTFT p95 degrades >= ACCEPT_DEGRADE_RATIO x the
+  policy-on p95 (the win is real, not noise);
+- batch virtual throughput under policy >= ACCEPT_BATCH_RATIO x the
+  policy-off leg (preemption-by-recompute taxes the flood, bounded);
+- ZERO lost requests either leg (every stream finishes length|stop);
+- per-request token streams BYTE-IDENTICAL across the legs (policy
+  moves work in time, never changes tokens — the transparency gate);
+- ``decode_compilations() == 1`` per leg, preemption/restore included;
+- the policy leg REPLAYS identically (streams, TTFTs, preemption
+  count) when run twice.
+
+Usage:
+  python scripts/bench_slo.py --quick [--json PATH]   # CPU-sized
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_decode import _models  # noqa: E402
+
+NUM_SLOTS = 4
+S_MAX = 128
+BS = 8                            # KV block size
+CHUNK = 16                        # chunked-prefill budget
+DT = 0.005                        # virtual seconds per engine step
+TTFT_SLO_S = 0.08                 # the latency class target (16 steps)
+CLASSES = "latency,standard,batch*"
+SLO_TTFT_MS = "80,400,0"
+ACCEPT_DEGRADE_RATIO = 3.0        # policy-off p95 / policy-on p95
+ACCEPT_BATCH_RATIO = 0.8          # batch tok/s(policy) / tok/s(fifo)
+
+
+def _workload(vocab, flood, trickle, batch_new):
+    """(virtual_time, tag, request) triples: a batch flood submitted at
+    t=0 (greedy rows plus one seeded-sampled row — the PRNG-snapshot
+    path must be exercised under preemption), then latency arrivals
+    staggered AFTER the flood owns every slot."""
+    from paddle_tpu.serving import GenerationRequest
+    rng = np.random.RandomState(61)
+    jobs = []
+    for i in range(flood):
+        kw = {"temperature": 0.9, "top_k": 5, "seed": 123} if i == 1 else {}
+        jobs.append((0.0, "batch", GenerationRequest(
+            prompt=rng.randint(0, vocab, (12,)).astype(np.int32),
+            max_new_tokens=batch_new, priority_class="batch", **kw)))
+    for i in range(trickle):
+        jobs.append((0.02 + 0.05 * i, "latency", GenerationRequest(
+            prompt=rng.randint(0, vocab, (8,)).astype(np.int32),
+            max_new_tokens=4, priority_class="latency")))
+    return jobs
+
+
+def _strip(req):
+    from paddle_tpu.serving import GenerationRequest
+    return GenerationRequest(
+        prompt=req.prompt, max_new_tokens=req.max_new_tokens,
+        temperature=req.temperature, top_k=req.top_k, seed=req.seed)
+
+
+def _leg(model, jobs, policy):
+    """Replay the schedule on one engine; virtual time advances DT per
+    step (and per idle tick between arrivals)."""
+    from paddle_tpu.serving import (ClassTable, ContinuousBatchingEngine,
+                                    VirtualClock)
+    clk = VirtualClock()
+    table = ClassTable.parse(CLASSES, slo_ttft_ms=SLO_TTFT_MS) \
+        if policy else None
+    eng = ContinuousBatchingEngine(
+        model, num_slots=NUM_SLOTS, max_seq_len=S_MAX, decode_chunk=1,
+        prefix_cache=True, prefix_block_size=BS, prefill_chunk=CHUNK,
+        step_clock=clk, priority_classes=table,
+        jit_cache=model.__dict__.setdefault("_serving_jit_slobench", {}))
+    pending = sorted(jobs, key=lambda j: j[0])
+    seqs, i = [], 0
+    while i < len(pending) or eng.has_work():
+        while i < len(pending) and pending[i][0] <= clk():
+            t, tag, req = pending[i]
+            seqs.append((tag, eng.submit(
+                req if policy else _strip(req))))
+            i += 1
+        if eng.has_work():
+            eng.step()
+        clk.advance(DT)
+
+    lat_ttft = sorted(s.ttft_s for tag, s in seqs if tag == "latency")
+    batch = [s for tag, s in seqs if tag == "batch"]
+    batch_tokens = sum(len(s.tokens) for s in batch)
+    batch_makespan = max(s.t_finish for s in batch)
+    return {
+        "latency_ttft_p50_ms": round(
+            float(np.percentile(lat_ttft, 50)) * 1e3, 3),
+        "latency_ttft_p95_ms": round(
+            float(np.percentile(lat_ttft, 95)) * 1e3, 3),
+        "latency_ttft_max_ms": round(lat_ttft[-1] * 1e3, 3),
+        "batch_tokens": batch_tokens,
+        "batch_makespan_virtual_s": round(batch_makespan, 4),
+        "batch_tok_per_virtual_s": round(
+            batch_tokens / max(batch_makespan, 1e-9), 2),
+        "policy_preemptions": eng.stats["policy_preemptions"],
+        "restores": eng.stats["restores"],
+        "finish_reasons": sorted({s.finish_reason for _, s in seqs}),
+        "lost": sum(1 for _, s in seqs
+                    if s.finish_reason not in ("length", "stop")),
+        "decode_compilations": eng.decode_compilations(),
+    }, [s.tokens for _, s in seqs], [round(t, 6) for t in lat_ttft]
+
+
+def measure_slo(quick=True, flood=None, trickle=None, batch_new=None):
+    model = _models(quick)["jnp"]
+    jobs = _workload(model.config.vocab_size,
+                     flood=flood or (4 if quick else 8),
+                     trickle=trickle or (4 if quick else 8),
+                     batch_new=batch_new or (64 if quick else 96))
+
+    fifo, fifo_streams, _ = _leg(model, jobs, policy=False)
+    pol, pol_streams, pol_ttfts = _leg(model, jobs, policy=True)
+    # deterministic-replay pin: the whole policy leg, rerun
+    pol2, pol2_streams, pol2_ttfts = _leg(model, jobs, policy=True)
+
+    degrade = fifo["latency_ttft_p95_ms"] / max(
+        pol["latency_ttft_p95_ms"], 1e-9)
+    batch_ratio = pol["batch_tok_per_virtual_s"] / max(
+        fifo["batch_tok_per_virtual_s"], 1e-9)
+    replay_ok = (pol_streams == pol2_streams and pol_ttfts == pol2_ttfts
+                 and pol["policy_preemptions"] == pol2["policy_preemptions"])
+    tokens_equal = fifo_streams == pol_streams
+    compile_once = (fifo["decode_compilations"] == 1
+                    and pol["decode_compilations"] == 1)
+    accepted = bool(
+        tokens_equal and replay_ok and compile_once
+        and fifo["lost"] == 0 and pol["lost"] == 0
+        and pol["latency_ttft_p95_ms"] <= TTFT_SLO_S * 1e3
+        and degrade >= ACCEPT_DEGRADE_RATIO
+        and pol["policy_preemptions"] > 0
+        and batch_ratio >= ACCEPT_BATCH_RATIO)
+    return {
+        "num_slots": NUM_SLOTS,
+        "dt_virtual_s": DT,
+        "classes": CLASSES,
+        "ttft_slo_ms": TTFT_SLO_S * 1e3,
+        "requests": len(jobs),
+        "fifo": fifo,
+        "policy": pol,
+        "ttft_p95_degrade_ratio_fifo_over_policy": round(degrade, 4),
+        "batch_throughput_ratio_policy_over_fifo": round(batch_ratio, 4),
+        "tokens_equal": tokens_equal,
+        "replay_identical": replay_ok,
+        "compile_once": compile_once,
+        "accepted": accepted,
+        "workload": "batch flood (greedy + one seeded row) holding every "
+                    "slot for the whole run + staggered latency arrivals "
+                    "with an 80ms TTFT target, replayed on a VirtualClock "
+                    "(DT per step) policy-off vs policy-on; latency "
+                    "figures are pure scheduling measures.",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-sized model + short budgets")
+    ap.add_argument("--json", default=None, help="also write result here")
+    args = ap.parse_args()
+    import jax
+    res = {"platform": jax.default_backend(), "quick": bool(args.quick),
+           "slo": measure_slo(quick=args.quick)}
+    print(json.dumps(res, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0 if res["slo"]["accepted"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,8 @@
 "Serving over HTTP") for flags and curl examples.
 
     python scripts/serve.py --preset tiny --port 8000
+    python scripts/serve.py --preset tiny --port 8000 \\
+        --classes 'latency*,standard,batch' --slo-ttft-ms 80,200,0
 """
 import os
 import sys

@@ -203,6 +203,17 @@ class TestBenchDriverFlow:
                      "hit_rate_ratio": 5.0,
                      "ttft_recompute_over_tier_hit": 2.01,
                      "accepted": True}), ""
+            if leg == "--slo":
+                # multi-tenant SLO leg: same hang-proof contract
+                assert env == {"JAX_PLATFORMS": "cpu"}
+                return 0, json.dumps(
+                    {"name": "slo", "ok": True,
+                     "tokens_equal": True,
+                     "replay_identical": True,
+                     "compile_once": True,
+                     "ttft_p95_degrade_ratio_fifo_over_policy": 6.48,
+                     "batch_throughput_ratio_policy_over_fifo": 0.84,
+                     "accepted": True}), ""
             if leg == "--smoke":
                 return 0, json.dumps({"kernel": "k", "ok": True}), ""
             if leg == "--config":
@@ -237,12 +248,12 @@ class TestBenchDriverFlow:
         # and the tunnel-independent scheduling + gateway + prefix-cache
         # legs run before anything that can wedge
         assert order[-1] == "--decode" and "--trace" in order
-        assert order[:13] == ["--decode-cb", "--serve-http",
+        assert order[:14] == ["--decode-cb", "--serve-http",
                               "--prefix-cache", "--paged-attn",
                               "--chunked-prefill", "--ragged", "--spec",
                               "--chaos", "--trace-overhead",
                               "--dispatch", "--density", "--tp",
-                              "--tier"]
+                              "--tier", "--slo"]
         art = json.load(open(bench.SELF_BENCH_PATH))
         assert art["decode"]["ok"] is True and art["decode"]["attn"] == "jnp"
         assert art["serve_http"]["overhead_ratio"] == 1.17
@@ -278,6 +289,13 @@ class TestBenchDriverFlow:
         assert art["tier"]["accepted"] is True
         assert art["tier"]["hit_rate_ratio"] == 5.0
         assert art["tier"]["ttft_recompute_over_tier_hit"] == 2.01
+        # the multi-tenant SLO leg rides the same banked artifact
+        assert art["slo"]["accepted"] is True
+        assert art["slo"]["tokens_equal"] is True
+        assert art["slo"][
+            "ttft_p95_degrade_ratio_fifo_over_policy"] == 6.48
+        assert art["slo"][
+            "batch_throughput_ratio_policy_over_fifo"] == 0.84
         # the pallas attempt's forensic trail rides along with the success
         (fa,) = art["decode"]["failed_attempts"]
         assert fa["attn"] == "pallas" and fa["rc"] == 124

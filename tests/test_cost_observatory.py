@@ -906,6 +906,33 @@ class TestGuardDiscipline:
         fleet_src = (SERVING_DIR / "fleet" / "fleet.py").read_text()
         assert GUARD_RE.search(fleet_src) is not None
 
+    def test_sweep_sees_the_policy_paths(self):
+        """ISSUE 18 satellite: the multi-tenant policy package lives
+        inside the swept tree and its decision sites stay
+        guard-disciplined. The scheduler's admission decisions record
+        through the same nullable ``_tr()`` idiom as the engine (the
+        engine syncs the alias at the top of every step, BEFORE
+        ``_policy_preempt`` runs, so preemption and headroom instants
+        ride the step's already-guarded tracer), and the engine's
+        SLO-preemption site reads the guarded local — a refactor that
+        moved the policy out of ``serving/`` or grew a raw
+        ``self.tracer.`` touch would silently shed the ≤1%-disabled-
+        overhead property on the hottest new decision path."""
+        swept = {p.name for p in SERVING_DIR.rglob("*.py")}
+        assert {"classes.py", "admission.py", "victim.py"} <= swept
+        adm = (SERVING_DIR / "policy" / "admission.py").read_text()
+        body = adm.split("def admissions(")[1].split("\n    def ")[0]
+        assert "tr = self._tr()" in body
+        assert "self.tracer." not in body
+        eng = (SERVING_DIR / "engine.py").read_text()
+        pp = eng.split("def _policy_preempt(")[1].split("\n    def ")[0]
+        assert "tr = self._tr()" in pp
+        assert "self.tracer." not in pp
+        # the step syncs the scheduler's alias before consulting policy
+        assert "self.scheduler.tracer = tr" in eng
+        assert eng.index("self.scheduler.tracer = tr") < \
+            eng.index("self._policy_preempt()")
+
 
 # ---------------------------------------------------- profiler CLI (json)
 class TestProfilerCLIChrome:
