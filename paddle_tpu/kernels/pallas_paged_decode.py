@@ -67,6 +67,22 @@ def _head_scale_mat(s, rows, gh, hkv):
                                preferred_element_type=jnp.float32)
 
 
+def _block_scale_vec(s, rows, gh, hkv):
+    """The fp8 sibling of :func:`_head_scale_mat`: an fp8 pool's scale
+    planes are per-BLOCK (one fp32 scalar per (block, head) — README
+    "Quantized serving"), so the dequant factor is constant across the
+    block's pool rows and depends only on the wide row's KV head. Same
+    one-hot trick, contracted with the block's ``[1, hkv]`` scale
+    vector → ``[rows, 1]``, broadcast over the logits/probs columns
+    post-dot. 2D ops only."""
+    g = gh // hkv
+    w = jax.lax.broadcasted_iota(jnp.int32, (rows, hkv), 0)
+    h = jax.lax.broadcasted_iota(jnp.int32, (rows, hkv), 1)
+    onehot = jnp.where((w % gh) // g == h, 1.0, 0.0).astype(jnp.float32)
+    return jax.lax.dot_general(onehot, s, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _paged_kernel(len_ref, tbl_ref, *refs, scale, block_k,
                   quantized=False, hkv=0):
     # positional ref layout follows the pallas_call spec lists: inputs
@@ -94,17 +110,23 @@ def _paged_kernel(len_ref, tbl_ref, *refs, scale, block_k,
         k = k_ref[0]                        # [block_k, Hkv*D]
         v = v_ref[0]                        # [block_k, Hkv*D]
         if quantized:
-            # int8 pool: the DMA above moved int8 (the HBM win); the
-            # dequant happens HERE, right after it — the data converts
-            # in VMEM on the way into the MXU, and the per-row-per-head
-            # scales apply POST-dot via the head one-hot trick
-            # (_head_scale_mat), since the block-diagonal wide rows
-            # make the factor separable per (row, col)
+            # quantized pool: the DMA above moved int8/fp8 (the HBM
+            # win); the upcast happens HERE, right after it — the data
+            # converts in VMEM on the way into the MXU (fused into the
+            # dot, never materialized back to HBM), and the scales
+            # apply POST-dot: int8's per-row-per-head planes via the
+            # head one-hot trick (_head_scale_mat), fp8's per-block
+            # planes as a per-wide-row factor (_block_scale_vec) —
+            # both separable because the block-diagonal wide rows pair
+            # each output row with exactly one KV head
             k = k.astype(jnp.float32)
             v = v.astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if quantized:
+        if quantized == "fp8":
+            s = s * _block_scale_vec(ks_ref[...], s.shape[0], s.shape[0],
+                                     hkv)
+        elif quantized:
             s = s * _head_scale_mat(ks_ref[0], s.shape[0], s.shape[0],
                                     hkv)
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -123,7 +145,10 @@ def _paged_kernel(len_ref, tbl_ref, *refs, scale, block_k,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
-        if quantized:
+        if quantized == "fp8":
+            p = p * _block_scale_vec(vs_ref[...], p.shape[0], p.shape[0],
+                                     hkv)
+        elif quantized:
             # V dequant, same separability: fold the scales into P
             # (P_wj * sv[j, head(w)]) and dot with the raw int8 values
             p = p * _head_scale_mat(vs_ref[0], p.shape[0], p.shape[0],
@@ -143,15 +168,17 @@ def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret,
                 scales=None):
     """q_wide: [B, H, KD] block-diagonal; pool_*: [num_blocks, bs, KD];
     tables: [B, max_blocks] int32 physical block ids; scales: None, or
-    ``(k_scale, v_scale)`` [num_blocks, bs, Hkv] fp32 planes for an
-    int8 pool (dequant in-kernel, right after the table-indirect
-    DMA)."""
+    ``(k_scale, v_scale)`` fp32 planes — [num_blocks, bs, Hkv] for an
+    int8 pool (per-row), [num_blocks, Hkv] for an fp8 pool (per-block;
+    the plane rank is the mode switch). Either way the dequant happens
+    in-kernel, right after the table-indirect DMA."""
     B, H, KD = q_wide.shape
     num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
     nk = tables.shape[1]
     grid = (B, nk)
-    quantized = scales is not None
-    hkv = scales[0].shape[2] if quantized else 0
+    quantized = False if scales is None else \
+        ("fp8" if scales[0].ndim == 2 else "int8")
+    hkv = scales[0].shape[-1] if quantized else 0
     kernel = functools.partial(_paged_kernel, scale=scale, block_k=bs,
                                quantized=quantized, hkv=hkv)
 
@@ -164,13 +191,22 @@ def _paged_call(q_wide, pool_k, pool_v, tables, lengths, scale, interpret,
         phys = tbl[b, jnp.minimum(ki, last)]
         return (jnp.clip(phys, 0, num_blocks - 1), 0, 0)
 
+    def _kv_index2(b, ki, lens, tbl):
+        # the fp8 scale planes' 2D twin (per-block planes have no row
+        # axis): same clamp, same physical block
+        return _kv_index(b, ki, lens, tbl)[:2]
+
     in_specs = [
         pl.BlockSpec((1, H, KD), lambda b, ki, lens, tbl: (b, 0, 0)),
         pl.BlockSpec((1, bs, KD), _kv_index),
         pl.BlockSpec((1, bs, KD), _kv_index),
     ]
     args = [lengths, tables, q_wide, pool_k, pool_v]
-    if quantized:
+    if quantized == "fp8":
+        in_specs += [pl.BlockSpec((1, hkv), _kv_index2),
+                     pl.BlockSpec((1, hkv), _kv_index2)]
+        args += [scales[0], scales[1]]
+    elif quantized:
         # the scale planes ride the SAME table-indirect index map as
         # the data blocks: one block's scales arrive with its values
         in_specs += [pl.BlockSpec((1, bs, hkv), _kv_index),
@@ -253,11 +289,13 @@ def paged_decode_attention_pallas(q, pool_k, pool_v, tables, lengths,
     tables:   [B, max_blocks] int32  — physical block ids per sequence
                                        (entries >= num_blocks = unmapped)
     lengths:  [B] int32              — valid logical rows per sequence
-    k_scale/v_scale: None, or [num_blocks, bs, Hkv] fp32 scale planes
-              for an int8 pool (README "Quantized serving") — the
-              kernel DMAs int8 blocks and dequantizes in VMEM right
-              after the table-indirect fetch, so HBM traffic is int8
-              while the MXU math stays full-precision
+    k_scale/v_scale: None, or fp32 scale planes — [num_blocks, bs, Hkv]
+              per-row for an int8 pool, [num_blocks, Hkv] per-block
+              for an fp8 pool (README "Quantized serving") — the
+              kernel DMAs the quantized blocks and upcasts in VMEM
+              right after the table-indirect fetch (fused into the
+              dot), so HBM traffic is 1 byte/value while the MXU math
+              stays full-precision
     returns:  [B, H, D]
 
     The logical cache of row ``b`` is ``pool[tables[b]]`` flattened to
@@ -295,9 +333,10 @@ def paged_decode_attention_reference(q, pool_k, pool_v, tables, lengths,
     """jnp oracle with identical semantics: materialize each row's
     logical cache by gathering its table (clip-mode keeps sentinel
     entries harmless — masked by ``lengths``), then run the dense
-    ragged reference. An int8 pool (``k_scale``/``v_scale`` given)
+    ragged reference. A quantized pool (``k_scale``/``v_scale`` given)
     dequantizes right after the gather — the same
-    fetch-then-dequantize order as the Pallas kernel."""
+    fetch-then-dequantize order as the Pallas kernel; fp8's per-block
+    planes broadcast over each block's rows."""
     B = q.shape[0]
     num_blocks, bs, Hkv, D = pool_k.shape
     mb = tables.shape[1]
@@ -307,10 +346,14 @@ def paged_decode_attention_reference(q, pool_k, pool_v, tables, lengths,
     v = jnp.take(pool_v, tables, axis=0,
                  mode="clip").reshape(B, mb * bs, Hkv, D)
     if k_scale is not None:
-        ks = jnp.take(k_scale, tables, axis=0,
-                      mode="clip").reshape(B, mb * bs, Hkv)
-        vs = jnp.take(v_scale, tables, axis=0,
-                      mode="clip").reshape(B, mb * bs, Hkv)
+        ks = jnp.take(k_scale, tables, axis=0, mode="clip")
+        vs = jnp.take(v_scale, tables, axis=0, mode="clip")
+        if k_scale.ndim == 2:           # fp8: [B, mb, Hkv] per-block
+            ks = jnp.repeat(ks, bs, axis=1)
+            vs = jnp.repeat(vs, bs, axis=1)
+        else:                           # int8: [B, mb, bs, Hkv] per-row
+            ks = ks.reshape(B, mb * bs, Hkv)
+            vs = vs.reshape(B, mb * bs, Hkv)
         k = k.astype(jnp.float32) * ks[..., None]
         v = v.astype(jnp.float32) * vs[..., None]
     return decode_attention_reference(q, k, v, lengths)

@@ -66,7 +66,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_flash import _cparams, _interpret_mode
-from .pallas_paged_decode import _head_scale_mat
+from .pallas_paged_decode import _block_scale_vec, _head_scale_mat
 
 NEG_INF = -1e30
 
@@ -114,17 +114,22 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, *refs, scale,
         k = k_ref[0]                        # [block_k, KD]
         v = v_ref[0]
         if quantized:
-            # int8 pool: the table-indirect DMA above moved int8 (the
-            # HBM win); dequant happens HERE, right after it — values
-            # convert in VMEM on the way into the MXU and the per-row-
-            # per-head scales apply post-dot via the head one-hot
-            # trick (_head_scale_mat; the query block is a multiple of
-            # gh, so the row->head map is block-position-free)
+            # quantized pool: the table-indirect DMA above moved the
+            # narrow dtype (the HBM win); the upcast happens HERE,
+            # right after it — values convert in VMEM on the way into
+            # the MXU and the scales apply post-dot via the head
+            # one-hot trick (the query block is a multiple of gh, so
+            # the row->head map is block-position-free). int8 carries
+            # per-(pool-row, head) scales (_head_scale_mat); fp8
+            # carries one scale per (block, head) (_block_scale_vec),
+            # constant across the logits columns.
             k = k.astype(jnp.float32)
             v = v.astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if quantized:
+        if quantized == "fp8":
+            s = s * _block_scale_vec(ks_ref[...], tq, gh, hkv)
+        elif quantized:
             s = s * _head_scale_mat(ks_ref[0], tq, gh, hkv)
         wrow = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -148,7 +153,11 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, tbl_ref, *refs, scale,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
-        if quantized:
+        if quantized == "fp8":
+            # per-block V scale: constant across pool rows, so it
+            # collapses to a per-wide-row factor folded into P
+            p = p * _block_scale_vec(vs_ref[...], tq, gh, hkv)
+        elif quantized:
             # V dequant, same separability: fold the scales into P
             # (P_wj * sv[j, head(w)]) and dot with the raw values
             p = p * _head_scale_mat(vs_ref[0], tq, gh, hkv)
@@ -175,16 +184,21 @@ def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
                  scale, gh, block_q, interpret, scales=None):
     """q_wide: [TH_pad, KD] block-diagonal wide rows (gh per token);
     pool_*: [num_blocks, bs, KD]; tables: [R, max_blocks] int32;
-    scales: None, or ``(k_scale, v_scale)`` [num_blocks, bs, Hkv] fp32
-    planes for an int8 pool (dequant in-kernel, right after the
-    table-indirect DMA)."""
+    scales: None, or ``(k_scale, v_scale)`` fp32 planes for a
+    quantized pool (upcast in-kernel, right after the table-indirect
+    DMA): [num_blocks, bs, Hkv] per-row planes select the int8 path,
+    [num_blocks, Hkv] per-block planes select fp8 — the plane rank IS
+    the mode switch, same convention as ``pallas_paged_decode``."""
     TH, KD = q_wide.shape
     num_blocks, bs = pool_k.shape[0], pool_k.shape[1]
     R, nk = tables.shape
     nq = TH // block_q
     grid = (nq, R, nk)
-    quantized = scales is not None
-    hkv = scales[0].shape[2] if quantized else 0
+    if scales is None:
+        quantized = False
+    else:
+        quantized = "fp8" if scales[0].ndim == 2 else "int8"
+    hkv = scales[0].shape[-1] if quantized else 0
     kernel = functools.partial(_ragged_kernel, scale=scale, block_k=bs,
                                tq=block_q, gh=gh, quantized=quantized,
                                hkv=hkv)
@@ -207,7 +221,15 @@ def _ragged_call(q_wide, pool_k, pool_v, tables, qstart, qlen, kvlen,
         pl.BlockSpec((1, bs, KD), _kv_index),
     ]
     args = [qstart, qlen, kvlen, tables, q_wide, pool_k, pool_v]
-    if quantized:
+    if quantized == "fp8":
+        # per-BLOCK planes [num_blocks, hkv]: one [1, hkv] scale row
+        # rides the same table-indirect fetch as its data block
+        def _kv_index2(qi, r, ki, qs, ql, kl, tbl):
+            return _kv_index(qi, r, ki, qs, ql, kl, tbl)[:2]
+        in_specs += [pl.BlockSpec((1, hkv), _kv_index2),
+                     pl.BlockSpec((1, hkv), _kv_index2)]
+        args += [scales[0], scales[1]]
+    elif quantized:
         # the scale planes ride the SAME table-indirect index map as
         # the data blocks: one block's scales arrive with its values
         in_specs += [pl.BlockSpec((1, bs, hkv), _kv_index),
@@ -301,11 +323,14 @@ def ragged_paged_attention_pallas(q, pool_k, pool_v, tables, qstart, qlen,
     kvlen:    [R] int32 — valid logical KV rows per sequence AFTER this
                           step's writes (span token i attends over
                           positions 0 .. kvlen - qlen + i)
-    k_scale/v_scale: None, or [num_blocks, bs, Hkv] fp32 scale planes
-              for an int8 pool (README "Quantized serving") — the
-              kernel DMAs int8 blocks and dequantizes in VMEM right
-              after the table-indirect fetch, so HBM traffic is int8
-              while the MXU math stays full-precision
+    k_scale/v_scale: None, or fp32 scale planes for a quantized pool
+              (README "Quantized serving") — [num_blocks, bs, Hkv]
+              per-row planes for int8, [num_blocks, Hkv] per-block
+              planes for fp8 (plane rank = mode switch). The kernel
+              DMAs the narrow blocks and upcasts in VMEM right after
+              the table-indirect fetch — one upcast site, fused into
+              the dot — so HBM traffic is 1-byte while the MXU math
+              stays full-precision
     returns:  [T, H, D]; packed rows outside every span are exact zeros
 
     GQA is resolved with the block-diagonal wide-query trick (see
@@ -359,10 +384,11 @@ def ragged_attention_reference(q, pool_k, pool_v, tables, qstart, qlen,
     reproduces ``paged_decode_attention_reference`` and a span-n row
     reproduces ``_paged_suffix_prefill_impl``'s in-program attention
     (same einsums, same masking, same plain softmax), so the unified
-    serving step can be pinned bitwise against the old pair. An int8
-    pool (``k_scale``/``v_scale`` given) dequantizes right after the
-    two-stage gather — the same fetch-then-dequantize order as the
-    kernel."""
+    serving step can be pinned bitwise against the old pair. A
+    quantized pool (``k_scale``/``v_scale`` given) upcasts right after
+    the two-stage gather — the same fetch-then-dequantize order as the
+    kernel; per-block fp8 planes (ndim 2) broadcast over the block's
+    rows."""
     T, H, D = q.shape
     num_blocks, bs, Hkv, _ = pool_k.shape
     G = H // Hkv
@@ -393,12 +419,20 @@ def ragged_attention_reference(q, pool_k, pool_v, tables, qstart, qlen,
     v_rows = jnp.take(pool_v, tables, axis=0,
                       mode="clip").reshape(R, s_tot, Hkv, D)
     if k_scale is not None:
-        # int8 pool: dequantize right after the per-row gather (the
-        # kernel's fetch-then-dequantize order), per row and head
-        ks_rows = jnp.take(k_scale, tables, axis=0,
-                           mode="clip").reshape(R, s_tot, Hkv)
-        vs_rows = jnp.take(v_scale, tables, axis=0,
-                           mode="clip").reshape(R, s_tot, Hkv)
+        # quantized pool: upcast right after the per-row gather (the
+        # kernel's fetch-then-dequantize order). Per-block fp8 planes
+        # ([num_blocks, Hkv]) broadcast over each block's rows;
+        # per-row int8 planes apply per row and head.
+        if jnp.asarray(k_scale).ndim == 2:
+            ks_rows = jnp.repeat(jnp.take(k_scale, tables, axis=0,
+                                          mode="clip"), bs, axis=1)
+            vs_rows = jnp.repeat(jnp.take(v_scale, tables, axis=0,
+                                          mode="clip"), bs, axis=1)
+        else:
+            ks_rows = jnp.take(k_scale, tables, axis=0,
+                               mode="clip").reshape(R, s_tot, Hkv)
+            vs_rows = jnp.take(v_scale, tables, axis=0,
+                               mode="clip").reshape(R, s_tot, Hkv)
         k_rows = k_rows.astype(jnp.float32) * ks_rows[..., None]
         v_rows = v_rows.astype(jnp.float32) * vs_rows[..., None]
     k = jnp.take(k_rows, seg, axis=0)                     # [T, s_tot, ...]

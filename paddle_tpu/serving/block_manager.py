@@ -52,7 +52,21 @@ class BlockManager:
     dequantize right after the table-indirect DMA, so HBM block bytes
     are int8 (a ~4x cut vs fp32 at head_dim 64; scales cost
     ``4 / head_dim`` of the int8 data) while the matmuls stay
-    full-precision."""
+    full-precision.
+
+    ``kv_dtype="fp8"`` stores ``float8_e4m3fn`` with PER-BLOCK
+    per-head scale planes ``[L, num_blocks, Hkv]`` — ``block_size``×
+    fewer scale bytes than int8's per-row planes. The block scale is
+    the constant 1.0 by construction: e4m3's own exponent is the
+    per-value scale, and a data-dependent block scale would make a
+    block's bytes depend on WHICH program first wrote it (a decode
+    append covers one row, a prefill chunk covers the whole block), so
+    restore()-by-recompute could not replay byte-identically. The
+    planes still ride the same physical block id through every
+    lifecycle move and the kernels still apply them post-dot — the
+    structural (data, scale) plumbing is identical to int8's, only the
+    write rule differs (``kv_cache.quantize_kv_rows_fp8``: saturating
+    cast, no scale write)."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
                  head_dim, dtype=jnp.float32, kv_dtype=None, mesh=None):
@@ -60,20 +74,30 @@ class BlockManager:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
-        if kv_dtype not in (None, "int8"):
+        if kv_dtype not in (None, "int8", "fp8"):
             raise ValueError(
-                f"kv_dtype must be None (store at pool dtype) or 'int8', "
-                f"got {kv_dtype!r}")
+                f"kv_dtype must be None (store at pool dtype), 'int8' or "
+                f"'fp8', got {kv_dtype!r}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.kv_dtype = kv_dtype
-        self.quantized = kv_dtype == "int8"
+        self.quantized = kv_dtype is not None
+        self.fp8 = kv_dtype == "fp8"
         shape = (num_layers, self.num_blocks, self.block_size,
                  num_kv_heads, head_dim)
-        store = jnp.int8 if self.quantized else dtype
+        store = (jnp.float8_e4m3fn if self.fp8
+                 else jnp.int8 if self.quantized else dtype)
         self.k = jnp.zeros(shape, store)
         self.v = jnp.zeros(shape, store)
-        if self.quantized:
+        if self.fp8:
+            # per-BLOCK planes, constant 1.0 (class docstring): never
+            # rewritten by appends, only read by the kernels' post-dot
+            # rescale — initialized to ones so a fresh block
+            # dequantizes as identity
+            sshape = (num_layers, self.num_blocks, num_kv_heads)
+            self.k_scale = jnp.ones(sshape, jnp.float32)
+            self.v_scale = jnp.ones(sshape, jnp.float32)
+        elif self.quantized:
             self.k_scale = jnp.zeros(shape[:-1], jnp.float32)
             self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
         else:
@@ -97,7 +121,7 @@ class BlockManager:
             # re-spelling: a spelling difference here would read as a
             # fresh sharding to the pjit cache every step
             if self.quantized:
-                data_spec, scale_spec = _pool_pspec(True)
+                data_spec, scale_spec = _pool_pspec(self.kv_dtype)
                 scale_s = NamedSharding(mesh, scale_spec)
                 self.k_scale = jax.device_put(self.k_scale, scale_s)
                 self.v_scale = jax.device_put(self.v_scale, scale_s)
@@ -200,7 +224,10 @@ class BlockManager:
         from .kv_cache import _tier_fetch
         bid = np.int32(block)
         if self.quantized:
-            bk, bv, bks, bvs = _tier_fetch(True, self.tp)(
+            # kv_dtype keys the program: the fp8 pool's per-BLOCK scale
+            # planes are a different rank (and TP spec) than int8's
+            # per-row planes
+            bk, bv, bks, bvs = _tier_fetch(self.kv_dtype, self.tp)(
                 self.k, self.v, self.k_scale, self.v_scale, bid)
             return {"k": np.asarray(bk), "v": np.asarray(bv),
                     "k_scale": np.asarray(bks), "v_scale": np.asarray(bvs)}
@@ -219,7 +246,7 @@ class BlockManager:
         bid = np.int32(block)
         if self.quantized:
             self.k, self.v, self.k_scale, self.v_scale = _tier_inject(
-                donate, True, self.tp)(
+                donate, self.kv_dtype, self.tp)(
                     self.k, self.v, self.k_scale, self.v_scale,
                     jnp.asarray(bufs["k"]), jnp.asarray(bufs["v"]),
                     jnp.asarray(bufs["k_scale"]),

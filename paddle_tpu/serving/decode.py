@@ -47,7 +47,7 @@ from ..kernels.pallas_ragged_attention import (ragged_attention_reference,
                                                ragged_paged_attention_pallas)
 from ..models.llama import _apply_rope, _qkv_bshd, _rms, _rope_tables, \
     _swiglu_raw
-from .kv_cache import quantize_kv_rows
+from .kv_cache import quantize_kv_rows, quantize_kv_rows_fp8
 
 NEG_INF = -1e30
 
@@ -108,21 +108,110 @@ def _dq(w, dt):
     return w
 
 
-def _dq_layer(lp, dt):
+def _dq_layer(lp, dt, a8=False):
     """Per-layer weight handoff: dequantize the 7 projection entries of
     one scanned layer tuple IN the layer body — one layer materializes
     at a time, so the weight stack still streams int8 from HBM — and
     pass everything after them (norm weights, cache slices) through
-    untouched."""
+    untouched. Under ``a8`` (quantize_activations, README "Quantized
+    serving") NOTHING dequantizes: the ``(q, scale)`` pairs flow
+    straight to the int8×int8 projection helpers (``_a8_apply``), so
+    no dequantized weight copy is ever materialized in the layer
+    body."""
+    if a8:
+        return lp
     return tuple(_dq(w, dt) for w in lp[:7]) + tuple(lp[7:])
 
 
-def _dq_head(params, tied, dt):
+def _dq_head(params, tied, dt, a8=False):
     """The lm-head matmul operand, dequantized when quantized (tied
     heads transpose AFTER dequant — the scales were laid out for the
-    stored orientation)."""
-    head = _dq(params["lm_head"], dt)
+    stored orientation). Under ``a8`` the int8 pair passes through for
+    the int8×int8 head matmul, pre-oriented: tied pairs transpose data
+    AND scales — one int8 transpose, traced once outside the scan."""
+    head = params["lm_head"]
+    if a8 and isinstance(head, tuple):
+        q, s = head
+        return (q.T, s.T) if tied else (q, s)
+    head = _dq(head, dt)
     return head.T if tied else head
+
+
+# ------------------------------------------- int8×int8 activation path
+# The ``quantize_activations=True`` decode path (README "Quantized
+# serving"): every projection input is quantized per-row AT RUNTIME
+# (the shared absmax rule, ``quantization.quantize_collective_int8``)
+# and the matmul runs int8×int8 on the MXU — ``dot_general`` over the
+# narrow operands with int32 accumulate, then ONE fused
+# ``(act_scale ⊗ weight_scale)`` rescale post-dot. The projection
+# helpers below dispatch on the weight's pytree structure, so the
+# dense/w8 paths trace the exact same ops as before (the structure IS
+# the trace variant) and the a8 layer body never materializes a
+# dequantized weight.
+def quantize_act_rows(x):
+    """Per-row dynamic int8 activation quantization — each row (absmax
+    over the last axis) gets its own fp32 scale. Returns ``(q int8,
+    scale f32 [..., 1])``."""
+    from ..quantization import quantize_collective_int8
+    return quantize_collective_int8(x)
+
+
+def _a8_apply(qx, sx, w):
+    """One int8×int8 projection: quantized activations ``(qx, sx)``
+    against an int8 weight-only ``(q, scale)`` pair — int32-accumulate
+    dot, fused post-dot rescale. Returns fp32."""
+    qw, sw = w
+    acc = jax.lax.dot_general(qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sx * sw.reshape(-1)
+
+
+def _a8_dot(x, w):
+    """Quantize ``x`` per-row and run one int8×int8 projection."""
+    qx, sx = quantize_act_rows(x)
+    return _a8_apply(qx, sx, w).astype(x.dtype)
+
+
+def _qkv_proj(hn, lwq, lwk, lwv, nh, nkv, hd):
+    """The QKV projections — ``models.llama._qkv_bshd`` verbatim on
+    dense weights; under quantize_activations the input quantizes
+    per-row ONCE and feeds three int8×int8 dots."""
+    if isinstance(lwq, tuple):
+        B, S = hn.shape[0], hn.shape[1]
+        dt = hn.dtype
+        qx, sx = quantize_act_rows(hn)
+        q = _a8_apply(qx, sx, lwq).astype(dt).reshape(B, S, nh, hd)
+        k = _a8_apply(qx, sx, lwk).astype(dt).reshape(B, S, nkv, hd)
+        v = _a8_apply(qx, sx, lwv).astype(dt).reshape(B, S, nkv, hd)
+        return q, k, v
+    return _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+
+
+def _swiglu_proj(hn, lg, lu, ld):
+    """The SwiGLU MLP — ``models.llama._swiglu_raw`` verbatim on dense
+    weights; under quantize_activations gate/up share one per-row act
+    quant and down re-quantizes the gated product."""
+    if isinstance(lg, tuple):
+        qx, sx = quantize_act_rows(hn)
+        g = jax.nn.silu(_a8_apply(qx, sx, lg))
+        u = _a8_apply(qx, sx, lu)
+        return _a8_dot(g * u, ld).astype(hn.dtype)
+    return _swiglu_raw(hn, lg, lu, ld)
+
+
+def _o_proj(attn2, lwo):
+    """The attention output projection ``[B, S, nh*hd] @ wo``."""
+    if isinstance(lwo, tuple):
+        return _a8_dot(attn2, lwo)
+    return jnp.einsum("bsd,dh->bsh", attn2, lwo)
+
+
+def _head_logits(last_h, head):
+    """The lm-head matmul ``[B, H] @ head`` (the pair arrives
+    pre-oriented from ``_dq_head`` under a8)."""
+    if isinstance(head, tuple):
+        return _a8_dot(last_h, head)
+    return jnp.einsum("bh,hv->bv", last_h, head)
 
 
 # ------------------------------------------------- int8 block-pool view
@@ -148,11 +237,18 @@ def _kv_attn_args(pool_k, pool_v):
 
 def _kv_write(pool_l, phys, row, x):
     """Scatter K/V rows ``x [..., Hkv, D]`` into one layer's pool slice
-    at ``(phys, row)`` — quantizing on write (data + per-row-per-head
-    scales to the SAME coordinates) on an int8 pool. Drop-mode both
-    ways: a dead row vanishes from data and scales alike."""
+    at ``(phys, row)`` — quantizing on write on a quantized pool.
+    int8 writes data + per-row-per-head scales to the SAME
+    coordinates; fp8 is a data-only saturating cast
+    (``quantize_kv_rows_fp8``) — its per-BLOCK scale planes are the
+    constant 1.0 and are never written by appends (the determinism
+    argument in ``BlockManager``'s docstring). Drop-mode both ways: a
+    dead row vanishes from data and scales alike."""
     if isinstance(pool_l, tuple):
         data, sc = pool_l
+        if data.dtype == jnp.float8_e4m3fn:
+            return (data.at[phys, row].set(quantize_kv_rows_fp8(x),
+                                           mode="drop"), sc)
         q, s = quantize_kv_rows(x)
         return (data.at[phys, row].set(q, mode="drop"),
                 sc.at[phys, row].set(s, mode="drop"))
@@ -161,17 +257,37 @@ def _kv_write(pool_l, phys, row, x):
 
 def _kv_gather_rows(pool_l, tables, shape4):
     """Gather per-row logical caches through the block tables
-    (clip-mode; the suffix-prefill oracle path), dequantizing right
-    after the gather on an int8 pool. ``shape4`` is the target
-    ``(G, s_tot, Hkv, D)``."""
+    (clip-mode; the suffix-prefill oracle path). ``shape4`` is the
+    target ``(G, s_tot, Hkv, D)``. On a quantized pool the rows come
+    back in the pool's NATIVE narrow dtype — no dequantized fp copy is
+    materialized; the upcast fuses into the attention dots and the
+    scales return separately (normalized to ``[G, s_tot, Hkv]``; fp8's
+    per-block planes broadcast over each block's rows) for the
+    post-dot rescale (``_row_scale_bhqk``). Returns
+    ``(rows, scale_rows_or_None)``."""
     if isinstance(pool_l, tuple):
         data, sc = pool_l
         rows = jnp.take(data, tables, axis=0,
                         mode="clip").reshape(shape4)
-        srows = jnp.take(sc, tables, axis=0,
-                         mode="clip").reshape(shape4[:-1])
-        return rows.astype(jnp.float32) * srows[..., None]
-    return jnp.take(pool_l, tables, axis=0, mode="clip").reshape(shape4)
+        if sc.ndim == 2:         # fp8 per-block planes [nb, Hkv]
+            srows = jnp.repeat(jnp.take(sc, tables, axis=0, mode="clip"),
+                               shape4[-3] // tables.shape[1], axis=1)
+        else:                    # int8 per-row planes [nb, bs, Hkv]
+            srows = jnp.take(sc, tables, axis=0,
+                             mode="clip").reshape(shape4[:-1])
+        return rows, srows
+    rows = jnp.take(pool_l, tables, axis=0, mode="clip").reshape(shape4)
+    return rows, None
+
+
+def _row_scale_bhqk(srows, grp):
+    """Reshape gathered per-KV-row scales ``[G, s_tot, Hkv]`` into the
+    ``[G, H, 1, s_tot]`` factor the suffix path's post-dot rescale
+    broadcasts against its ``bhqk`` logits/probs — the gather-path
+    twin of the kernels' head one-hot trick (each query head h reads
+    its KV group's scale)."""
+    sf = jnp.repeat(srows, grp, axis=2) if grp > 1 else srows
+    return jnp.transpose(sf, (0, 2, 1))[:, :, None, :]
 
 
 # --------------------------------------------- tensor parallel (TP) plumbing
@@ -268,9 +384,14 @@ def _params_pspec(wq8):
 
 def _pool_pspec(kv_quant):
     """PartitionSpec for one pool side: blocks replicated, HEADS
-    sharded (axis 3 of ``[L, nb, bs, Hkv, D]``); an int8 pool's scale
-    plane ``[L, nb, bs, Hkv]`` partitions on the same head axis."""
+    sharded (axis 3 of ``[L, nb, bs, Hkv, D]``). A quantized pool's
+    scale planes partition on the same head axis — int8's per-row
+    planes ``[L, nb, bs, Hkv]`` on axis 3, fp8's per-BLOCK planes
+    ``[L, nb, Hkv]`` on axis 2. ``kv_quant``: False, "int8"/"fp8", or
+    True (int8 back-compat)."""
     data = PartitionSpec(None, None, None, TP_AXIS)
+    if kv_quant == "fp8":
+        return (data, PartitionSpec(None, None, TP_AXIS))
     if kv_quant:
         return (data, PartitionSpec(None, None, None, TP_AXIS))
     return data
@@ -351,7 +472,7 @@ def sample_rows(logits, keys, temps, top_ks):
 
 # ------------------------------------------------------------------ prefill
 def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
-                  hd, eps, theta, tied, tp_reduce=None):
+                  hd, eps, theta, tied, tp_reduce=None, a8=False):
     """Batched prefill: ids [G, S_pad] (right-padded prompts), lengths
     [G] real token counts, per-row keys/temps/top_ks.
 
@@ -366,18 +487,19 @@ def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
     sin, cos = _rope_tables(S, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
     wdt = params["embed"].dtype
-    head = _dq_head(params, tied, wdt)
+    head = _dq_head(params, tied, wdt, a8)
 
     def prefill_layer(h, lp):
-        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = _dq_layer(lp, wdt)
+        (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost) = \
+            _dq_layer(lp, wdt, a8)
         hn = _rms(h, lin, eps)
-        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q, k, v = _qkv_proj(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope(q, sin, cos)
         k = _apply_rope(k, sin, cos)
         attn = _attention(q, k, v, causal=True)
-        o = jnp.einsum("bsd,dh->bsh", attn.reshape(B, S, nh * hd), lwo)
+        o = _o_proj(attn.reshape(B, S, nh * hd), lwo)
         h = h + (o if tp_reduce is None else tp_reduce(o))
-        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        m = _swiglu_proj(_rms(h, lpost, eps), lg, lu, ld)
         h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (k, v)
 
@@ -386,14 +508,14 @@ def _prefill_impl(params, ids, lengths, keys, temps, top_ks, *, nh, nkv,
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [G, H]
     last_h = _rms(last, params["final_norm"], eps)
-    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    logits = _head_logits(last_h, head)
     both = jax.vmap(jax.random.split)(keys)  # [G, 2, 2]
     tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
     return pk, pv, tok0, both[:, 0]
 
 
 def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied, tp=1,
-                     collective_dtype="fp", wq8=False):
+                     collective_dtype="fp", wq8=False, a8=False):
     """One jitted prefill; jax retraces per (group, prompt-bucket)
     shape — both padded to powers of two by the engine. ``tp > 1``
     wraps it in shard_map over the heads-sharded mesh (README
@@ -406,7 +528,7 @@ def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied, tp=1,
         impl = functools.partial(
             _prefill_impl, nh=nh // tp, nkv=nkv // tp, hd=hd, eps=eps,
             theta=theta, tied=tied,
-            tp_reduce=_tp_allreduce(collective_dtype, tp))
+            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
         rep = PartitionSpec()
         return jax.jit(_tp_shard(
             impl, tp,
@@ -415,7 +537,7 @@ def build_prefill_fn(*, nh, nkv, hd, eps, theta, tied, tp=1,
                        rep, rep)))
     return jax.jit(functools.partial(
         _prefill_impl, nh=nh, nkv=nkv, hd=hd, eps=eps, theta=theta,
-        tied=tied))
+        tied=tied, a8=a8))
 
 
 # ------------------------------------------------------------ suffix prefill
@@ -535,7 +657,7 @@ def build_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied, donate=None):
 def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
                                ids, suffix_lens, keys, temps, top_ks, *,
                                nh, nkv, hd, eps, theta, tied,
-                               tp_reduce=None):
+                               tp_reduce=None, a8=False):
     """Suffix prefill through per-row block tables: the paged twin of
     ``_suffix_prefill_impl``, reading/writing the BlockManager pool
     instead of per-slot dense caches.
@@ -564,10 +686,13 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     tables/lengths/knobs are runtime arrays, so the compile set stays
     the same pow2 (group, bucket) grid as the dense suffix path.
 
-    Returns (pool_k', pool_v', tok0, keys'). On an int8 pool each side
-    arrives (and returns) as a ``(data, scale)`` pair: suffix K/V
-    quantize on write (``_kv_write``) and the in-program attention
-    dequantizes right after the table gather (``_kv_gather_rows``).
+    Returns (pool_k', pool_v', tok0, keys'). On a quantized pool
+    (int8 or fp8) each side arrives (and returns) as a
+    ``(data, scale)`` pair: suffix K/V quantize on write
+    (``_kv_write``) and the in-program attention reads the pool
+    NATIVELY — the table gather keeps the narrow dtype
+    (``_kv_gather_rows``), the upcast fuses into the attention dots,
+    and the scales apply post-dot — no materialized fp round-trip.
     """
     G, S = ids.shape
     nb, bs = _kv_data(pool_k).shape[1], _kv_data(pool_k).shape[2]
@@ -576,7 +701,7 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
     wdt = params["embed"].dtype
-    head = _dq_head(params, tied, wdt)
+    head = _dq_head(params, tied, wdt, a8)
 
     pos = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     sin_p = jnp.take(sin, pos, axis=0, mode="clip")   # [G, S, D]
@@ -602,32 +727,40 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
 
     def layer(h, lp):
         (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = \
-            _dq_layer(lp, wdt)
+            _dq_layer(lp, wdt, a8)
         hn = _rms(h, lin, eps)
-        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q, k, v = _qkv_proj(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_grid(q, sin_p, cos_p)
         k = _apply_rope_grid(k, sin_p, cos_p)
         # write the suffix K/V through the table (quantize-on-write on
-        # an int8 pool), then gather each row's logical cache (shared
-        # prefix + own suffix) for attention — dequantized right after
-        # the gather; the causal mask keeps columns from seeing rows
-        # past their position
+        # a quantized pool), then gather each row's logical cache
+        # (shared prefix + own suffix) in the pool's NATIVE dtype — the
+        # upcast fuses into the attention dots and the scales apply
+        # POST-dot (``_row_scale_bhqk``), so a quantized pool never
+        # round-trips through a materialized fp copy; the causal mask
+        # keeps columns from seeing rows past their position
         pk_l = _kv_write(pk_l, phys, prow, k)
         pv_l = _kv_write(pv_l, phys, prow, v)
-        ck = _kv_gather_rows(pk_l, tables, (G, s_tot, nkv, hd))
-        cv = _kv_gather_rows(pv_l, tables, (G, s_tot, nkv, hd))
+        ck, ksr = _kv_gather_rows(pk_l, tables, (G, s_tot, nkv, hd))
+        cv, vsr = _kv_gather_rows(pv_l, tables, (G, s_tot, nkv, hd))
         kf = jnp.repeat(ck, grp, axis=2) if grp > 1 else ck
         vf = jnp.repeat(cv, grp, axis=2) if grp > 1 else cv
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf.astype(q.dtype),
                             preferred_element_type=jnp.float32) * scale
+        if ksr is not None:
+            logits = logits * _row_scale_bhqk(ksr, grp)
         logits = jnp.where(mask[:, None], logits, NEG_INF)
         probs = jax.nn.softmax(logits, axis=-1)
         probs = jnp.where(mask[:, None], probs, 0.0)
-        vf = jnp.where(row_valid[:, :, None, None], vf, 0.0)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vf)
-        o = jnp.einsum("bsd,dh->bsh", attn.reshape(G, S, nh * hd), lwo)
+        if vsr is not None:
+            probs = probs * _row_scale_bhqk(vsr, grp)
+        vf = jnp.where(row_valid[:, :, None, None], vf,
+                       jnp.zeros_like(vf))
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype),
+                          vf.astype(q.dtype))
+        o = _o_proj(attn.reshape(G, S, nh * hd), lwo)
         h = h + (o if tp_reduce is None else tp_reduce(o))
-        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        m = _swiglu_proj(_rms(h, lpost, eps), lg, lu, ld)
         h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
@@ -636,7 +769,7 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
     last = jnp.take_along_axis(
         x, (suffix_lens - 1)[:, None, None], axis=1)[:, 0]  # [G, H]
     last_h = _rms(last, params["final_norm"], eps)
-    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    logits = _head_logits(last_h, head)
     both = jax.vmap(jax.random.split)(keys)  # [G, 2, 2]
     tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
     return npk, npv, tok0, both[:, 0]
@@ -645,7 +778,7 @@ def _paged_suffix_prefill_impl(params, pool_k, pool_v, tables, prefix_lens,
 def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
                                   donate=None, tp=1,
                                   collective_dtype="fp", kv_quant=False,
-                                  wq8=False):
+                                  wq8=False, a8=False):
     """One jitted paged suffix prefill — doubling as THE chunked-prefill
     program (see ``_paged_suffix_prefill_impl``); retraces per (group,
     bucket) shape — same bounded pow2 grid as the dense suffix path.
@@ -659,7 +792,7 @@ def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
         impl = functools.partial(
             _paged_suffix_prefill_impl, nh=nh // tp, nkv=nkv // tp,
             hd=hd, eps=eps, theta=theta, tied=tied,
-            tp_reduce=_tp_allreduce(collective_dtype, tp))
+            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -669,7 +802,7 @@ def build_paged_suffix_prefill_fn(*, nh, nkv, hd, eps, theta, tied,
             donate_argnums=(1, 2) if donate else ())
     return jax.jit(
         functools.partial(_paged_suffix_prefill_impl, nh=nh, nkv=nkv, hd=hd,
-                          eps=eps, theta=theta, tied=tied),
+                          eps=eps, theta=theta, tied=tied, a8=a8),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -834,7 +967,8 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 # ------------------------------------------------------ unified ragged step
 def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
                        pv_all, lens, kys, app_mask, temps, top_ks, *, nh,
-                       nkv, hd, eps, decode_attn, tp_reduce=None):
+                       nkv, hd, eps, decode_attn, tp_reduce=None,
+                       a8=False):
     """ONE fused decode tick over all rows — THE shared tail body of
     the unified ragged step's scan and the multi-tick step's
     while_loop (the two must compute identically or ``decode_ticks>1``
@@ -862,9 +996,9 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
 
     def layer(h, xs):
         lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l = \
-            _dq_layer(xs, wdt)
+            _dq_layer(xs, wdt, a8)
         hn = _rms(h, lin, eps)
-        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q, k, v = _qkv_proj(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_rows(q, sin_r, cos_r)
         k = _apply_rope_rows(k, sin_r, cos_r)
         pk_l = _kv_write(pk_l, phys, prow, k[:, 0])
@@ -878,15 +1012,15 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
             attn = paged_decode_attention_reference(
                 q[:, 0], kd, vd, tables, lens + app_mask,
                 k_scale=ksc, v_scale=vsc)
-        o = jnp.einsum("bsd,dh->bsh", attn.reshape(R, 1, nh * hd), lwo)
+        o = _o_proj(attn.reshape(R, 1, nh * hd), lwo)
         h = h + (o if tp_reduce is None else tp_reduce(o))
-        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        m = _swiglu_proj(_rms(h, lpost, eps), lg, lu, ld)
         h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
     x, (npk, npv) = jax.lax.scan(layer, x, stack + (pk_all, pv_all))
     lastt = _rms(x[:, 0], params["final_norm"], eps)
-    lgt = jnp.einsum("bh,hv->bv", lastt, head)
+    lgt = _head_logits(lastt, head)
     b2 = jax.vmap(jax.random.split)(kys)
     nxt = sample_rows(lgt, b2[:, 1], temps, top_ks)
     return nxt, npk, npv, b2[:, 0]
@@ -904,7 +1038,7 @@ def _span_last_sample(params, head, x, qstart, qlen, keys, temps, top_ks,
     last_idx = jnp.clip(qstart + qlen - 1, 0, T - 1)
     last = jnp.take(x[0], last_idx, axis=0)                 # [R, H]
     last_h = _rms(last, params["final_norm"], eps)
-    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    logits = _head_logits(last_h, head)
     both = jax.vmap(jax.random.split)(keys)                 # [R, 2, 2]
     tok0 = sample_rows(logits, both[:, 1], temps, top_ks)
     return tok0, both[:, 0]
@@ -912,7 +1046,7 @@ def _span_last_sample(params, head, x, qstart, qlen, keys, temps, top_ks,
 
 def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, sin, cos, *, nh, nkv, hd,
-                         eps, decode_attn, tp_reduce=None):
+                         eps, decode_attn, tp_reduce=None, a8=False):
     """ONE forward pass over a packed buffer of variable-length query
     spans through the block tables — the shared tick-0 assembly of the
     unified ragged step AND the speculative verify program (the two
@@ -945,9 +1079,9 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
 
     def layer0(h, lp):
         (lwq, lwk, lwv, lwo, lg, lu, ld, lin, lpost, pk_l, pv_l) = \
-            _dq_layer(lp, wdt)
+            _dq_layer(lp, wdt, a8)
         hn = _rms(h, lin, eps)
-        q, k, v = _qkv_bshd(hn, lwq, lwk, lwv, nh, nkv, hd)
+        q, k, v = _qkv_proj(hn, lwq, lwk, lwv, nh, nkv, hd)
         q = _apply_rope_grid(q, sin_p, cos_p)
         k = _apply_rope_grid(k, sin_p, cos_p)
         # write the packed K/V through the tables (quantize-on-write on
@@ -967,9 +1101,9 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
             attn = ragged_attention_reference(
                 q[0], kd, vd, tables, qstart, qlen, kvlen,
                 k_scale=ksc, v_scale=vsc)
-        o = jnp.einsum("bsd,dh->bsh", attn.reshape(1, T, nh * hd), lwo)
+        o = _o_proj(attn.reshape(1, T, nh * hd), lwo)
         h = h + (o if tp_reduce is None else tp_reduce(o))
-        m = _swiglu_raw(_rms(h, lpost, eps), lg, lu, ld)
+        m = _swiglu_proj(_rms(h, lpost, eps), lg, lu, ld)
         h = h + (m if tp_reduce is None else tp_reduce(m))
         return h, (pk_l, pv_l)
 
@@ -981,7 +1115,7 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
 def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, dec_mask, keys, temps, top_ks,
                       *, n_steps, nh, nkv, hd, eps, theta, tied,
-                      decode_attn, tp_reduce=None):
+                      decode_attn, tp_reduce=None, a8=False):
     """THE unified serving step: one device call that advances every
     slot's span — decode rows (span 1) and prefill chunks (span n) —
     through the same block tables, collapsing the
@@ -1026,13 +1160,13 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = _dq_head(params, tied, params["embed"].dtype)
+    head = _dq_head(params, tied, params["embed"].dtype, a8)
 
     # ----------------------------------- tick 0 (shared packed forward)
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn, tp_reduce=tp_reduce)
+        decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
     tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
                                       keys, temps, top_ks, eps)
 
@@ -1047,7 +1181,8 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
         nxt, npk, npv, nkeys = _fused_decode_tick(
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, dec_mask, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce)
+            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce,
+            a8=a8)
         return (nxt, npk, npv, lens + dec_mask, nkeys), nxt
 
     if n_steps > 1:
@@ -1063,7 +1198,7 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
                          decode_attn, donate=None, tp=1,
                          collective_dtype="fp", kv_quant=False,
-                         wq8=False):
+                         wq8=False, a8=False):
     """One jitted unified serving step (``_ragged_step_impl``): shapes
     depend only on ``(num_slots, token_budget)`` plus the fused
     ``n_steps`` — one compilation per step size serves every span mix,
@@ -1084,7 +1219,7 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
             _ragged_step_impl, n_steps=n_steps, nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp))
+            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -1095,7 +1230,8 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
     return jax.jit(
         functools.partial(
             _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn,
+            a8=a8),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -1104,7 +1240,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, dec_mask, keys, temps,
                          top_ks, eos_ids, budgets, n_ticks, *, max_ticks,
                          nh, nkv, hd, eps, theta, tied, decode_attn,
-                         tp_reduce=None):
+                         tp_reduce=None, a8=False):
     """THE multi-tick serving step (README "Multi-tick decode"): the
     unified ragged step with the host driven out of the per-token loop.
     Tick 0 is ``_ragged_step_impl``'s packed forward verbatim (decode
@@ -1152,13 +1288,13 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
     stack = tuple(params[k] for k in _STACK_KEYS)
-    head = _dq_head(params, tied, params["embed"].dtype)
+    head = _dq_head(params, tied, params["embed"].dtype, a8)
 
     # ----------------------------------- tick 0 (shared packed forward)
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn, tp_reduce=tp_reduce)
+        decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
     tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
                                       keys, temps, top_ks, eps)
 
@@ -1185,7 +1321,8 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
         nxt, npk, npv, nkeys = _fused_decode_tick(
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, am, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce)
+            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce,
+            a8=a8)
         tb = tb.at[t].set(nxt)
         kb = kb.at[t].set(nkeys)
         # the host's _maybe_finish rule, in-program: after emitting
@@ -1204,7 +1341,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
                             decode_attn, donate=None, tp=1,
                             collective_dtype="fp", kv_quant=False,
-                            wq8=False):
+                            wq8=False, a8=False):
     """One jitted multi-tick serving step (``_multitick_step_impl``):
     shapes depend only on ``(num_slots, token_budget, max_ticks)`` —
     the tick count actually run is a RUNTIME argument, so one
@@ -1221,7 +1358,7 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
             _multitick_step_impl, max_ticks=int(max_ticks), nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp))
+            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -1233,7 +1370,7 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _multitick_step_impl, max_ticks=int(max_ticks), nh=nh,
             nkv=nkv, hd=hd, eps=eps, theta=theta, tied=tied,
-            decode_attn=decode_attn),
+            decode_attn=decode_attn, a8=a8),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -1241,7 +1378,7 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
 def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, sample_start, keys, temps,
                       top_ks, *, spec_len, nh, nkv, hd, eps, theta, tied,
-                      decode_attn, tp_reduce=None):
+                      decode_attn, tp_reduce=None, a8=False):
     """THE speculative serving step (README "Speculative decoding"):
     one device call that scores every slot's draft-extended span — a
     verify row packs ``[last_token, d_1 .. d_k]`` at positions
@@ -1289,12 +1426,12 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     R = tables.shape[0]
     s_tot = tables.shape[1] * _kv_data(pool_k).shape[2]
     sin, cos = _rope_tables(s_tot, hd, theta)
-    head = _dq_head(params, tied, params["embed"].dtype)
+    head = _dq_head(params, tied, params["embed"].dtype, a8)
 
     x, pk, pv = _packed_span_forward(
         params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
         kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn, tp_reduce=tp_reduce)
+        decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
     # per-row sample positions: spec_len consecutive packed rows from
     # sample_start, clamped inside the row's span (idle rows clamp to
     # row 0 — garbage the host never reads)
@@ -1304,7 +1441,7 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                    qstart[:, None], span_end[:, None])       # [R, S]
     hsel = jnp.take(x[0], idx.reshape(-1), axis=0)           # [R*S, H]
     last_h = _rms(hsel, params["final_norm"], eps)
-    logits = jnp.einsum("bh,hv->bv", last_h, head)
+    logits = _head_logits(last_h, head)
     logits = logits.reshape(R, spec_len, -1)
 
     def walk(kys, lg_j):
@@ -1320,7 +1457,7 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
                          decode_attn, donate=None, tp=1,
                          collective_dtype="fp", kv_quant=False,
-                         wq8=False):
+                         wq8=False, a8=False):
     """One jitted speculative verify step (``_spec_verify_impl``):
     shapes depend only on ``(num_slots, spec token budget, spec_len)``
     — one compilation serves every draft/acceptance/chunk mix, the
@@ -1336,7 +1473,7 @@ def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
             _spec_verify_impl, spec_len=spec_len, nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp))
+            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -1347,5 +1484,6 @@ def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
     return jax.jit(
         functools.partial(
             _spec_verify_impl, spec_len=spec_len, nh=nh, nkv=nkv, hd=hd,
-            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn),
+            eps=eps, theta=theta, tied=tied, decode_attn=decode_attn,
+            a8=a8),
         donate_argnums=(1, 2) if donate else ())

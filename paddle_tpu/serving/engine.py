@@ -178,7 +178,8 @@ class ContinuousBatchingEngine:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  step_clock=None, spec_decode=False, spec_k=4,
                  drafter=None, decode_ticks=1, kv_dtype=None,
-                 quantize_weights=False, tp=1, collective_dtype="fp",
+                 quantize_weights=False, quantize_activations=False,
+                 tp=1, collective_dtype="fp",
                  host_tier_bytes=0, priority_classes=None):
         c = model.config
         # multi-tenant SLO policy (README "Multi-tenant SLO serving"):
@@ -246,17 +247,31 @@ class ContinuousBatchingEngine:
             self._tp_mesh = _tp_mesh(self._tp)
         else:
             self._tp_mesh = None
-        if kv_dtype not in (None, "int8"):
+        if kv_dtype not in (None, "int8", "fp8"):
             raise ValueError(
-                f"kv_dtype must be None (store KV at the pool dtype) or "
-                f"'int8', got {kv_dtype!r}")
-        if kv_dtype == "int8" and not (paged_attn and ragged_step):
+                f"kv_dtype must be None (store KV at the pool dtype), "
+                f"'int8' or 'fp8', got {kv_dtype!r}")
+        if kv_dtype is not None and not (paged_attn and ragged_step):
             raise ValueError(
-                "kv_dtype='int8' requires the unified ragged paged "
-                "engine (paged_attn=True, ragged_step=True): the int8 "
-                "pool's one dequant site is the ragged attention "
-                "kernel, and the dense / two-program paths never grew "
-                "scale-plane plumbing")
+                f"kv_dtype={kv_dtype!r} requires the unified ragged "
+                f"paged engine (paged_attn=True, ragged_step=True): the "
+                f"quantized pool's one upcast site is the ragged "
+                f"attention kernel, and the dense / two-program paths "
+                f"never grew scale-plane plumbing")
+        if quantize_activations and not quantize_weights:
+            raise ValueError(
+                "quantize_activations=True requires "
+                "quantize_weights=True: the int8xint8 projection path "
+                "contracts runtime-quantized activations against the "
+                "int8 weight pytree, so there is no activation-only "
+                "variant")
+        if quantize_activations and not (paged_attn and ragged_step):
+            raise ValueError(
+                "quantize_activations=True requires the unified ragged "
+                "paged engine (paged_attn=True, ragged_step=True): only "
+                "the packed-span programs grew the int8xint8 projection "
+                "path, and a dense-path decode would silently fall back "
+                "to weight-dequant matmuls")
         self.model = model
         self.config = c
         self.num_slots = int(num_slots)
@@ -264,19 +279,30 @@ class ContinuousBatchingEngine:
         self._bucketing = prefill_bucketing
         self._params, self._tied = llama_decode_params(model)
         self._paged = bool(paged_attn)
-        # int8 block-quantized KV (README "Quantized serving"): the
-        # pool stores int8 with per-row-per-head fp32 scale planes, the
-        # append paths quantize on write, and the ragged kernel
-        # dequantizes after the table-indirect DMA. Default None keeps
-        # the pool at the model dtype — every banked baseline is
-        # byte-identical to before the knob existed.
-        self._kv_quant = kv_dtype == "int8"
+        # quantized KV pool (README "Quantized serving"): "int8" stores
+        # int8 with per-row-per-head fp32 scale planes, "fp8" stores
+        # float8_e4m3fn with per-BLOCK planes (constant 1.0 — e4m3's
+        # exponent is the per-value scale; see BlockManager). Either
+        # way the append paths quantize on write and the attention
+        # kernels upcast in-register after the table-indirect DMA.
+        # Default None keeps the pool at the model dtype — every banked
+        # baseline is byte-identical to before the knob existed.
+        # _kv_quant carries the MODE (falsy None / "int8" / "fp8"): the
+        # builders and _pool_pspec dispatch on the string.
+        self._kv_quant = kv_dtype
         self._kv_dtype = kv_dtype
         # int8 weight-only decode matmuls: convert ONCE per model (the
         # converted pytree is model-resident, so the factory's rebuilds
         # and every fleet replica share both the quantized arrays and
         # the jit cache — decode_compilations()==1 across rebuilds)
         self._wq8 = bool(quantize_weights)
+        # int8xint8 decode projections (README "Quantized serving"):
+        # activations quantize per-row at runtime and contract against
+        # the int8 weights with int32 accumulate — the per-layer weight
+        # DEQUANT disappears from the scanned layer body (the AST pin
+        # in tests/test_cost_observatory.py holds it there). Default
+        # False keeps the weight-only path byte-identical.
+        self._a8 = bool(quantize_activations)
         if self._wq8:
             from .decode import quantize_decode_params
             qp = model.__dict__.get("_decode_qparams")
@@ -293,8 +319,10 @@ class ContinuousBatchingEngine:
         # collective dtype) is a variant the same way: a sharded
         # program is a different trace of the same impl, so tp=2 and
         # tp=1 engines sharing one jit_cache must key apart.
-        self._kvtag = ("kv8",) if self._kv_quant else ()
+        self._kvtag = (("kv8f",) if self._kv_dtype == "fp8"
+                       else ("kv8",) if self._kv_quant else ())
         self._wtag = ("w8",) if self._wq8 else ()
+        self._atag = ("a8",) if self._a8 else ()
         self._tptag = ((f"tp{self._tp}", self._coll_dtype)
                        if self._tp > 1 else ())
         if self._tp > 1:
@@ -322,7 +350,8 @@ class ContinuousBatchingEngine:
             # the pool's STORAGE dtype follows kv_dtype (int8 data +
             # scale planes), not the model dtype — a shared pool must
             # match the engine's quantization mode exactly
-            store = jnp.int8 if self._kv_quant else dtype
+            store = (jnp.float8_e4m3fn if self._kv_dtype == "fp8"
+                     else jnp.int8 if self._kv_quant else dtype)
             # TP partitions the pool's HEAD axis across the mesh: the
             # BlockManager commits its arrays with that sharding once,
             # so every sharded program adopts them zero-copy
@@ -334,8 +363,8 @@ class ContinuousBatchingEngine:
                 have = (pool.k.shape[0],) + pool.k.shape[3:]
                 if have != want or pool.k.dtype != store \
                         or pool.block_size != bs \
-                        or getattr(pool, "quantized",
-                                   False) != self._kv_quant:
+                        or getattr(pool, "kv_dtype",
+                                   None) != self._kv_dtype:
                     raise ValueError(
                         f"shared PrefixCache pool geometry "
                         f"{have}/bs={pool.block_size}/{pool.k.dtype} does "
@@ -664,17 +693,26 @@ class ContinuousBatchingEngine:
         return dict(tp=self._tp, collective_dtype=self._coll_dtype,
                     kv_quant=self._kv_quant, wq8=self._wq8)
 
+    def _q_consts(self):
+        """Builder kwargs of the activation-quantized variant ({} when
+        off, so default engines call the builders exactly as before).
+        Only the builders that grew the int8xint8 path take ``a8`` —
+        the validation above keeps a8 engines off the dense/two-program
+        builders."""
+        return dict(a8=True) if self._a8 else {}
+
     def _prefill_fn(self):
         # the weight tag (not the kv tag): the cold prefill touches the
         # params but never the pool, so two engines differing only in
         # kv_dtype SHARE this trace while a quantized-weights engine
         # (different param pytree = different trace) keys apart. The
         # TP tag joins: a sharded prefill is a different program.
-        key = ("prefill",) + self._wtag + self._tptag
+        key = ("prefill",) + self._wtag + self._atag + self._tptag
         if key not in self._jit:
             tpk = self._tp_consts()
             tpk.pop("kv_quant", None)   # prefill never touches the pool
-            self._jit[key] = build_prefill_fn(**self._fn_consts(), **tpk)
+            self._jit[key] = build_prefill_fn(**self._fn_consts(), **tpk,
+                                              **self._q_consts())
         # host_out: the engine fetches tok0 (result 2); pk/pv feed the
         # cache writer device-side and keys stay device state
         return self._wrap_prog(key, self._jit[key], host_out=(2,))
@@ -685,12 +723,13 @@ class ContinuousBatchingEngine:
         # apart; the cold prefill is IDENTICAL either way and is shared.
         # The suffix program touches params AND pool — all three tags.
         key = (("psuffix",) if self._paged else ("suffix",)) \
-            + self._kvtag + self._wtag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag
         if key not in self._jit:
             build = (build_paged_suffix_prefill_fn if self._paged
                      else build_suffix_prefill_fn)
             self._jit[key] = build(**self._fn_consts(),
-                                   **self._tp_consts())
+                                   **self._tp_consts(),
+                                   **self._q_consts())
         return self._wrap_prog(key, self._jit[key], host_out=(2,))
 
     def _decode_fn(self, n_steps):
@@ -715,12 +754,13 @@ class ContinuousBatchingEngine:
         # slots=16/chunk=56 share a token budget of 72)
         key = ("ragged", self.num_slots, self._token_budget,
                int(n_steps), self.config.decode_attention) \
-            + self._kvtag + self._wtag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag
         if key not in self._jit:
             self._jit[key] = build_ragged_step_fn(
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts(), **self._tp_consts())
+                **self._fn_consts(), **self._tp_consts(),
+                **self._q_consts())
         # host reads the sampled tokens and the tick-0 keys (chunk
         # installs); keys_fin is adopted device-side via jnp.where
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
@@ -733,13 +773,14 @@ class ContinuousBatchingEngine:
         # argument, so this is the engine's ONE decode program.
         key = ("mtick", self.num_slots, self._token_budget,
                self._decode_ticks, self.config.decode_attention) \
-            + self._kvtag + self._wtag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag
         if key not in self._jit:
             from .decode import build_multitick_step_fn
             self._jit[key] = build_multitick_step_fn(
                 max_ticks=self._decode_ticks,
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts(), **self._tp_consts())
+                **self._fn_consts(), **self._tp_consts(),
+                **self._q_consts())
         # host reads the sampled token block, the key walk (per-slot
         # adoption at each slot's trim cut) and the ticks-run scalar
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3, 4))
@@ -750,13 +791,14 @@ class ContinuousBatchingEngine:
         # trace apart from other engines sharing one jit_cache
         key = ("spec", self.num_slots, self._spec_budget,
                self._spec_len, self.config.decode_attention) \
-            + self._kvtag + self._wtag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag
         if key not in self._jit:
             from .decode import build_spec_verify_fn
             self._jit[key] = build_spec_verify_fn(
                 spec_len=self._spec_len,
                 decode_attn=self.config.decode_attention,
-                **self._fn_consts(), **self._tp_consts())
+                **self._fn_consts(), **self._tp_consts(),
+                **self._q_consts())
         # host reads the sampled walk tokens AND the key walk (both are
         # np.asarray'd for acceptance)
         return self._wrap_prog(key, self._jit[key], host_out=(2, 3))
@@ -827,11 +869,11 @@ class ContinuousBatchingEngine:
     @property
     def kv_dtype(self) -> str:
         """The EFFECTIVE KV storage dtype this engine serves from:
-        ``"int8"`` on a quantized pool, else the pool's array dtype
-        name — the public surface for banners/metrics (README
-        "Quantized serving")."""
+        ``"int8"`` / ``"fp8"`` on a quantized pool, else the pool's
+        array dtype name — the public surface for banners/metrics
+        (README "Quantized serving")."""
         if self._kv_quant:
-            return "int8"
+            return self._kv_dtype
         arr = self.cache.pool.k if self._paged else self.cache.k
         return str(arr.dtype)
 
@@ -841,6 +883,14 @@ class ContinuousBatchingEngine:
         weight-only (converted once at engine build) — the public
         surface for banners/metrics."""
         return self._wq8
+
+    @property
+    def quantize_activations(self) -> bool:
+        """Whether the decode-path projections run int8xint8 — per-row
+        runtime activation quant contracted against the int8 weights
+        with int32 accumulate, no per-layer weight dequant — the public
+        surface for banners/metrics (README "Quantized serving")."""
+        return self._a8
 
     @property
     def ragged_step(self) -> bool:
@@ -874,7 +924,7 @@ class ContinuousBatchingEngine:
         ``("tpN", dtype)``-tagged traces, so the pin covers the
         shard_map program and a tp=1 sibling sharing the jit cache
         never pollutes it (README "Tensor-parallel serving")."""
-        tags = self._kvtag + self._wtag + self._tptag
+        tags = self._kvtag + self._wtag + self._atag + self._tptag
         if self._spec:
             # spec_len is CONFIG (spec_k + 1), not a runtime variant
             # like the ragged key's n_steps — two engines differing
@@ -921,10 +971,11 @@ class ContinuousBatchingEngine:
         sfx = "psuffix" if self._paged else "suffix"
         return sum(fn._cache_size() for key, fn in self._jit.items()
                    if (key[0] == "prefill"
-                       and key[1:] == self._wtag + self._tptag)
+                       and key[1:] == self._wtag + self._atag
+                       + self._tptag)
                    or (key[0] == sfx
                        and key[1:] == self._kvtag + self._wtag
-                       + self._tptag))
+                       + self._atag + self._tptag))
 
     # ------------------------------------------------------------- intake
     def _key_for(self, request):

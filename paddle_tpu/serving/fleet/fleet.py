@@ -100,6 +100,7 @@ class EngineFleet:
                  prefill_chunk=512, ragged_step=True, headroom_mult=2.0,
                  spec_decode=False, spec_k=4, drafter=None,
                  decode_ticks=1, kv_dtype=None, quantize_weights=False,
+                 quantize_activations=False,
                  tp=1, collective_dtype="fp", host_tier_bytes=0,
                  priority_classes=None,
                  registry=None, clock=None, watchdog_deadline_s=None,
@@ -180,6 +181,7 @@ class EngineFleet:
                     int(decode_chunk), int(prefix_block_size),
                     bool(prefix_cache), pblocks[i], int(decode_ticks),
                     kv_dtype, bool(quantize_weights),
+                    bool(quantize_activations),
                     int(tp), str(collective_dtype))
             jit = jits.setdefault(geom, {})
 
@@ -198,6 +200,7 @@ class EngineFleet:
                     drafter=drafter, decode_ticks=decode_ticks,
                     kv_dtype=kv_dtype,
                     quantize_weights=quantize_weights,
+                    quantize_activations=quantize_activations,
                     tp=tp, collective_dtype=collective_dtype,
                     host_tier_bytes=tiers[i],
                     priority_classes=self.classes,

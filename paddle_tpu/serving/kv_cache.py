@@ -87,6 +87,24 @@ def quantize_kv_rows(x):
     return q, scale
 
 
+FP8_MAX = 448.0   # float8_e4m3fn finite max — the saturation bound
+
+
+def quantize_kv_rows_fp8(x):
+    """THE fp8 write rule, ``quantize_kv_rows``' e4m3 sibling:
+    ``x [..., Hkv, D]`` → ``float8_e4m3fn`` same shape via a saturating
+    cast (clip to ±448 first: e4m3fn overflow is NaN, not a saturate).
+    No scale is computed or written — the pool's per-BLOCK scale planes
+    are the constant 1.0 (``BlockManager`` docstring): e4m3's exponent
+    IS the per-value scale, and any data-dependent block scale would
+    tie a block's bytes to which program first wrote it (decode appends
+    cover one row, prefill chunks cover the whole block), breaking
+    restore()/replay byte-identity. Rows still quantize independently,
+    so every append path shares this one rule exactly like int8's."""
+    xf = x.astype(jnp.float32)
+    return jnp.clip(xf, -FP8_MAX, FP8_MAX).astype(jnp.float8_e4m3fn)
+
+
 def _write_prefill(cache_k, cache_v, pk, pv, slot):
     # pk/pv: [L, S_pad, Hkv, D] -> one slot's leading rows. Rows past the
     # real prompt length hold prefill padding garbage; they sit beyond
@@ -165,6 +183,21 @@ def _paged_write_prefill_q(pool_k, pool_v, pool_ks, pool_vs, pk, pv,
     return pool_k, pool_v, pool_ks, pool_vs
 
 
+def _paged_write_prefill_f8(pool_k, pool_v, pk, pv, table_row,
+                            prompt_len):
+    # the fp8 twin: same coordinate rule, but the write is a saturating
+    # e4m3 cast of the data alone — the per-block scale planes are the
+    # constant 1.0 and are never touched by an append
+    # (quantize_kv_rows_fp8 docstring), so only the data scatters
+    phys, row = _prefill_scatter_coords(pool_k, pk, table_row,
+                                        prompt_len)
+    pool_k = pool_k.at[:, phys, row].set(quantize_kv_rows_fp8(pk),
+                                         mode="drop")
+    pool_v = pool_v.at[:, phys, row].set(quantize_kv_rows_fp8(pv),
+                                         mode="drop")
+    return pool_k, pool_v
+
+
 @functools.lru_cache(maxsize=None)
 def _writer(donate):
     # module-level so every cache instance (one per engine, one engine
@@ -176,14 +209,21 @@ def _writer(donate):
 @functools.lru_cache(maxsize=None)
 def _paged_writer(donate, quantized=False, tp=1):
     # donate the POOL arrays (the pool is the cache being updated);
-    # the quantized writer donates the scale planes too. On a
-    # tensor-parallel pool (tp > 1) the writer runs under shard_map
-    # with the pool (and the prefill K/V it scatters) partitioned on
-    # the head axis — NOT auto-GSPMD: the scatter must hand the pool
-    # back with exactly the sharding the sharded step programs expect,
-    # or the first post-prefill step pays a re-specialization and the
-    # compile-once pin breaks (README "Tensor-parallel serving").
-    impl = _paged_write_prefill_q if quantized else _paged_write_prefill
+    # the int8 writer donates the scale planes too. ``quantized`` is
+    # the pool's kv mode: False (store at pool dtype), "int8"/True
+    # (per-row quantize-on-write, scales scatter beside the data) or
+    # "fp8" (saturating e4m3 cast, data only — the per-block planes
+    # are constant and never written). On a tensor-parallel pool
+    # (tp > 1) the writer runs under shard_map with the pool (and the
+    # prefill K/V it scatters) partitioned on the head axis — NOT
+    # auto-GSPMD: the scatter must hand the pool back with exactly the
+    # sharding the sharded step programs expect, or the first
+    # post-prefill step pays a re-specialization and the compile-once
+    # pin breaks (README "Tensor-parallel serving").
+    fp8 = quantized == "fp8"
+    int8 = bool(quantized) and not fp8
+    impl = (_paged_write_prefill_f8 if fp8
+            else _paged_write_prefill_q if int8 else _paged_write_prefill)
     if tp > 1:
         from jax.sharding import PartitionSpec as P
         from .decode import _pool_pspec, _tp_mesh
@@ -192,17 +232,19 @@ def _paged_writer(donate, quantized=False, tp=1):
         # programs expect (scale planes shard on the same head axis)
         kv = P(None, None, "tp")            # pk/pv [L, S, Hkv, D]
         rep = P()
-        if quantized:
-            pool, sc = _pool_pspec(True)
+        if int8:
+            pool, sc = _pool_pspec("int8")
             in_specs = (pool, pool, sc, sc, kv, kv, rep, rep)
             out_specs = (pool, pool, sc, sc)
         else:
-            pool = _pool_pspec(False)
+            # the fp8 writer touches the DATA only, so its spec set is
+            # the plain writer's (with the fp8 pool's data spec)
+            pool = _pool_pspec("fp8")[0] if fp8 else _pool_pspec(False)
             in_specs = (pool, pool, kv, kv, rep, rep)
             out_specs = (pool, pool)
         impl = jax.shard_map(impl, mesh=_tp_mesh(tp), in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
-    if quantized:
+    if int8:
         return jax.jit(impl, donate_argnums=(0, 1, 2, 3) if donate else ())
     return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
@@ -248,15 +290,21 @@ def _tier_fetch_impl(pool_k, pool_v, block_id):
     return bk, bv
 
 
+def _scale_block_slice(planes, block_id):
+    # one block's scale planes, rank-generic: int8 planes are per-row
+    # [L, nb, bs, Hkv], fp8 planes per-block [L, nb, Hkv] — the block
+    # axis is axis 1 in both, so one slice rule serves both pools
+    return jax.lax.dynamic_slice(
+        planes, (0, block_id) + (0,) * (planes.ndim - 2),
+        (planes.shape[0], 1) + planes.shape[2:])
+
+
 def _tier_fetch_q_impl(pool_k, pool_v, pool_ks, pool_vs, block_id):
-    # quantized twin: the int8 data block travels WITH its fp32 scale
-    # planes [L, 1, bs, Hkv] — same block id, no separate bookkeeping
+    # quantized twin: the int8/fp8 data block travels WITH its fp32
+    # scale planes — same block id, no separate bookkeeping
     bk, bv = _tier_fetch_impl(pool_k, pool_v, block_id)
-    L, _, bs, Hkv = pool_ks.shape
-    bks = jax.lax.dynamic_slice(pool_ks, (0, block_id, 0, 0),
-                                (L, 1, bs, Hkv))
-    bvs = jax.lax.dynamic_slice(pool_vs, (0, block_id, 0, 0),
-                                (L, 1, bs, Hkv))
+    bks = _scale_block_slice(pool_ks, block_id)
+    bvs = _scale_block_slice(pool_vs, block_id)
     return bk, bv, bks, bvs
 
 
@@ -270,8 +318,9 @@ def _tier_inject_impl(pool_k, pool_v, bk, bv, block_id):
 def _tier_inject_q_impl(pool_k, pool_v, pool_ks, pool_vs,
                         bk, bv, bks, bvs, block_id):
     pk, pv = _tier_inject_impl(pool_k, pool_v, bk, bv, block_id)
-    pks = jax.lax.dynamic_update_slice(pool_ks, bks, (0, block_id, 0, 0))
-    pvs = jax.lax.dynamic_update_slice(pool_vs, bvs, (0, block_id, 0, 0))
+    at = lambda planes: (0, block_id) + (0,) * (planes.ndim - 2)  # noqa: E731
+    pks = jax.lax.dynamic_update_slice(pool_ks, bks, at(pool_ks))
+    pvs = jax.lax.dynamic_update_slice(pool_vs, bvs, at(pool_vs))
     return pk, pv, pks, pvs
 
 
@@ -284,10 +333,12 @@ def _tier_pspecs(quantized, tp):
     # re-spelling), so fetch hands out shards the host gathers and
     # inject hands the pool back exactly as the sharded step programs
     # expect it
-    from jax.sharding import PartitionSpec as P
     from .decode import _pool_pspec
     if quantized:
-        pool, sc = _pool_pspec(True)
+        # quantized is the kv mode string here ("int8"/"fp8" — True is
+        # accepted as int8): the fp8 pool's per-block planes drop the
+        # row axis, so their spec differs from int8's per-row planes
+        pool, sc = _pool_pspec("int8" if quantized is True else quantized)
         return (pool, pool, sc, sc), (pool, pool, sc, sc)
     pool = _pool_pspec(False)
     return (pool, pool), (pool, pool)
@@ -473,12 +524,13 @@ class PagedKVCache:
         bs = int(block_size)
         if bs < 1:
             raise ValueError(f"block_size must be >= 1, got {bs}")
-        if kv_dtype not in (None, "int8"):
+        if kv_dtype not in (None, "int8", "fp8"):
             raise ValueError(
-                f"kv_dtype must be None (store at pool dtype) or 'int8', "
-                f"got {kv_dtype!r}")
+                f"kv_dtype must be None (store at pool dtype), 'int8' or "
+                f"'fp8', got {kv_dtype!r}")
         self.kv_dtype = kv_dtype
-        self.quantized = kv_dtype == "int8"
+        self.quantized = kv_dtype is not None
+        self.fp8 = kv_dtype == "fp8"
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
         self.block_size = bs
@@ -487,11 +539,12 @@ class PagedKVCache:
             pool = BlockManager(num_layers, self.num_slots * self.max_blocks,
                                 bs, num_kv_heads, head_dim, dtype=dtype,
                                 kv_dtype=kv_dtype)
-        if getattr(pool, "quantized", False) != self.quantized:
+        if getattr(pool, "kv_dtype", None) != kv_dtype:
             raise ValueError(
                 f"pool kv_dtype {getattr(pool, 'kv_dtype', None)!r} does "
-                f"not match cache kv_dtype {kv_dtype!r}: an int8 cache "
-                f"needs a pool carrying scale planes (and vice versa)")
+                f"not match cache kv_dtype {kv_dtype!r}: a quantized "
+                f"cache needs a pool carrying THAT dtype's scale-plane "
+                f"layout (int8 per-row vs fp8 per-block, and vice versa)")
         if pool.block_size != bs:
             raise ValueError(
                 f"pool block_size {pool.block_size} != cache block_size "
@@ -732,9 +785,15 @@ class PagedKVCache:
         self.ensure_capacity(slot, int(prompt_len))
         p = self.pool
         tp = getattr(p, "tp", 1)
-        if self.quantized:
+        if self.fp8:
+            # data-only write: fp8's per-block scale planes are the
+            # constant 1.0 and never touched by appends
+            p.k, p.v = _paged_writer(self._donate, "fp8", tp)(
+                p.k, p.v, pk, pv,
+                jnp.asarray(self.tables[slot]), np.int32(prompt_len))
+        elif self.quantized:
             p.k, p.v, p.k_scale, p.v_scale = \
-                _paged_writer(self._donate, True, tp)(
+                _paged_writer(self._donate, "int8", tp)(
                     p.k, p.v, p.k_scale, p.v_scale, pk, pv,
                     jnp.asarray(self.tables[slot]), np.int32(prompt_len))
         else:

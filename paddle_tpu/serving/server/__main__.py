@@ -128,17 +128,21 @@ def main(argv=None):
                          "amortizes n-fold (tokens stream in bursts "
                          "of up to n). Mixed traffic clamps back to "
                          "single-tick. 1 = off (the baseline)")
-    ap.add_argument("--kv-dtype", choices=("pool", "int8"),
+    ap.add_argument("--kv-dtype", choices=("pool", "int8", "fp8"),
                     default="pool",
                     help="KV cache storage dtype (README 'Quantized "
                          "serving'): 'pool' stores at the model dtype "
                          "(the default — every banked baseline), "
                          "'int8' serves from the block-quantized pool "
                          "(unified ragged paged engine only; appends "
-                         "quantize on write, the ragged kernel "
-                         "dequantizes after the table-indirect DMA, "
-                         "~4x pool HBM cut vs fp32 = ~4x concurrent "
-                         "slots at a fixed budget)")
+                         "quantize on write, the attention kernels "
+                         "upcast in-register after the table-indirect "
+                         "DMA, ~4x pool HBM cut vs fp32 = ~4x "
+                         "concurrent slots at a fixed budget), 'fp8' "
+                         "stores float8_e4m3fn with per-BLOCK scale "
+                         "planes — fewer scale bytes per cached token "
+                         "than int8's per-row planes and no quantize "
+                         "arithmetic on the append path")
     ap.add_argument("--quantize-weights",
                     action=argparse.BooleanOptionalAction, default=False,
                     help="int8 weight-only decode matmuls: convert the "
@@ -147,6 +151,16 @@ def main(argv=None):
                          "fused into the matmul) — weight HBM traffic "
                          "drops ~4x vs fp32 at a measured-not-assumed "
                          "quality cost")
+    ap.add_argument("--quantize-activations",
+                    action=argparse.BooleanOptionalAction, default=False,
+                    help="int8xint8 decode projections (requires "
+                         "--quantize-weights; unified ragged paged "
+                         "engine only): quantize each projection input "
+                         "per-row at runtime and contract int8 against "
+                         "the int8 weights with int32 accumulate — the "
+                         "per-layer weight dequant disappears from the "
+                         "decode step entirely (greedy divergence "
+                         "measured in DENSITY_BENCH.json, not assumed)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree (README 'Tensor-"
                          "parallel serving'): shard every serving "
@@ -266,6 +280,7 @@ def main(argv=None):
             spec_decode=args.spec_decode, spec_k=args.spec_k,
             decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
             quantize_weights=args.quantize_weights,
+            quantize_activations=args.quantize_activations,
             tp=args.tp, collective_dtype=args.collective_dtype,
             classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
             slo_tpot_ms=args.slo_tpot_ms,
@@ -294,6 +309,8 @@ def main(argv=None):
             "kv_dtype": fleet.replicas[0].gateway.engine.kv_dtype,
             "quantize_weights":
                 fleet.replicas[0].gateway.engine.quantize_weights,
+            "quantize_activations":
+                fleet.replicas[0].gateway.engine.quantize_activations,
             # effective-value idiom: the engines' ACTUAL mesh shape
             # (devices per replica on the "tp" axis) and the wire
             # dtype their per-layer all-reduce really runs
@@ -336,6 +353,7 @@ def main(argv=None):
         spec_decode=args.spec_decode, spec_k=args.spec_k,
         decode_ticks=args.decode_ticks, kv_dtype=kv_dtype,
         quantize_weights=args.quantize_weights,
+        quantize_activations=args.quantize_activations,
         tp=args.tp, collective_dtype=args.collective_dtype,
         classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
         slo_tpot_ms=args.slo_tpot_ms,
@@ -368,6 +386,8 @@ def main(argv=None):
                       "kv_dtype": server.gateway.engine.kv_dtype,
                       "quantize_weights":
                       server.gateway.engine.quantize_weights,
+                      "quantize_activations":
+                      server.gateway.engine.quantize_activations,
                       # effective-value idiom: the EFFECTIVE mesh
                       # shape (the "tp" axis the programs actually
                       # shard over; 1 = no mesh) and the wire dtype

@@ -1589,6 +1589,12 @@ class ServingGateway:
             used = occ["live"] + occ["trie"]
             doc["kv_pool"] = {
                 "kv_dtype": eng.kv_dtype,
+                # the other two low-precision knobs ride along so the
+                # whole "Quantized serving" posture reads off one block
+                "quantize_weights": getattr(eng, "quantize_weights",
+                                            False),
+                "quantize_activations": getattr(
+                    eng, "quantize_activations", False),
                 "live_bytes": occ["live"] * per_block,
                 "trie_bytes": occ["trie"] * per_block,
                 "free_bytes": occ["free"] * per_block,

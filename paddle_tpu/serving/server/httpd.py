@@ -520,6 +520,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
           fault_hook=None, clock=None, spec_decode=False, spec_k=4,
           drafter=None, trace=False, trace_buffer=65536, cost=True,
           decode_ticks=1, kv_dtype=None, quantize_weights=False,
+          quantize_activations=False,
           tp=1, collective_dtype="fp", host_tier_bytes=0,
           classes=None, slo_ttft_ms=None, slo_tpot_ms=None):
     """Build engine → gateway → HTTP server and start listening.
@@ -608,9 +609,14 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     None so every banked baseline stays byte-identical) serves from
     the int8 block-quantized KV pool (README "Quantized serving"):
     appends quantize on write with per-row-per-head fp32 scale planes
-    riding the same physical blocks, the ragged kernel dequantizes
-    after the table-indirect DMA, and pool HBM drops ~4x vs fp32 —
-    the density win DENSITY_BENCH.json banks. ``/metrics`` grows
+    riding the same physical blocks, the attention kernels upcast
+    in-register after the table-indirect DMA, and pool HBM drops ~4x
+    vs fp32 — the density win DENSITY_BENCH.json banks.
+    ``kv_dtype="fp8"`` stores ``float8_e4m3fn`` instead with
+    per-BLOCK scale planes (constant 1.0 — e4m3's exponent is the
+    per-value scale), cutting scale bytes per cached token
+    ``block_size``-fold vs int8's per-row planes and making the
+    append path a saturating cast. ``/metrics`` grows
     ``kv_pool_bytes{kind="kv|scales"}`` and
     ``serving_kv_bytes_per_token``; ``/debug/profile`` reports the
     pool in bytes. ``quantize_weights=True`` additionally routes the
@@ -618,6 +624,12 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
     (converted once per model — rebuilds and fleet replicas share the
     converted arrays and the jit cache, so
     ``decode_compilations()==1`` holds across restarts).
+    ``quantize_activations=True`` (requires ``quantize_weights``)
+    upgrades those projections to int8xint8: each projection input is
+    quantized per-row at runtime and contracted against the int8
+    weights with int32 accumulate, so the per-layer weight dequant
+    disappears from the decode step entirely (greedy divergence
+    measured in DENSITY_BENCH.json, not assumed).
 
     ``tp=N`` (unified ragged paged engine only, default 1) serves
     tensor-parallel over an N-device heads-sharded mesh (README
@@ -676,6 +688,7 @@ def serve(model, host="127.0.0.1", port=8000, num_slots=8,
             spec_decode=spec_decode, spec_k=spec_k, drafter=drafter,
             decode_ticks=decode_ticks, kv_dtype=kv_dtype,
             quantize_weights=quantize_weights,
+            quantize_activations=quantize_activations,
             tp=tp, collective_dtype=collective_dtype,
             host_tier_bytes=host_tier_bytes,
             priority_classes=priority_classes,
@@ -703,7 +716,8 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
                 fault_hooks=None, clock=None, spec_decode=False,
                 spec_k=4, drafter=None, trace=False, trace_buffer=65536,
                 cost=True, affinity_band=16, decode_ticks=1,
-                kv_dtype=None, quantize_weights=False, tp=1,
+                kv_dtype=None, quantize_weights=False,
+                quantize_activations=False, tp=1,
                 collective_dtype="fp", host_tier_bytes=0,
                 classes=None, slo_ttft_ms=None, slo_tpot_ms=None):
     """Build an engine fleet → HTTP server and start listening (README
@@ -768,6 +782,7 @@ def serve_fleet(model, replicas=2, router="affinity", host="127.0.0.1",
         headroom_mult=headroom_mult, spec_decode=spec_decode,
         spec_k=spec_k, drafter=drafter, decode_ticks=decode_ticks,
         kv_dtype=kv_dtype, quantize_weights=quantize_weights,
+        quantize_activations=quantize_activations,
         tp=tp, collective_dtype=collective_dtype,
         host_tier_bytes=host_tier_bytes,
         priority_classes=priority_classes,

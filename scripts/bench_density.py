@@ -1,9 +1,13 @@
-"""Quantized serving density benchmark: int8 block-quantized KV at a
-FIXED pool-byte budget (README "Quantized serving").
+"""Quantized serving density benchmark: int8/fp8 block-quantized KV at
+a FIXED pool-byte budget (README "Quantized serving").
 
 Question answered: holding the KV pool's HBM budget constant, how many
 MORE concurrent slots does ``kv_dtype="int8"`` serve than the fp32
 baseline — and what does quality actually pay (measured, not assumed)?
+The fp8 leg asks the follow-up: with per-BLOCK scale planes instead of
+int8's per-row planes, how many FEWER bytes does a cached token cost —
+and the int8xint8 leg (``quantize_activations``) measures what the
+dequant-free decode projections pay in stream divergence.
 
 Legs (all deterministic — exact byte accounting + token comparison, no
 wall-clock in the gates):
@@ -27,6 +31,13 @@ wall-clock in the gates):
   have drifted.
 - **weights**: int8 weight-only decode rides along — projection-weight
   bytes fp vs int8 and stream determinism.
+- **fp8**: bytes per cached token strictly below the int8 leg's (the
+  per-block scale planes cost ``2*L*Hkv*4/block_size`` per token vs
+  int8's ``2*L*Hkv*4``), greedy divergence measured against fp32 and
+  gated at <= 0.02, replay-deterministic,
+  ``decode_compilations() == 1`` on the kv8f geometry.
+- **a8** (int8xint8 projections): divergence measured and bounded,
+  deterministic, compiles once on the a8 geometry.
 
 Usage:
   python scripts/bench_density.py --quick [--json PATH]   # CPU-sized
@@ -178,6 +189,28 @@ def measure_density(quick=True, base_slots=4):
                                  for k in _WEIGHT_QUANT_KEYS
                                  + ("lm_head",)))
 
+    # ------------------------------------------------------- fp8 KV leg
+    # per-BLOCK scale planes: bytes per cached token must land strictly
+    # below the int8 leg's (same data bytes at head_dim >= 8, block_size
+    # x fewer scale bytes), and greedy divergence vs fp32 is MEASURED
+    # and gated tight — e4m3's exponent is the per-value scale, so the
+    # walk should hold on this model/trace.
+    f8_eng = _engine(model, base_slots, s_max, kv_dtype="fp8")
+    f8_streams, _ = _run_concurrent(f8_eng, reqs_small)
+    f8_streams2, _ = _run_concurrent(
+        _engine(model, base_slots, s_max, kv_dtype="fp8"), reqs_small)
+    f8_div = _divergence(b_streams, f8_streams)
+    ob_f8 = f8_eng.cache.occupancy_bytes()
+
+    # -------------------------------------------- int8xint8 (a8) leg
+    a8_eng = _engine(model, base_slots, s_max, quantize_weights=True,
+                     quantize_activations=True)
+    a8_streams, _ = _run_concurrent(a8_eng, reqs_small)
+    a8_streams2, _ = _run_concurrent(
+        _engine(model, base_slots, s_max, quantize_weights=True,
+                quantize_activations=True), reqs_small)
+    a8_div = _divergence(b_streams, a8_streams)
+
     # default-path pin, second reading: quantized siblings in the same
     # jit cache must not have perturbed the default engine's streams
     default_after, _ = _run_concurrent(_engine(model, base_slots, s_max),
@@ -206,8 +239,16 @@ def measure_density(quick=True, base_slots=4):
         "weight_bytes_fp": int(fp_w_bytes),
         "weight_bytes_int8": int(q_w_bytes),
         "weight_bytes_ratio": fp_w_bytes / q_w_bytes,
+        "fp8_bytes_per_token": ob_f8["per_token"],
+        "fp8_scale_plane_bytes": int(ob_f8["capacity_scales"]),
+        "fp8_greedy_divergence": f8_div,
+        "fp8_deterministic": f8_streams == f8_streams2,
+        "a8_greedy_divergence": a8_div,
+        "a8_deterministic": a8_streams == a8_streams2,
         "decode_compilations_int8": quant.decode_compilations(),
         "decode_compilations_w8": w_eng.decode_compilations(),
+        "decode_compilations_fp8": f8_eng.decode_compilations(),
+        "decode_compilations_a8": a8_eng.decode_compilations(),
         "default_streams_unchanged": default_before == default_after,
         "block_size": BLOCK_SIZE,
         "trace": f"{2 * base_slots} reqs round-robin over 2 shared "
@@ -216,6 +257,17 @@ def measure_density(quick=True, base_slots=4):
             ratio >= 1.8 and peak_q == q_slots
             and q_streams == q_streams2
             and quant.decode_compilations() == 1
+            # fp8 gates: strictly cheaper cached tokens than int8,
+            # tight measured divergence, deterministic, compiles once
+            and ob_f8["per_token"] < ob_q["per_token"]
+            and f8_div["divergence_rate"] <= 0.02
+            and f8_streams == f8_streams2
+            and f8_eng.decode_compilations() == 1
+            # a8 gates: divergence BOUNDED (reported exactly above),
+            # deterministic, compiles once
+            and a8_div["matched_prefix_fraction"] >= 0.75
+            and a8_streams == a8_streams2
+            and a8_eng.decode_compilations() == 1
             and default_before == default_after),
     }
     return res

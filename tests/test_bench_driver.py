@@ -179,6 +179,13 @@ class TestBenchDriverFlow:
                      "slot_capacity_ratio": 3.5,
                      "greedy_divergence": {"divergence_rate": 0.0},
                      "int8_deterministic": True,
+                     "int8_bytes_per_token": 2496.0,
+                     "fp8_bytes_per_token": 2316.0,
+                     "fp8_greedy_divergence": {"divergence_rate": 0.0},
+                     "fp8_deterministic": True,
+                     "a8_greedy_divergence":
+                         {"matched_prefix_fraction": 0.953125},
+                     "a8_deterministic": True,
                      "default_streams_unchanged": True,
                      "accepted": True}), ""
             if leg == "--tp":
@@ -280,6 +287,15 @@ class TestBenchDriverFlow:
         assert art["density"]["slot_capacity_ratio"] == 3.5
         assert art["density"][
             "greedy_divergence"]["divergence_rate"] == 0.0
+        # the fp8/a8 low-precision legs ride the same banked artifact:
+        # fp8 cached tokens strictly cheaper than int8's, divergence
+        # measured (not assumed) and deterministic either leg
+        assert art["density"]["fp8_bytes_per_token"] \
+            < art["density"]["int8_bytes_per_token"]
+        assert art["density"][
+            "fp8_greedy_divergence"]["divergence_rate"] <= 0.02
+        assert art["density"]["fp8_deterministic"] is True
+        assert art["density"]["a8_deterministic"] is True
         # the tensor-parallel leg rides the same banked artifact
         assert art["tp"]["accepted"] is True
         assert art["tp"]["tokens_equal"] is True

@@ -83,6 +83,8 @@ def _run(model, reqs, **kw):
 
 
 class TestTransparency:
+    @pytest.mark.slow  # 8 s transparency duplicate: test_chunked_equals_
+    # unchunked_with_prefix_hits below is the stricter default rep (870s cap)
     def test_chunked_equals_unchunked_greedy_and_sampled(self, model):
         """The acceptance pin: varied prompt lengths (sub-chunk,
         multi-chunk, non-block-multiple), greedy and seeded-sampled,

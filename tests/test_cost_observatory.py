@@ -360,6 +360,8 @@ class TestExactAccounting:
                       headroom_mult=None, spec_decode=True, spec_k=3)),
     )
 
+    @pytest.mark.slow  # 15 s exact-count duplicate: test_launch_attribution_
+    # per_request below keeps the default exact-accounting rep (870s cap)
     def test_counts_exact_streams_unchanged(self, model):
         reqs = _reqs(3, max_new=4, long_prompt=True)
         for name, cfg in self.CONFIGS:
@@ -815,6 +817,68 @@ class TestGuardDiscipline:
             body = eng.split(f"def {fn_name}(")[1].split("\n    def ")[0]
             assert "_wrap_prog" in body, fn_name
             assert "_kvtag" in body or "_wtag" in body, fn_name
+
+    def test_sweep_pins_a8_layer_body_dequant_free(self):
+        """ISSUE 19 satellite: under ``quantize_activations`` the
+        scanned layer body is PROVABLY dequant-free — no int8 weight is
+        ever materialized at fp in the layer body; the only fp
+        materialization is the int32 accumulator's post-dot rescale.
+        Pinned structurally (AST, not substrings) so a refactor that
+        quietly re-introduced a ``q.astype(f32) * s`` weight dequant
+        into the a8 path fails here, not in a perf trace."""
+        src = (SERVING_DIR / "decode.py").read_text()
+        tree = ast.parse(src)
+        fns = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)}
+        # the a8 short-circuit is the FIRST statement of _dq_layer:
+        # nothing dequantizes ahead of the early return
+        first = [n for n in fns["_dq_layer"].body
+                 if not (isinstance(n, ast.Expr)
+                         and isinstance(n.value, ast.Constant))][0]
+        assert isinstance(first, ast.If) \
+            and isinstance(first.body[0], ast.Return)
+        # _dq_head's a8 branch passes the int8 pair through (transpose
+        # only) — it never falls into the _dq call below it
+        head_first = [n for n in fns["_dq_head"].body
+                      if isinstance(n, ast.If)][0]
+        assert not any(isinstance(c, ast.Call)
+                       and isinstance(c.func, ast.Name)
+                       and c.func.id == "_dq"
+                       for n in head_first.body for c in ast.walk(n))
+        # none of the int8x8 projection helpers reach the dequant
+        # helper (directly or via _dq_layer)
+        for name in ("_a8_apply", "_a8_dot", "quantize_act_rows",
+                     "_qkv_proj", "_swiglu_proj", "_o_proj",
+                     "_head_logits"):
+            calls = [n for n in ast.walk(fns[name])
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)]
+            assert not any(c.func.id in ("_dq", "_dq_layer")
+                           for c in calls), name
+        # _a8_apply: ONE dot_general with int32 accumulate, and the
+        # single astype applies to the accumulator — never the weight
+        a8 = fns["_a8_apply"]
+        astypes = [n for n in ast.walk(a8) if isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "astype"]
+        assert len(astypes) == 1
+        assert isinstance(astypes[0].func.value, ast.Name) \
+            and astypes[0].func.value.id == "acc"
+        dots = [n for n in ast.walk(a8) if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "dot_general"]
+        assert len(dots) == 1
+        assert any(kw.arg == "preferred_element_type"
+                   for kw in dots[0].keywords)
+        # every scanned layer body routes its projections through the
+        # structure-dispatch helpers — an inline einsum could not
+        # reintroduce a dequant site unnoticed
+        for fn_name in ("_packed_span_forward", "_fused_decode_tick",
+                        "_paged_suffix_prefill_impl", "_prefill_impl"):
+            body = src.split(f"def {fn_name}(")[1].split("\ndef ")[0]
+            for helper in ("_qkv_proj(", "_swiglu_proj(", "_o_proj(",
+                           "_dq_layer("):
+                assert helper in body, (fn_name, helper)
 
     def test_sweep_sees_the_tp_launch_path(self):
         """ISSUE 15 satellite: the tensor-parallel launch path stays

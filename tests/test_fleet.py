@@ -183,6 +183,8 @@ class TestRouterPolicies:
 
 # ------------------------------------------------- routing determinism
 class TestRoutingDeterminism:
+    @pytest.mark.slow  # 6 s replay duplicate: test_kill_replay_is_deterministic
+    # below keeps the default fleet-determinism rep (870s cap)
     def test_virtual_clock_replay_routes_identically(self, model):
         """The chaos-replay pin: policies read replica state only, so
         the same submission order over a VirtualClock fleet produces
@@ -252,6 +254,8 @@ class TestFleetCompileDiscipline:
         assert e1.decode_compilations() == 1
         fleet.shutdown(drain=True, timeout=30)
 
+    @pytest.mark.slow  # 7 s geometry duplicate: test_mixed_geometry_isolates_
+    # jit_caches above is the default geometry rep (870s cap)
     def test_mixed_prefix_blocks_is_pool_geometry_too(self, model):
         """Review regression: prefix_blocks sizes the pool arrays the
         traced programs close over (num_blocks = live + trie budget),

@@ -31,6 +31,8 @@ class TestLlamaGenerate:
         got = m.generate(paddle.to_tensor(ids), max_new_tokens=8).numpy()
         np.testing.assert_array_equal(got, oracle)
 
+    @pytest.mark.slow  # 12 s full-forward duplicate: the GQA variant above is
+    # the stricter default rep (870s cap)
     def test_greedy_matches_full_forward_mha(self):
         paddle.seed(12)
         m = LlamaForCausalLM(llama_tiny(num_key_value_heads=4))

@@ -191,7 +191,7 @@ class TestValidation:
 
     def test_bad_kv_dtype_rejected(self, model):
         with pytest.raises(ValueError, match="kv_dtype"):
-            _engine(model, kv_dtype="fp8")
+            _engine(model, kv_dtype="int4")
 
 
 # -------------------------------------------------------------- streams
@@ -332,6 +332,8 @@ class TestChaosInt8:
 
 # --------------------------------------------------- compile discipline
 class TestCompileDiscipline:
+    @pytest.mark.slow  # 6 s four-engine matrix duplicate: test_lowprec_decode
+    # TestCompileDiscipline keys fp/kv8f/w8+a8 apart by default (870s cap)
     def test_compile_once_inclusive_of_quantized_geometry(self, model):
         # fresh dict: all four engines share one POOL geometry (no
         # trie), so the pin isolates exactly the quantization variants

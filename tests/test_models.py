@@ -116,6 +116,8 @@ class TestLlama:
         np.testing.assert_allclose(float(l1.value), float(l3.value),
                                    rtol=2e-4)
 
+    @pytest.mark.slow  # 7 s mesh-parity duplicate: test_train_converges_serial
+    # above is the default Llama train rep (870s cap)
     def test_hybrid_mesh_parity(self):
         """Flagship path: dp2 x mp2 x pp2 (+sharding1) matches serial."""
         paddle.seed(3)
@@ -237,6 +239,8 @@ class TestErnieViL:
 
 
 class TestMoEGPT:
+    @pytest.mark.slow  # 8 s MoE train duplicate: test_moe_ep_mesh below and
+    # test_parallel.py TestMoE keep the default MoE-train reps (870s cap)
     def test_moe_training(self):
         _no_mesh()
         paddle.seed(30)

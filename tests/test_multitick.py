@@ -95,6 +95,8 @@ def _greedy_ref(model, n=24, seed=5):
 
 # ---------------------------------------------------------- transparency
 class TestTransparency:
+    @pytest.mark.slow  # 8 s matrix duplicate: test_multitick_equals_two_
+    # program_baseline below keeps the default transparency rep (870s cap)
     def test_multitick_equals_single_tick_mixed_matrix(self, model):
         """The acceptance pin: a chunked/sampled/cancel traffic matrix
         — varied prompt lengths, greedy and seeded-sampled rows, a

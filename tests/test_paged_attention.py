@@ -95,6 +95,8 @@ class TestTransparency:
         assert eng.stats["prefill_tokens"] == \
             sum(len(r.prompt) for r in reqs) - pc.stats["hit_tokens"]
 
+    @pytest.mark.slow  # 8 s chunk-boundary duplicate: test_streams_identical_
+    # greedy_and_sampled above is the default paged rep (870s cap)
     def test_fused_chunks_cross_block_boundaries(self, model):
         """decode_chunk > block-crossing distance: fused ticks write
         across block boundaries through pre-grown tables; streams stay

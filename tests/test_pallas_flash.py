@@ -52,7 +52,7 @@ def test_fwd_matches_reference(causal, s, block):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [pytest.param(False, marks=pytest.mark.slow), True])
 @pytest.mark.parametrize("s,block", [
     (256, 128),
     # padded-tail grads at 320 are slow-marked; the fwd test keeps the

@@ -55,6 +55,8 @@ class TestXPlaneStatistics:
         assert totals == sorted(totals, reverse=True)
         assert len(op_statistics(d, device_only=False, top=3)) <= 3
 
+    @pytest.mark.slow  # 20 s render duplicate: test_parses_real_trace_and_finds
+    # _the_dot above keeps the default xplane rep (870s cap)
     def test_summarize_renders_table(self):
         d = _capture_trace()
         s = summarize.__wrapped__(d) if hasattr(summarize, "__wrapped__") \

@@ -64,6 +64,8 @@ def _clone(r):
 
 
 class TestTransparency:
+    @pytest.mark.slow  # 10 s transparency matrix duplicate: the one-launch and
+    # dense-engine reps below run by default (870s cap)
     def test_unified_equals_two_program_mixed_matrix(self, model):
         """The acceptance pin: a hit/miss/eviction/cancel/chunked
         traffic matrix — varied prompt lengths, shared system prompt,

@@ -38,6 +38,8 @@ def _solo(model, req, **ekw):
 
 
 class TestEngineBasics:
+    @pytest.mark.slow  # 9 s generate-parity duplicate: test_mid_flight_admission_
+    # matches_solo and the pallas/jnp identity test keep the default reps (870s cap)
     def test_greedy_matches_model_generate(self, model):
         ids = np.stack([_prompt(0), _prompt(1)])
         want = model.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
@@ -93,6 +95,8 @@ class TestDecodePathEquivalence:
 
 
 class TestEOS:
+    @pytest.mark.slow  # 6 s EOS duplicate: test_generate_eos_pads_output below
+    # is the default EOS rep (870s cap)
     def test_eos_early_exit_frees_slot(self, model):
         req = GenerationRequest(prompt=_prompt(5), max_new_tokens=12)
         free_run = _solo(model, req)

@@ -45,6 +45,8 @@ class TestSparseCoo:
         assert r.nnz == 3
         np.testing.assert_allclose(r.to_dense().numpy(), 0.0)
 
+    @pytest.mark.slow  # 8 s spmm duplicate: test_masked_matmul_sddmm below
+    # keeps the default sparse-matmul rep (870s cap)
     def test_spmm_matches_dense(self):
         rng = np.random.RandomState(0)
         dense = rng.randn(4, 5).astype(np.float32)
@@ -113,6 +115,8 @@ class TestQuantization:
 
         return M()
 
+    @pytest.mark.slow  # 6 s QAT train duplicate: test_qat_gradients_flow below
+    # keeps the default QAT rep (870s cap)
     def test_qat_quantize_swaps_and_stays_close(self):
         from paddle_tpu.quantization import QuantedLinear
         m = self._model()
@@ -136,6 +140,8 @@ class TestQuantization:
         assert qm.fc1.weight.grad is not None
         assert np.any(np.abs(qm.fc1.weight.grad.numpy()) > 0)
 
+    @pytest.mark.slow  # 6 s convert duplicate: test_converted_linear_dequant_
+    # follows_input_dtype below is the default PTQ rep (870s cap)
     def test_ptq_observe_convert_int8(self):
         from paddle_tpu.quantization import ConvertedLinear, ObservedLinear
         m = self._model()

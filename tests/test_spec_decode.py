@@ -182,6 +182,8 @@ class TestValidation:
 
 
 class TestTransparency:
+    @pytest.mark.slow  # 17 s transparency matrix duplicate: the drafter/eos/
+    # compile-isolation reps below run by default (870s cap)
     def test_spec_equals_baseline_mixed_matrix(self, model):
         """The acceptance pin: a hit/miss/chunked/cancel traffic matrix
         — shared system prompt, greedy and seeded-sampled rows, a long
@@ -240,6 +242,8 @@ class TestTransparency:
         assert a.decode_compilations() == 1
         assert b.decode_compilations() == 1
 
+    @pytest.mark.slow  # 6 s launch-count duplicate: the eos and compile-
+    # isolation reps in this class run by default (870s cap)
     def test_accepting_drafter_fewer_launches_than_tokens(self, model):
         """With the always-accept oracle (the target model drafting for
         itself) a launch advances a slot by up to spec_k + 1 tokens:
@@ -367,6 +371,8 @@ class TestFaultInterplay:
         assert gw.engine.decode_compilations() == 1   # shared factory
         # cache: the rebuild re-traced nothing
 
+    @pytest.mark.slow  # 6 s fault duplicate: test_fatal_mid_speculation_
+    # recovers_byte_identical above is the default fault rep (870s cap)
     def test_restore_recomputes_from_accepted_tokens_only(self, model):
         """Engine-level restore pin: displace a speculating sequence
         mid-flight; its recompute work is prompt + ACCEPTED tokens

@@ -99,6 +99,8 @@ def _run_matrix(model, **kw):
 
 # ----------------------------------------------------------- transparency
 class TestTPByteIdentity:
+    @pytest.mark.slow  # 14 s matrix duplicate: tp4/spec/multitick/int8 byte-
+    # identity reps below run by default (870s cap)
     def test_tp2_matrix_byte_identical_and_compile_once(self, model):
         """THE acceptance pin: TP=2 streams equal the single-chip
         baseline byte-for-byte — greedy AND seeded-sampled, cold/hit/

@@ -190,6 +190,8 @@ class TestSpanTracerUnit:
 
 # -------------------------------------------------------- engine spans
 class TestEngineTracing:
+    @pytest.mark.slow  # 6 s schema duplicate: the chunk-span and midflight-capture
+    # reps below run by default (870s cap)
     def test_lifecycle_and_step_phases_schema(self, model):
         tracer = SpanTracer().enable()
         eng = _engine(model, tracer=tracer, prefix_cache=True,
@@ -320,6 +322,8 @@ class TestSLOSubstrate:
         assert (tp[("serving_tpot_seconds_sum", ())]
                 <= lat[("serving_request_latency_seconds_sum", ())])
 
+    @pytest.mark.slow  # 5 s rebuild duplicate: test_slo_histograms_strict_parse
+    # above is the default SLO-histogram rep (870s cap)
     def test_slo_histograms_accumulate_across_rebuild(self, model):
         jit = {}
 
@@ -401,6 +405,8 @@ def _chaos_run(model, jit, reqs, with_plan, trace):
 
 
 class TestDeterministicChaosTrace:
+    @pytest.mark.slow  # 6 s chaos-trace duplicate: tracing-off token identity and
+    # the chaos byte-identity pins elsewhere run by default (870s cap)
     def test_chaos_spec_trace_byte_stable_and_complete(self, model):
         jit = {}            # one jit cache: identical config all runs
         reqs = _chaos_workload()

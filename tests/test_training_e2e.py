@@ -255,6 +255,8 @@ class TestResNet:
         grads = [p.grad for p in model.parameters() if not p.stop_gradient]
         assert all(g is not None for g in grads)
 
+    @pytest.mark.slow  # 18 s jit conv train duplicate: conv-train stays covered
+    # by TestEagerTraining.test_classification_eager (870s cap)
     def test_resnet18_jit_train_smoke(self):
         paddle.seed(11)
         from paddle_tpu.vision.models import resnet18

@@ -35,6 +35,8 @@ def _nms_oracle(boxes, scores, thr):
 
 
 class TestNMS:
+    @pytest.mark.slow  # 7 s brute-force duplicate: top_k/multiclass/iou reps
+    # below run by default (870s cap)
     def test_matches_bruteforce(self):
         rng = np.random.RandomState(0)
         xy = rng.rand(30, 2) * 60
@@ -69,6 +71,8 @@ class TestNMS:
 
 
 class TestRoiAlign:
+    @pytest.mark.slow  # 9 s RoiAlign duplicate: test_gradient_ramp below is
+    # the default rep (870s cap)
     def test_constant_map_returns_constant(self):
         x = np.full((1, 3, 16, 16), 7.0, np.float32)
         rois = np.array([[2, 2, 10, 10]], np.float32)
@@ -88,6 +92,8 @@ class TestRoiAlign:
         np.testing.assert_allclose(out[:, 0], 6.0, atol=0.3)
         np.testing.assert_allclose(out[:, 1], 10.0, atol=0.3)
 
+    @pytest.mark.slow  # 9 s RoiAlign duplicate: test_gradient_ramp above is
+    # the default rep (870s cap)
     def test_multi_image_batch(self):
         x = np.stack([np.full((1, 8, 8), 1.0), np.full((1, 8, 8), 2.0)]) \
             .astype(np.float32)
@@ -223,6 +229,8 @@ class TestDetectionOpsR4:
                           anchors=[10, 13, 16, 30, 33, 23], class_num=4,
                           iou_aware=True)
 
+    @pytest.mark.slow  # 6 s decode-properties duplicate: the roi_pool and
+    # prior_box reps in this class run by default (870s cap)
     def test_yolo_box_decode_properties(self):
         rng = np.random.RandomState(1)
         A, C, H, W = 3, 4, 4, 4
