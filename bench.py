@@ -521,9 +521,11 @@ def watchdog():
         {"ok": False, "rc": rc, "stderr_tail": err.strip()[-300:]}
     # Dispatch-cost leg: device launches + boundary bytes per decoded
     # token by engine config (scripts/bench_dispatch.py) — the banked
-    # mega-kernel baseline. Same hang-proof contract: exact counters,
-    # CPU-forced, banked up front.
-    rc, out, err = _run([me, "--dispatch"], 300,
+    # mega-kernel baseline plus the fused one-kernel ladder that beats
+    # it. Same hang-proof contract: exact counters, CPU-forced, banked
+    # up front. 600 s: the fused legs replay the trace on the pallas
+    # twin (interpret mode on CPU) for the jaxpr launch census.
+    rc, out, err = _run([me, "--dispatch"], 600,
                         env={"JAX_PLATFORMS": "cpu"})
     dp = _parse_result(rc, out)
     cb_extra["dispatch"] = dp if dp is not None else \

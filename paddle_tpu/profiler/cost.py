@@ -86,6 +86,100 @@ def _label(key) -> str:
     return f"{key[0]}[{','.join(str(k) for k in key[1:])}]"
 
 
+# ------------------------------------------------------- jaxpr launch census
+#: collective primitives the census bills as cross-chip wire operations
+#: (the TP all-reduce pair and every schedule it can lower to)
+COLLECTIVE_PRIMITIVES = ("psum", "all_to_all", "all_gather", "ppermute",
+                         "reduce_scatter")
+
+
+def _census_walk(jaxpr):
+    """Count ``pallas_call`` and collective eqns in one (open) jaxpr,
+    recursively. Returns ``(pallas, collectives, loop_bodies)`` where
+
+    - ``scan`` bodies multiply by the static trip count (a scanned
+      layer stack really launches its kernel once per layer);
+    - ``while`` bodies count ONCE into the totals (the trip count is a
+      runtime value) and additionally append their own PER-ITERATION
+      census to ``loop_bodies`` — the multi-tick tail's while body is
+      exactly the "launches per decode tick" quantity the mega-kernel
+      claim is pinned on;
+    - ``cond`` branches contribute their maximum (the worst launch
+      count a dispatch can pay);
+    - a ``pallas_call``'s inner jaxpr is NEVER recursed into — the
+      kernel body's ops run inside the one launch being counted.
+    """
+    pallas = 0
+    coll = 0
+    bodies = []
+
+    def _sub(j):
+        nonlocal pallas, coll
+        p, c, b = _census_walk(j)
+        bodies.extend(b)
+        return p, c
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            pallas += 1
+            continue
+        if name in COLLECTIVE_PRIMITIVES:
+            coll += 1
+            continue
+        if name == "scan":
+            p, c = _sub(eqn.params["jaxpr"].jaxpr)
+            n = int(eqn.params["length"])
+            pallas += p * n
+            coll += c * n
+        elif name == "while":
+            p, c = _sub(eqn.params["body_jaxpr"].jaxpr)
+            bodies.append({"pallas_calls": p, "collectives": c})
+            pallas += p
+            coll += c
+        elif name == "cond":
+            per = [_census_walk(br.jaxpr)
+                   for br in eqn.params["branches"]]
+            for _, _, b in per:
+                bodies.extend(b)
+            pallas += max(p for p, _, _ in per)
+            coll += max(c for _, c, _ in per)
+        else:
+            # generic containers: pjit, shard_map, custom_{vjp,jvp},
+            # remat — recurse every jaxpr-valued param
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                    p, c = _sub(v.jaxpr)
+                    pallas += p
+                    coll += c
+                elif hasattr(v, "eqns"):
+                    p, c = _sub(v)
+                    pallas += p
+                    coll += c
+    return pallas, coll, bodies
+
+
+def jaxpr_census(fn, *args) -> dict:
+    """Launch census of one program: trace ``fn`` over ``args``
+    (``jax.make_jaxpr`` — a pure retrace that does NOT touch the pjit
+    executable cache, so compile-once pins are undisturbed) and count
+    the device-side launch structure. Returns::
+
+        {"pallas_calls": int,     # total, scan bodies × trip count
+         "collectives": int,      # psum/all_to_all/all_gather/ppermute
+         "loop_bodies": [{"pallas_calls": n, "collectives": n}, ...]}
+
+    ``loop_bodies`` holds the PER-ITERATION census of each
+    ``while_loop`` body — for the serving multi-tick program that is
+    the per-decode-tick launch count: O(num_layers) for the scanned
+    baseline, exactly 1 for the fused whole-tick kernel (README
+    "One-kernel decode")."""
+    closed = jax.make_jaxpr(fn)(*args)
+    pallas, coll, bodies = _census_walk(closed.jaxpr)
+    return {"pallas_calls": pallas, "collectives": coll,
+            "loop_bodies": bodies}
+
+
 class CostObservatory:
     """Exact per-program dispatch / transfer / compile accounting.
 
@@ -131,6 +225,12 @@ class CostObservatory:
         # Directions: "d2h" (spill), "h2d" (readmit), "peer" (fleet
         # host-to-host transfer in).
         self.tiers = {}
+        # label -> jaxpr launch census (one per program, recorded
+        # lazily on the program's FIRST dispatch through the counting
+        # facade — the same chokepoint as every other column, so the
+        # in-program launch structure of exactly the programs that ran
+        # is what exports)
+        self.censuses = {}
 
     # ------------------------------------------------------------- control
     def enable(self):
@@ -189,6 +289,21 @@ class CostObservatory:
         ph["h2d_bytes"] += h2d
         ph["d2h_bytes"] += d2h
         ph["wall_s"] += dt
+
+    def record_census(self, label, fn, args):
+        """Record one program's jaxpr launch census (idempotent per
+        label; called by the counting facade on the program's first
+        dispatch). The retrace is pure — the pjit executable cache is
+        untouched — but it is a retrace, so it runs ONCE per program
+        label, never per call. A program whose trace fails under
+        ``make_jaxpr`` records ``None`` rather than killing the serving
+        step that triggered the census."""
+        if label in self.censuses:
+            return
+        try:
+            self.censuses[label] = jaxpr_census(fn, *args)
+        except Exception:            # noqa: BLE001 — census is advisory
+            self.censuses[label] = None
 
     def record_collective(self, dtype, ops, nbytes):
         """Account one sharded launch's cross-chip all-reduce traffic:
@@ -268,7 +383,9 @@ class CostObservatory:
                                 for k, v in list(
                                     self.collectives.items())},
                 "tiers": {k: dict(v)
-                          for k, v in list(self.tiers.items())}}
+                          for k, v in list(self.tiers.items())},
+                "censuses": {k: (dict(v) if v is not None else None)
+                             for k, v in list(self.censuses.items())}}
 
     def export(self, base=None, at=None) -> dict:
         """The cost-attribution document: aggregate, the delta since
@@ -290,7 +407,7 @@ class CostObservatory:
             if calls <= 0:
                 continue
             wall = rec["wall_s"] - b.get("wall_s", 0.0)
-            programs.append({
+            entry = {
                 "program": label, "kind": rec["kind"], "calls": calls,
                 "h2d_bytes": rec["h2d_bytes"] - b.get("h2d_bytes", 0),
                 "d2h_bytes": rec["d2h_bytes"] - b.get("d2h_bytes", 0),
@@ -299,7 +416,11 @@ class CostObservatory:
                 "wall_ewma_s": round(rec["wall_ewma_s"] or 0.0, 9),
                 "share_of_wall": round(wall / wall_total, 6)
                 if wall_total > 0 else 0.0,
-            })
+            }
+            census = state.get("censuses", {}).get(label)
+            if census is not None:
+                entry["census"] = census
+            programs.append(entry)
         programs.sort(key=lambda r: (-r["wall_s"], -r["calls"],
                                      r["program"]))
         phases = {}
@@ -368,4 +489,11 @@ class _CountedProgram:
         out = fn(*args)
         co._record(self._label, self._kind, args, out, self._host_out,
                    fn._cache_size() - c0, co.clock() - t0)
+        # jaxpr launch census, once per program label (idempotent):
+        # the facade call IS the chokepoint every jit-cache handout
+        # funnels through, so the census covers exactly the programs
+        # that dispatched — and the retrace it costs is paid once,
+        # after the real call, never on the steady-state path
+        if self._label not in co.censuses:
+            co.record_census(self._label, fn, args)
         return out

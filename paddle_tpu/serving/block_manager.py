@@ -37,6 +37,52 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class StagingPool:
+    """Reusable pageable host buffers for tier spills, one free list
+    per (shape, dtype).
+
+    Every spill used to land in freshly-allocated numpy per block, so a
+    long-running tiered engine paid an allocator round-trip (and a page
+    fault on first touch) per spilled block forever. A spill's staging
+    need is EXACTLY the pool's per-block shapes — a handful of keys —
+    so the steady state is one buffer per shape in flight:
+    :meth:`take` pops a free buffer or allocates the shape's first,
+    recycling (tier drop / readmission) gives it back, and
+    ``allocations`` counts real ``np.empty`` calls per shape — the
+    regression pin is one per shape, not one per spill."""
+
+    def __init__(self):
+        self._free = {}          # (plane, shape, dtype str) -> [buffers]
+        #: (plane, shape, dtype str) -> np.empty count (the test pin)
+        self.allocations = {}
+
+    @staticmethod
+    def _key(plane, shape, dtype):
+        return (plane, tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def take(self, plane, shape, dtype):
+        """A writable host buffer for the named plane (``k`` / ``v`` /
+        scale) of the shape, reused when one is free. The plane name
+        joins the key so the k and v planes — same shape — each own
+        exactly one steady-state buffer instead of contending for one
+        free list."""
+        key = self._key(plane, shape, dtype)
+        free = self._free.get(key)
+        if free:
+            return free.pop()
+        self.allocations[key] = self.allocations.get(key, 0) + 1
+        return np.empty(key[1], np.dtype(dtype))
+
+    def give(self, bufs):
+        """Return a spill's buffers (a ``read_block``-shaped dict) to
+        the free lists. Only call once NOTHING can read them again —
+        an alias held by a tier entry or an in-flight h2d would read
+        the next spill's bytes."""
+        for plane, b in bufs.items():
+            self._free.setdefault(self._key(plane, b.shape, b.dtype),
+                                  []).append(b)
+
+
 class BlockManager:
     """Physical block pool: device arrays + free heap + refcounts.
 
@@ -134,6 +180,10 @@ class BlockManager:
         self._free_set = set(self._free_heap)
         self._ref = np.zeros(self.num_blocks, np.int32)
         self._peak_used = 0
+        # spill staging (README "Tiered KV prefix cache"): per-shape
+        # reusable host buffers for read_block copies, recycled by the
+        # host tier's drop/readmit paths through recycle_staging
+        self.staging = StagingPool()
 
     # ---------------------------------------------------------- allocator
     @property
@@ -229,10 +279,38 @@ class BlockManager:
             # per-row planes
             bk, bv, bks, bvs = _tier_fetch(self.kv_dtype, self.tp)(
                 self.k, self.v, self.k_scale, self.v_scale, bid)
-            return {"k": np.asarray(bk), "v": np.asarray(bv),
-                    "k_scale": np.asarray(bks), "v_scale": np.asarray(bvs)}
+            return self._stage(k=bk, v=bv, k_scale=bks, v_scale=bvs)
         bk, bv = _tier_fetch(False, self.tp)(self.k, self.v, bid)
-        return {"k": np.asarray(bk), "v": np.asarray(bv)}
+        return self._stage(k=bk, v=bv)
+
+    def _stage(self, **arrays):
+        """Land the fetched block in staging-pool buffers (one real
+        allocation per shape over the pool's lifetime, not per spill):
+        ``np.asarray`` on the device result may be a zero-copy view of
+        the device buffer, so the copy into the reusable buffer is also
+        what unpins the spill bytes from XLA-owned memory."""
+        out = {}
+        for name, arr in arrays.items():
+            host = np.asarray(arr)
+            buf = self.staging.take(name, host.shape, host.dtype)
+            np.copyto(buf, host)
+            out[name] = buf
+        return out
+
+    def recycle_staging(self, bufs):
+        """Hand a spill's staging buffers back for reuse once their
+        tier entry is dead (dropped, replaced, or readmitted and
+        injected). The sync makes the readmission case safe: the
+        injection program may still be reading the host buffers under
+        async dispatch, and a recycled buffer's next spill would race
+        it — waiting on the pool arrays (the injection's outputs)
+        fences every pending read."""
+        jax.block_until_ready(self.k)
+        jax.block_until_ready(self.v)
+        if self.quantized:
+            jax.block_until_ready(self.k_scale)
+            jax.block_until_ready(self.v_scale)
+        self.staging.give(bufs)
 
     def write_block(self, block: int, bufs: dict):
         """Stream one spilled block's host buffers back h2d into pool

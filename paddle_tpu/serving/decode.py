@@ -338,16 +338,102 @@ def _tp_validate(nh, nkv, tp):
             f"num_key_value_heads ({nkv}): the mesh shards over heads")
 
 
-def _tp_allreduce(collective_dtype, tp):
+#: row chunks per overlapped tp_reduce site — each chunk's collective
+#: issues independently so its wire time hides under the neighbouring
+#: chunks' (and the next projection's) compute on hardware; rows-only
+#: chunking keeps per-row quantization scales (and the byte ledger)
+#: exact
+_OVERLAP_CHUNKS = 2
+
+
+def _permute_allreduce(x, tp):
+    """Ring reduce-scatter + all-gather over ``collective-permute``
+    steps — the fp wire schedule of ``collective_overlap=True`` (README
+    "One-kernel decode"; "Fused Computation-Collective Operations",
+    PAPERS.md). The hidden axis splits into ``tp`` pieces; ``tp - 1``
+    ``ppermute`` hops accumulate each piece's cross-shard sum around
+    the ring (reduce-scatter), ``tp - 1`` more hops gather the summed
+    pieces back (all-gather). Per device the wire bytes are exactly
+    ``2 * (tp-1)/tp`` of the payload — the same model
+    ``quantization.collective_wire_bytes`` prices, so the collective
+    ledger stays exact to the byte. Accumulation order is fixed by the
+    ring (deterministic); at ``tp=2`` every output element is one
+    commutative add, bit-equal to ``psum``."""
+    idx = jax.lax.axis_index(TP_AXIS)
+    shape = x.shape
+    hid = shape[-1]
+    pieces = jnp.moveaxis(
+        x.reshape(shape[:-1] + (tp, hid // tp)), -2, 0)
+
+    def _piece(i):
+        return jax.lax.dynamic_index_in_dim(pieces, i % tp, 0,
+                                            keepdims=False)
+
+    ring = [(j, (j + 1) % tp) for j in range(tp)]
+    # reduce-scatter: after step s, this device's accumulator holds
+    # piece (idx + 1 - s) summed over s + 1 consecutive ring devices
+    acc = _piece(idx + 1)
+    for s in range(1, tp):
+        acc = jax.lax.ppermute(acc, TP_AXIS, ring)
+        acc = acc + _piece(idx + 1 - s)
+    # all-gather: circulate the summed pieces back around the ring,
+    # then reorder into hidden order (gathered[s] came from device
+    # idx - s, which owns summed piece idx - s + 2 - tp)
+    gathered = [acc]
+    g = acc
+    for _ in range(1, tp):
+        g = jax.lax.ppermute(g, TP_AXIS, ring)
+        gathered.append(g)
+    order = (idx + 2 - tp - jnp.arange(tp)) % tp
+    out = jnp.take(jnp.stack(gathered, 0), order, axis=0)
+    return jnp.moveaxis(out, 0, -2).reshape(shape).astype(x.dtype)
+
+
+def _overlap_reduce(base, tp, x):
+    """Chunked compute/collective-overlap schedule for one
+    ``tp_reduce`` site (``collective_overlap=True``): the partial-sum
+    rows split into ``_OVERLAP_CHUNKS`` row chunks and each chunk's
+    reduction issues independently — int8 runs the EQuARX quantized
+    all-reduce per chunk (wire format preserved), fp runs the chunked
+    collective-permute ring — so on hardware each chunk's wire time
+    hides under the next chunk's and the following projection's
+    compute. Chunking along ROWS only: every row's absmax scale, wire
+    payload and reduced value are computed exactly as unchunked, so
+    streams AND the ``serving_collective_bytes_total`` ledger are
+    byte-identical to the unoverlapped schedule."""
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= int(d)
+    flat = x.reshape((rows, x.shape[-1]))
+    n = max(1, min(_OVERLAP_CHUNKS, rows))
+    bounds = [(i * rows) // n for i in range(n + 1)]
+    parts = [base(flat[lo:hi])
+             for lo, hi in zip(bounds[:-1], bounds[1:])]
+    return jnp.concatenate(parts, axis=0).reshape(x.shape)
+
+
+def _tp_allreduce(collective_dtype, tp, overlap=False):
     """The per-layer cross-shard reduction — ``tp_reduce`` in the layer
     bodies. ``"fp"`` is a plain ``psum``; ``"int8"`` is the EQuARX-style
     block-quantized all-reduce (README "Tensor-parallel serving":
-    measured greedy divergence, not assumed zero)."""
+    measured greedy divergence, not assumed zero). ``overlap=True``
+    (the engine's ``collective_overlap`` knob) swaps in the chunked
+    schedule of :func:`_overlap_reduce` — fp additionally switches from
+    one ``psum`` to the ring collective-permute reduce-scatter/
+    all-gather (:func:`_permute_allreduce`), byte-identical at tp=2 and
+    byte-exact on the wire ledger at every tp."""
     if collective_dtype == "int8":
         from ..quantization import quantized_psum_int8
-        return functools.partial(quantized_psum_int8, axis_name=TP_AXIS,
+        base = functools.partial(quantized_psum_int8, axis_name=TP_AXIS,
                                  tp=tp)
-    return functools.partial(jax.lax.psum, axis_name=TP_AXIS)
+    elif overlap:
+        base = functools.partial(_permute_allreduce, tp=tp)
+    else:
+        base = functools.partial(jax.lax.psum, axis_name=TP_AXIS)
+    if not overlap:
+        return base
+    return functools.partial(_overlap_reduce, base, tp)
 
 
 def _params_pspec(wq8):
@@ -968,7 +1054,7 @@ def build_paged_decode_steps_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
 def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
                        pv_all, lens, kys, app_mask, temps, top_ks, *, nh,
                        nkv, hd, eps, decode_attn, tp_reduce=None,
-                       a8=False):
+                       a8=False, fused=False):
     """ONE fused decode tick over all rows — THE shared tail body of
     the unified ragged step's scan and the multi-tick step's
     while_loop (the two must compute identically or ``decode_ticks>1``
@@ -978,7 +1064,22 @@ def _fused_decode_tick(params, stack, head, tables, sin, cos, tok, pk_all,
     masked rows drop their append and attend at their frozen length.
     Returns ``(next_tok, pk', pv', keys')``; the CALLER advances
     ``lens`` by ``app_mask``.
+
+    ``fused=True`` (the engine's ``fused_tick`` knob, README
+    "One-kernel decode") dispatches the tick to
+    ``kernels.pallas_fused_decode_tick`` — ONE whole-tick
+    ``pallas_call`` on the single-chip Pallas geometry (the layer loop
+    as the grid dimension, sampling epilogue included), the jnp oracle
+    that replays THIS function's op sequence everywhere else — so a
+    tick is O(1) device launches instead of O(layers), byte-identical
+    either way.
     """
+    if fused:
+        from ..kernels.pallas_fused_decode_tick import fused_decode_tick
+        return fused_decode_tick(
+            params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
+            lens, kys, app_mask, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
+            eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
     R = tok.shape[0]
     nb, bs = _kv_data(pk_all).shape[1], _kv_data(pk_all).shape[2]
     mb = tables.shape[1]
@@ -1115,7 +1216,7 @@ def _packed_span_forward(params, pool_k, pool_v, tables, ids, seg, pos,
 def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                       qstart, qlen, kvlen, dec_mask, keys, temps, top_ks,
                       *, n_steps, nh, nkv, hd, eps, theta, tied,
-                      decode_attn, tp_reduce=None, a8=False):
+                      decode_attn, tp_reduce=None, a8=False, fused=False):
     """THE unified serving step: one device call that advances every
     slot's span — decode rows (span 1) and prefill chunks (span n) —
     through the same block tables, collapsing the
@@ -1182,7 +1283,7 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, dec_mask, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
             eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce,
-            a8=a8)
+            a8=a8, fused=fused)
         return (nxt, npk, npv, lens + dec_mask, nkeys), nxt
 
     if n_steps > 1:
@@ -1198,7 +1299,8 @@ def _ragged_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
                          decode_attn, donate=None, tp=1,
                          collective_dtype="fp", kv_quant=False,
-                         wq8=False, a8=False):
+                         wq8=False, a8=False, fused=False,
+                         collective_overlap=False):
     """One jitted unified serving step (``_ragged_step_impl``): shapes
     depend only on ``(num_slots, token_budget)`` plus the fused
     ``n_steps`` — one compilation per step size serves every span mix,
@@ -1219,7 +1321,9 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
             _ragged_step_impl, n_steps=n_steps, nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
+            tp_reduce=_tp_allreduce(collective_dtype, tp,
+                                    overlap=collective_overlap),
+            a8=a8, fused=fused)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -1231,7 +1335,7 @@ def build_ragged_step_fn(*, n_steps, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _ragged_step_impl, n_steps=n_steps, nh=nh, nkv=nkv, hd=hd,
             eps=eps, theta=theta, tied=tied, decode_attn=decode_attn,
-            a8=a8),
+            a8=a8, fused=fused),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -1240,7 +1344,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
                          qstart, qlen, kvlen, dec_mask, keys, temps,
                          top_ks, eos_ids, budgets, n_ticks, *, max_ticks,
                          nh, nkv, hd, eps, theta, tied, decode_attn,
-                         tp_reduce=None, a8=False):
+                         tp_reduce=None, a8=False, fused=False):
     """THE multi-tick serving step (README "Multi-tick decode"): the
     unified ragged step with the host driven out of the per-token loop.
     Tick 0 is ``_ragged_step_impl``'s packed forward verbatim (decode
@@ -1291,12 +1395,41 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
     head = _dq_head(params, tied, params["embed"].dtype, a8)
 
     # ----------------------------------- tick 0 (shared packed forward)
-    x, pk, pv = _packed_span_forward(
-        params, pool_k, pool_v, tables, ids, seg, pos, qstart, qlen,
-        kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
-        decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
-    tok0, keys_t0 = _span_last_sample(params, head, x, qstart, qlen,
-                                      keys, temps, top_ks, eps)
+    def _packed_tick0(pk_in, pv_in):
+        x, pk2, pv2 = _packed_span_forward(
+            params, pk_in, pv_in, tables, ids, seg, pos, qstart, qlen,
+            kvlen, sin, cos, nh=nh, nkv=nkv, hd=hd, eps=eps,
+            decode_attn=decode_attn, tp_reduce=tp_reduce, a8=a8)
+        tok0, keys_t0 = _span_last_sample(params, head, x, qstart,
+                                          qlen, keys, temps, top_ks,
+                                          eps)
+        return tok0, keys_t0, pk2, pv2
+
+    if fused:
+        # a launch with NO chunk rows — every span a qlen<=1 decode
+        # row, the only state the scheduler fuses ticks for — runs
+        # tick 0 through the SAME fused whole-tick program as the
+        # tail, so the whole sync is one launch per tick; mixed
+        # launches (n_ticks == 1 by scheduler policy) keep the packed
+        # forward verbatim. Byte-identity of the two tick-0 spellings
+        # on pure-decode spans is the standing multi-tick contract
+        # (body ticks ≡ single-tick packed steps), applied at tick 0.
+        tok_in = ids[jnp.maximum(qstart + qlen - 1, 0)]
+        lens_in = jnp.where(dec_mask > 0, kvlen - 1, 0)
+
+        def _fused_tick0(pk_in, pv_in):
+            nxt, npk, npv, nkeys = _fused_decode_tick(
+                params, stack, head, tables, sin, cos, tok_in, pk_in,
+                pv_in, lens_in, keys, dec_mask, temps, top_ks, nh=nh,
+                nkv=nkv, hd=hd, eps=eps, decode_attn=decode_attn,
+                tp_reduce=tp_reduce, a8=a8, fused=True)
+            return nxt, nkeys, npk, npv
+
+        tok0, keys_t0, pk, pv = jax.lax.cond(
+            jnp.all(qlen <= 1), _fused_tick0, _packed_tick0,
+            pool_k, pool_v)
+    else:
+        tok0, keys_t0, pk, pv = _packed_tick0(pool_k, pool_v)
 
     # ------------------------------- fused tail (alive-masked, runtime n)
     lens0 = jnp.where(dec_mask > 0, kvlen, 0)
@@ -1322,7 +1455,7 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
             params, stack, head, tables, sin, cos, tok, pk_all, pv_all,
             lens, kys, am, temps, top_ks, nh=nh, nkv=nkv, hd=hd,
             eps=eps, decode_attn=decode_attn, tp_reduce=tp_reduce,
-            a8=a8)
+            a8=a8, fused=fused)
         tb = tb.at[t].set(nxt)
         kb = kb.at[t].set(nkeys)
         # the host's _maybe_finish rule, in-program: after emitting
@@ -1341,7 +1474,8 @@ def _multitick_step_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
                             decode_attn, donate=None, tp=1,
                             collective_dtype="fp", kv_quant=False,
-                            wq8=False, a8=False):
+                            wq8=False, a8=False, fused=False,
+                            collective_overlap=False):
     """One jitted multi-tick serving step (``_multitick_step_impl``):
     shapes depend only on ``(num_slots, token_budget, max_ticks)`` —
     the tick count actually run is a RUNTIME argument, so one
@@ -1358,7 +1492,9 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
             _multitick_step_impl, max_ticks=int(max_ticks), nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
+            tp_reduce=_tp_allreduce(collective_dtype, tp,
+                                    overlap=collective_overlap),
+            a8=a8, fused=fused)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(
@@ -1370,7 +1506,7 @@ def build_multitick_step_fn(*, max_ticks, nh, nkv, hd, eps, theta, tied,
         functools.partial(
             _multitick_step_impl, max_ticks=int(max_ticks), nh=nh,
             nkv=nkv, hd=hd, eps=eps, theta=theta, tied=tied,
-            decode_attn=decode_attn, a8=a8),
+            decode_attn=decode_attn, a8=a8, fused=fused),
         donate_argnums=(1, 2) if donate else ())
 
 
@@ -1457,7 +1593,7 @@ def _spec_verify_impl(params, pool_k, pool_v, tables, ids, seg, pos,
 def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
                          decode_attn, donate=None, tp=1,
                          collective_dtype="fp", kv_quant=False,
-                         wq8=False, a8=False):
+                         wq8=False, a8=False, collective_overlap=False):
     """One jitted speculative verify step (``_spec_verify_impl``):
     shapes depend only on ``(num_slots, spec token budget, spec_len)``
     — one compilation serves every draft/acceptance/chunk mix, the
@@ -1473,7 +1609,9 @@ def build_spec_verify_fn(*, spec_len, nh, nkv, hd, eps, theta, tied,
             _spec_verify_impl, spec_len=spec_len, nh=nh // tp,
             nkv=nkv // tp, hd=hd, eps=eps, theta=theta, tied=tied,
             decode_attn=decode_attn,
-            tp_reduce=_tp_allreduce(collective_dtype, tp), a8=a8)
+            tp_reduce=_tp_allreduce(collective_dtype, tp,
+                                    overlap=collective_overlap),
+            a8=a8)
         rep = PartitionSpec()
         pool = _pool_pspec(kv_quant)
         return jax.jit(_tp_shard(

@@ -180,7 +180,8 @@ class ContinuousBatchingEngine:
                  drafter=None, decode_ticks=1, kv_dtype=None,
                  quantize_weights=False, quantize_activations=False,
                  tp=1, collective_dtype="fp",
-                 host_tier_bytes=0, priority_classes=None):
+                 host_tier_bytes=0, priority_classes=None,
+                 fused_tick=False, collective_overlap=False):
         c = model.config
         # multi-tenant SLO policy (README "Multi-tenant SLO serving"):
         # like host_tier_bytes, policy not geometry — classes change
@@ -526,7 +527,58 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "decode_ticks > 1 is incompatible with spec_decode: a "
                 "speculative step is a verify launch every step, so "
-                "there is no pure-decode tail to multi-tick — pick one")
+                "there is no pure-decode tail to multi-tick. spec_decode "
+                "composes with: paged_attn, ragged_step, prefix_cache, "
+                "prefill_chunk, kv_dtype, quantize_weights, "
+                "quantize_activations, tp, collective_overlap, "
+                "host_tier_bytes, priority_classes. decode_ticks > 1 "
+                "composes with those plus fused_tick — pick one of the "
+                "two step shapes")
+        # one-kernel decode (README "One-kernel decode"): fused_tick
+        # swaps the scanned per-layer tick body for ONE Pallas program
+        # whose grid dimension IS the layer loop — a tick becomes O(1)
+        # device launches instead of O(layers). Same op sequence, same
+        # bits: the kernel replays _fused_decode_tick exactly, and the
+        # jnp oracle (kernels.pallas_fused_decode_tick) covers the
+        # geometries the single-device mega-kernel can't express
+        # (in-kernel collectives, int8 activations). Default False keeps
+        # every banked baseline byte-identical.
+        self._fused_tick = bool(fused_tick)
+        if self._fused_tick and not self._ragged:
+            raise ValueError(
+                "fused_tick=True requires the unified ragged paged "
+                "engine (paged_attn=True, ragged_step=True): the fused "
+                "program is the packed-span tick body, and the dense / "
+                "two-program paths never grew its dispatch site")
+        if self._fused_tick and self._spec:
+            raise ValueError(
+                "fused_tick=True is incompatible with spec_decode: the "
+                "fused program is the one-token tick body, and a verify "
+                "launch is a spec_len-token span. fused_tick composes "
+                "with: prefix_cache, prefill_chunk, decode_ticks, "
+                "kv_dtype, quantize_weights, quantize_activations, tp, "
+                "collective_overlap, host_tier_bytes, priority_classes")
+        # TP compute/collective overlap (README "One-kernel decode"):
+        # the per-layer all-reduce pair (post o-proj + post down-proj
+        # tp_reduce sites) switches to a chunked reduce-scatter /
+        # all-gather schedule so chunk k's wire time hides behind chunk
+        # k+1's compute. Same bits on the wire format (EQuARX int8
+        # preserved) and same ledger bytes — but a DIFFERENT trace
+        # (ppermute chains instead of one psum), so the tp tag grows an
+        # "ov" marker to key overlap engines apart in a shared cache.
+        self._coll_overlap = bool(collective_overlap)
+        if self._coll_overlap and self._tp <= 1:
+            raise ValueError(
+                "collective_overlap=True requires tp > 1: the overlap "
+                "schedule rewrites the per-layer tensor-parallel "
+                "all-reduce pair, and a tp=1 engine has no collectives "
+                "to overlap")
+        if self._coll_overlap:
+            self._tptag = self._tptag + ("ov",)
+        # jit-key tag for the fused-tick variant: appended LAST (after
+        # kv8f/a8/tpN) so every pre-existing key stays byte-identical
+        # on default engines
+        self._fktag = ("fk",) if self._fused_tick else ()
         if headroom_mult is not None and float(headroom_mult) <= 0:
             raise ValueError(
                 f"headroom_mult must be > 0 (or None for fixed-cap chunk "
@@ -575,6 +627,7 @@ class ContinuousBatchingEngine:
                       "prefill_chunks": 0, "chunk_tokens": 0,
                       "unified_steps": 0,
                       "mtick_syncs": 0, "mtick_ticks": 0,
+                      "mtick_pure_syncs": 0,
                       "last_decode_ticks": 0,
                       "spec_steps": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_tokens": 0,
@@ -754,11 +807,14 @@ class ContinuousBatchingEngine:
         # slots=16/chunk=56 share a token budget of 72)
         key = ("ragged", self.num_slots, self._token_budget,
                int(n_steps), self.config.decode_attention) \
-            + self._kvtag + self._wtag + self._atag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag \
+            + self._fktag
         if key not in self._jit:
             self._jit[key] = build_ragged_step_fn(
                 n_steps=int(n_steps),
                 decode_attn=self.config.decode_attention,
+                fused=self._fused_tick,
+                collective_overlap=self._coll_overlap,
                 **self._fn_consts(), **self._tp_consts(),
                 **self._q_consts())
         # host reads the sampled tokens and the tick-0 keys (chunk
@@ -773,12 +829,15 @@ class ContinuousBatchingEngine:
         # argument, so this is the engine's ONE decode program.
         key = ("mtick", self.num_slots, self._token_budget,
                self._decode_ticks, self.config.decode_attention) \
-            + self._kvtag + self._wtag + self._atag + self._tptag
+            + self._kvtag + self._wtag + self._atag + self._tptag \
+            + self._fktag
         if key not in self._jit:
             from .decode import build_multitick_step_fn
             self._jit[key] = build_multitick_step_fn(
                 max_ticks=self._decode_ticks,
                 decode_attn=self.config.decode_attention,
+                fused=self._fused_tick,
+                collective_overlap=self._coll_overlap,
                 **self._fn_consts(), **self._tp_consts(),
                 **self._q_consts())
         # host reads the sampled token block, the key walk (per-slot
@@ -797,6 +856,7 @@ class ContinuousBatchingEngine:
             self._jit[key] = build_spec_verify_fn(
                 spec_len=self._spec_len,
                 decode_attn=self.config.decode_attention,
+                collective_overlap=self._coll_overlap,
                 **self._fn_consts(), **self._tp_consts(),
                 **self._q_consts())
         # host reads the sampled walk tokens AND the key walk (both are
@@ -838,6 +898,22 @@ class ContinuousBatchingEngine:
         plain psum (and the reported value on tp=1, where no collective
         ever runs) — the public surface for banners/metrics."""
         return self._coll_dtype
+
+    @property
+    def fused_tick(self) -> bool:
+        """Whether the decode tick body runs as ONE fused Pallas
+        program (grid-over-layers mega-kernel; O(1) device launches per
+        tick) instead of the scanned per-layer stack — the public
+        surface for banners/metrics (README "One-kernel decode")."""
+        return self._fused_tick
+
+    @property
+    def collective_overlap(self) -> bool:
+        """Whether the per-layer TP all-reduce pair runs the chunked
+        reduce-scatter/all-gather overlap schedule instead of one psum
+        (False on tp=1, where no collective ever runs) — the public
+        surface for banners/metrics (README "One-kernel decode")."""
+        return self._coll_overlap
 
     def _record_collectives(self, co, spans):
         """EXACT collective-byte accounting for one sharded launch —
@@ -923,8 +999,13 @@ class ContinuousBatchingEngine:
         the sharded geometry: a tp=N engine counts only its own
         ``("tpN", dtype)``-tagged traces, so the pin covers the
         shard_map program and a tp=1 sibling sharing the jit cache
-        never pollutes it (README "Tensor-parallel serving")."""
-        tags = self._kvtag + self._wtag + self._atag + self._tptag
+        never pollutes it (README "Tensor-parallel serving"). The
+        fused-tick tag joins the tail the same way: a fused engine
+        counts only its own ``fk``-tagged traces, and the pin stays ==1
+        inclusive of the ``fk`` (and ``fk`` x ``tpN`` x ``kv8f``/``a8``)
+        variant geometry (README "One-kernel decode")."""
+        tags = self._kvtag + self._wtag + self._atag + self._tptag \
+            + self._fktag
         if self._spec:
             # spec_len is CONFIG (spec_k + 1), not a runtime variant
             # like the ragged key's n_steps — two engines differing
@@ -2122,6 +2203,12 @@ class ContinuousBatchingEngine:
             self.stats["slot_steps"] += ticks * self.num_slots
             self.stats["mtick_syncs"] += 1
             self.stats["mtick_ticks"] += ticks
+            if not chunk_rows:
+                # every span was a qlen<=1 decode row: the program's
+                # pure-decode predicate held, so a fused engine ran
+                # tick 0 through the whole-tick kernel (the bench's
+                # exact device-launch accounting reads this count)
+                self.stats["mtick_pure_syncs"] += 1
             self.stats["last_decode_ticks"] = ticks
             counts = np.zeros(R, np.int32)  # accepted tokens per slot
             for slot in range(self.num_slots):

@@ -103,6 +103,7 @@ class EngineFleet:
                  quantize_activations=False,
                  tp=1, collective_dtype="fp", host_tier_bytes=0,
                  priority_classes=None,
+                 fused_tick=False, collective_overlap=False,
                  registry=None, clock=None, watchdog_deadline_s=None,
                  max_transient_retries=3, retry_backoff_s=0.02,
                  max_restarts=8, fault_hooks=None, trace=False,
@@ -176,13 +177,18 @@ class EngineFleet:
             # different collectives), so replicas with different TP
             # degrees get isolated jit-cache dicts — the same
             # discipline as the kv8/w8 tags
+            # fused_tick and collective_overlap are geometry the same
+            # way: the fused mega-kernel and the ppermute-chain overlap
+            # schedule are different traces of the same step, so
+            # replicas differing in either get isolated jit-cache dicts
             geom = (slots[i], smax[i], chunk[i], bool(paged_attn),
                     bool(ragged_step), bool(spec_decode), int(spec_k),
                     int(decode_chunk), int(prefix_block_size),
                     bool(prefix_cache), pblocks[i], int(decode_ticks),
                     kv_dtype, bool(quantize_weights),
                     bool(quantize_activations),
-                    int(tp), str(collective_dtype))
+                    int(tp), str(collective_dtype),
+                    bool(fused_tick), bool(collective_overlap))
             jit = jits.setdefault(geom, {})
 
             def factory(i=i, jit=jit):
@@ -204,6 +210,8 @@ class EngineFleet:
                     tp=tp, collective_dtype=collective_dtype,
                     host_tier_bytes=tiers[i],
                     priority_classes=self.classes,
+                    fused_tick=fused_tick,
+                    collective_overlap=collective_overlap,
                     jit_cache=jit)
 
             gw = ServingGateway(
@@ -365,7 +373,10 @@ class EngineFleet:
             if entry is None:
                 break               # chain must stay contiguous
             _, bufs, nbytes = entry
-            pc.tier.put(path, bufs)
+            # shared=True: these are the donor tier's buffers by
+            # reference (the pointer-move transfer) — neither tier may
+            # recycle them into its staging pool
+            pc.tier.put(path, bufs, shared=True)
             pc.stats["tier_transfers"] += 1
             moved += 1
             moved_bytes += nbytes

@@ -110,12 +110,18 @@ class HostTier:
 
     def __init__(self, capacity_bytes):
         self.capacity_bytes = int(capacity_bytes)
-        # path -> [bufs, nbytes, tick, digest]
+        # path -> [bufs, nbytes, tick, digest, shared]
         self._entries = {}
         self._by_digest = {}          # digest -> path
         self._tick = itertools.count(1)
         self._bytes = 0
         self._lock = threading.Lock()
+        # staging recycler (BlockManager.recycle_staging): called with
+        # a dead entry's buffers UNLESS the entry is shared — an
+        # exported entry's buffers are referenced by a sibling tier
+        # (the fleet cache plane's pointer-move transfer), so recycling
+        # them here would hand the sibling the next spill's bytes
+        self.on_recycle = None
 
     # ------------------------------------------------------------ digests
     @staticmethod
@@ -134,12 +140,21 @@ class HostTier:
 
     # ------------------------------------------------------------- access
     def _remove_locked(self, path):
-        bufs, nbytes, _, digest = self._entries.pop(path)
+        bufs, nbytes, _, digest, shared = self._entries.pop(path)
         self._bytes -= nbytes
         self._by_digest.pop(digest, None)
-        return bufs, nbytes
+        return bufs, nbytes, shared
 
-    def put(self, path, bufs) -> int:
+    def _recycle(self, bufs, shared):
+        """Return a dead entry's buffers to the spill staging pool —
+        unless a sibling tier still references them (class docstring's
+        buffers-are-immutable convention: shared buffers are never
+        reused, they just age out)."""
+        cb = self.on_recycle
+        if cb is not None and not shared:
+            cb(bufs)
+
+    def put(self, path, bufs, shared=False) -> int:
         """Insert (or refresh) one spilled block's buffers under
         ``path``; trims the tier back to budget and returns how many
         OTHER entries the trim dropped (the ``tier_evictions`` stat).
@@ -151,10 +166,14 @@ class HostTier:
         nbytes = sum(int(b.nbytes) for b in bufs.values())
         digest = self.chain_digests(path)[-1]
         dropped = 0
+        recycle = []
         with self._lock:
             if path in self._entries:
-                self._remove_locked(path)
-            self._entries[path] = [bufs, nbytes, next(self._tick), digest]
+                old, _, old_shared = self._remove_locked(path)
+                if old is not bufs:
+                    recycle.append((old, old_shared))
+            self._entries[path] = [bufs, nbytes, next(self._tick),
+                                   digest, bool(shared)]
             self._by_digest[digest] = path
             self._bytes += nbytes
             while self._bytes > self.capacity_bytes and self._entries:
@@ -164,19 +183,24 @@ class HostTier:
                 doomed = [p for p in self._entries
                           if p[:len(victim)] == victim]
                 for p in doomed:
-                    self._remove_locked(p)
+                    dead, _, dead_shared = self._remove_locked(p)
+                    recycle.append((dead, dead_shared))
                     if p != path:
                         dropped += 1
+        for dead, dead_shared in recycle:
+            self._recycle(dead, dead_shared)
         return dropped
 
     def pop(self, path):
-        """Remove and return ``path``'s buffers (readmission: the block
-        is going back to HBM; a re-eviction re-spills it), or None."""
+        """Remove and return ``(bufs, shared)`` for ``path``
+        (readmission: the block is going back to HBM; a re-eviction
+        re-spills it — ``shared`` must ride along so a degrade re-put
+        keeps the sibling-referenced flag), or None."""
         with self._lock:
             if path not in self._entries:
                 return None
-            bufs, _ = self._remove_locked(path)
-            return bufs
+            bufs, _, shared = self._remove_locked(path)
+            return bufs, shared
 
     def has(self, path) -> bool:
         with self._lock:
@@ -194,6 +218,10 @@ class HostTier:
                 return None
             entry = self._entries[path]
             entry[2] = next(self._tick)
+            # the export hands out buffer REFERENCES: from here on a
+            # sibling tier may hold them, so this entry's buffers can
+            # never be recycled into the local staging pool
+            entry[4] = True
             return path, entry[0], entry[1]
 
     # ------------------------------------------------------------- intro
@@ -256,6 +284,11 @@ class PrefixCache:
                 f"host_tier_bytes must be >= 0, got {host_tier_bytes}")
         self.tier = (HostTier(self.host_tier_bytes)
                      if self.host_tier_bytes else None)
+        if self.tier is not None and hasattr(pool, "recycle_staging"):
+            # dead tier entries hand their staging buffers back to the
+            # pool's per-shape free lists (one allocation per shape,
+            # not per spill)
+            self.tier.on_recycle = pool.recycle_staging
         # CostObservatory for the tier ledger — installed by the
         # engine's _co() sync (gateway-owned observatories arrive after
         # construction), read via a local so a concurrent uninstall
@@ -374,16 +407,21 @@ class PrefixCache:
         try:
             for key in keys[len(matched):]:
                 path = path + (key,)
-                bufs = self.tier.pop(path)
-                if bufs is None:
+                popped = self.tier.pop(path)
+                if popped is None:
                     break
+                bufs, buf_shared = popped
                 block = self.pool.alloc()
                 while block is None and self._evict_one():
                     block = self.pool.alloc()
                 if block is None:      # everything pinned: degrade
-                    self.tier.put(path, bufs)
+                    self.tier.put(path, bufs, shared=buf_shared)
                     break
                 self.pool.write_block(block, bufs)
+                if not buf_shared:
+                    # injected: the staging buffers are dead the moment
+                    # the h2d completes — recycle_staging fences that
+                    self.pool.recycle_staging(bufs)
                 node = _Node(key, parent, block)
                 node.tick = next(self._tick)
                 children[key] = node

@@ -180,6 +180,27 @@ def main(argv=None):
                          "fewer cross-chip bytes; greedy divergence "
                          "measured in TP_BENCH.json, not assumed). "
                          "Ignored (no collectives) at --tp 1")
+    ap.add_argument("--fused-tick", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="one-kernel decode (unified ragged paged "
+                         "engine only; README 'One-kernel decode'): "
+                         "run the decode tick's entire layer stack as "
+                         "ONE Pallas program with the layer loop as "
+                         "the grid dimension — a tick is O(1) device "
+                         "launches instead of O(layers), streams stay "
+                         "byte-identical, and the jaxpr launch census "
+                         "on GET /debug/profile pins the count. "
+                         "Composes with --decode-ticks (the fused "
+                         "program is the multi-tick body)")
+    ap.add_argument("--collective-overlap",
+                    action=argparse.BooleanOptionalAction, default=False,
+                    help="TP compute/collective overlap (requires "
+                         "--tp > 1): the per-layer all-reduce pair "
+                         "runs a chunked reduce-scatter/all-gather "
+                         "schedule interleaved with the next "
+                         "projection's compute — wire format (incl. "
+                         "EQuARX int8) and the collective-bytes "
+                         "ledger stay exact, streams byte-identical")
     ap.add_argument("--spec-decode", action=argparse.BooleanOptionalAction,
                     default=False,
                     help="speculative multi-token decode (paged only): "
@@ -282,6 +303,8 @@ def main(argv=None):
             quantize_weights=args.quantize_weights,
             quantize_activations=args.quantize_activations,
             tp=args.tp, collective_dtype=args.collective_dtype,
+            fused_tick=args.fused_tick,
+            collective_overlap=args.collective_overlap,
             classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
             slo_tpot_ms=args.slo_tpot_ms,
             trace=args.trace, trace_buffer=args.trace_buffer,
@@ -319,6 +342,11 @@ def main(argv=None):
                 {"tp": fleet.replicas[0].gateway.engine.tp},
             "collective_dtype":
                 fleet.replicas[0].gateway.engine.collective_dtype,
+            # effective-value idiom: whether the engines' decode tick
+            # really runs the one-kernel program / overlap schedule
+            "fused_tick": fleet.replicas[0].gateway.engine.fused_tick,
+            "collective_overlap":
+                fleet.replicas[0].gateway.engine.collective_overlap,
             # effective-value idiom: the parsed class table the fleet's
             # engines actually schedule with (ranks, ms targets,
             # reserved headroom, the default marker) — not the flag
@@ -355,6 +383,8 @@ def main(argv=None):
         quantize_weights=args.quantize_weights,
         quantize_activations=args.quantize_activations,
         tp=args.tp, collective_dtype=args.collective_dtype,
+        fused_tick=args.fused_tick,
+        collective_overlap=args.collective_overlap,
         classes=args.classes, slo_ttft_ms=args.slo_ttft_ms,
         slo_tpot_ms=args.slo_tpot_ms,
         trace=args.trace, trace_buffer=args.trace_buffer,
@@ -396,6 +426,12 @@ def main(argv=None):
                       "mesh_shape": {"tp": server.gateway.engine.tp},
                       "collective_dtype":
                       server.gateway.engine.collective_dtype,
+                      # effective-value idiom: whether the decode tick
+                      # really runs the one-kernel program / overlap
+                      # schedule (README "One-kernel decode")
+                      "fused_tick": server.gateway.engine.fused_tick,
+                      "collective_overlap":
+                      server.gateway.engine.collective_overlap,
                       # effective-value idiom: the EFFECTIVE class
                       # table the engine schedules with (parsed ranks,
                       # ms targets, reserved headroom, default marker)

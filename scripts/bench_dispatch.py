@@ -40,8 +40,9 @@ every leg's plan — and therefore its counts — deterministic.
 
 Disabled-overhead leg: the TRACE_BENCH three-way method
 (``bench_trace.py``), with the COST layer in the tracer's role —
-baseline (no observatory) vs installed-but-disabled (must be ≤ 1.01×:
-the ``_co()`` one-attribute guard) vs enabled (reported openly).
+baseline (no observatory) vs installed-but-disabled (loose ≤ 1.15×
+sanity bound — see ACCEPT_DISABLED_RATIO) vs enabled (reported
+openly).
 
 Usage:
   python scripts/bench_dispatch.py --quick [--json PATH]   # CPU-sized
@@ -54,6 +55,14 @@ import time
 
 import numpy as np
 
+# the fused×tp2×overlap leg shards over a 2-device CPU mesh: force the
+# virtual host devices BEFORE jax initializes (same flag conftest.py
+# uses; inert for every single-chip leg — the banked counters reproduce)
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -65,7 +74,17 @@ NUM_SLOTS = 4
 S_MAX = 256
 BLOCK_SIZE = 8
 CHUNK = 32
-ACCEPT_DISABLED_RATIO = 1.01    # ISSUE 11: the cost layer is free off
+#: ISSUE 11: the cost layer is free when off. 1.01 was bankable on the
+#: PR-10 box; the current container shows 2-10% best-of-9 spread
+#: between the three IDENTICAL-work legs (measured on a pristine
+#: pre-PR-20 checkout: disabled_overhead_ratio 1.078/1.095 on code
+#: whose banked value was 1.005), so the wall gate is a loose 1.15
+#: sanity bound — an accidentally unguarded record path costs well
+#: beyond that. The structural zero-work claim is carried by
+#: ``_co()``'s one-attribute guard plus the AST sweep
+#: (test_cost_observatory.py), and the raw wall ratios
+#: (disabled_vs_baseline included) are banked openly alongside
+ACCEPT_DISABLED_RATIO = 1.15
 
 
 def _requests(vocab, n_short=6, max_new=12):
@@ -135,6 +154,37 @@ def _count_accessor_launches(eng):
     return calls
 
 
+def _device_launches(co, eng):
+    """EXACT device-side kernel-launch count for one leg: per-program
+    dispatch counts (the observatory) × the program's jaxpr census,
+    with the multi-tick while body billed at its PER-ITERATION census
+    for the iterations that actually ran (``mtick_ticks`` −
+    ``mtick_syncs`` body iterations; the census counts a while body
+    once per call) and the fused program's cond'd tick 0 billed at the
+    branch that ran (``mtick_pure_syncs`` pure-decode launches take
+    the whole-tick kernel; mixed launches keep the packed forward,
+    which is the census' cond maximum)."""
+    total = 0
+    for p in co.export()["programs"]:
+        census = p.get("census")
+        if census is None:
+            continue
+        body = sum(b["pallas_calls"] for b in census["loop_bodies"])
+        if p["kind"] == "mtick":
+            tick0_scanned = census["pallas_calls"] - body
+            tick0_pure = body if eng.fused_tick else tick0_scanned
+            pure = eng.stats["mtick_pure_syncs"]
+            iters = eng.stats["mtick_ticks"] - eng.stats["mtick_syncs"]
+            total += (pure * tick0_pure
+                      + (p["calls"] - pure) * tick0_scanned
+                      + iters * body)
+        else:
+            # scan trip counts are already multiplied in; no while
+            # loops outside the mtick program
+            total += p["calls"] * census["pallas_calls"]
+    return total
+
+
 def _run_config(model, name, cfg, reqs):
     from dataclasses import replace
 
@@ -164,7 +214,7 @@ def _run_config(model, name, cfg, reqs):
         "decode_ticks_per_sync": round(
             eng.stats["mtick_ticks"] / max(eng.stats["mtick_syncs"], 1),
             3),
-    }, [o.tolist() for o in outs]
+    }, [o.tolist() for o in outs], co, eng
 
 
 def _overhead_leg(model, reqs, repeats=9):
@@ -220,6 +270,101 @@ def _overhead_leg(model, reqs, repeats=9):
     }
 
 
+#: the one-kernel decode legs (README "One-kernel decode"): the SAME
+#: trace on the pallas-attention twin (identical weights, seed 7; the
+#: paged decode kernel is pinned byte-identical to the jnp oracle), so
+#: the jaxpr census counts real ``pallas_call`` launches. fusedmt16 is
+#: the headline composition; fusedtp2ov exercises the overlapped
+#: collective schedule (census collectives + exact wire ledger — the
+#: fused×TP in-kernel collective is the remote-DMA follow-on, so its
+#: launch counts stay scanned-shaped).
+FUSED_CONFIGS = (
+    ("raggedp", dict(paged_attn=True, ragged_step=True)),
+    ("fusedmt1", dict(paged_attn=True, ragged_step=True,
+                      fused_tick=True)),
+    ("fusedmt4", dict(paged_attn=True, ragged_step=True, decode_ticks=4,
+                      fused_tick=True)),
+    ("fusedmt8", dict(paged_attn=True, ragged_step=True, decode_ticks=8,
+                      fused_tick=True)),
+    ("fusedmt16", dict(paged_attn=True, ragged_step=True,
+                       decode_ticks=16, fused_tick=True)),
+    ("fusedtp2ov", dict(paged_attn=True, ragged_step=True,
+                        decode_ticks=8, fused_tick=True, tp=2,
+                        collective_overlap=True)),
+)
+
+#: ISSUE 20 acceptance bar: the fused whole-tick program must cut the
+#: census-exact device launches PER DECODE TICK >= 5x vs the scanned
+#: tick (O(num_layers) pallas_calls -> exactly 1)
+ACCEPT_FUSED_REDUCTION = 5.0
+
+
+def _fused_legs(quick, reqs, jnp_streams):
+    """Run the one-kernel decode ladder on the pallas twin and derive
+    the census-exact device-launch metrics."""
+    model = _models(quick, attns=("pallas",))["pallas"]
+    rows, dev, streams, censuses = {}, {}, {}, {}
+    overlap = None
+    for name, cfg in FUSED_CONFIGS:
+        row, s, co, eng = _run_config(model, name, cfg, reqs)
+        launches = _device_launches(co, eng)
+        row["device_launches"] = launches
+        row["device_launches_per_decoded_token"] = round(
+            launches / max(row["decoded_tokens"], 1), 4)
+        row["mtick_pure_syncs"] = eng.stats["mtick_pure_syncs"]
+        rows[name] = row
+        dev[name] = row["device_launches_per_decoded_token"]
+        streams[name] = s
+        censuses[name] = {
+            k: c for k, c in co.snapshot_full()["censuses"].items()}
+        if name == "fusedtp2ov":
+            led = co.snapshot_full()["collectives"]
+            dt = eng.collective_dtype
+            body = [c["loop_bodies"] for c in censuses[name].values()
+                    if c and c["loop_bodies"]]
+            overlap = {
+                "collective_dtype": dt,
+                "wire_ops": led[dt]["ops"], "wire_bytes": led[dt]["bytes"],
+                "census_collectives_per_tick":
+                    body[0][-1]["collectives"] if body else 0,
+            }
+    # per-tick launch counts, straight from the census: the scanned
+    # tick-at-a-time program vs the fused while body
+    scanned_tick = next(
+        c["pallas_calls"] for c in censuses["raggedp"].values()
+        if c and c["pallas_calls"])
+    fused_body = next(
+        c["loop_bodies"][-1]["pallas_calls"]
+        for c in censuses["fusedmt16"].values()
+        if c and c["loop_bodies"])
+    return {
+        "configs": rows,
+        "streams_equal_to_scanned_legs": all(
+            s == jnp_streams for s in streams.values()),
+        "exact_vs_program_accessors": all(
+            r["exact"] for r in rows.values()),
+        "compile_once": all(r["decode_compilations"] == 1
+                            for r in rows.values()),
+        # THE headline: census-exact launches per decode tick
+        "scanned_per_tick_device_launches": scanned_tick,
+        "fused_per_tick_device_launches": fused_body,
+        "fused_tick_launch_reduction": round(
+            scanned_tick / max(fused_body, 1), 2),
+        "accept_fused_reduction": ACCEPT_FUSED_REDUCTION,
+        # end-to-end on the banked mixed trace (cold 89-token chunked
+        # prompt interleaved with running decodes): the 3 mixed syncs
+        # keep the packed forward for their chunk spans, so the
+        # end-to-end number sits below the pure per-tick reduction
+        "device_launches_per_decoded_token": dev,
+        "end_to_end_device_launch_reduction": round(
+            dev["raggedp"] / max(dev["fusedmt16"], 1e-9), 2),
+        # the host-sync ladder must NOT move: the fused program changes
+        # what runs inside a launch, never how often the host syncs
+        "host_ladder_matches_scanned": None,   # filled by the caller
+        "collective_overlap": overlap,
+    }
+
+
 def measure_dispatch_cost(quick=True, max_new=None):
     model = _models(quick)["jnp"]
     reqs = _requests(model.config.vocab_size,
@@ -227,8 +372,8 @@ def measure_dispatch_cost(quick=True, max_new=None):
     configs = {}
     streams = {}
     for name, cfg in CONFIGS:
-        configs[name], streams[name] = _run_config(model, name, cfg,
-                                                   reqs)
+        configs[name], streams[name], _, _ = _run_config(model, name,
+                                                         cfg, reqs)
     tokens_equal = all(s == streams["dense"] for s in streams.values())
     overhead = _overhead_leg(model, reqs)
     exact = all(c["exact"] for c in configs.values())
@@ -245,6 +390,29 @@ def measure_dispatch_cost(quick=True, max_new=None):
     }
     mtick_reduction = round(
         ladder["1"] / max(ladder["8"], 1e-9), 2)
+    # one-kernel decode legs (ISSUE 20): same trace, pallas twin, the
+    # census-exact device-launch ladder. The host-sync ladder is pinned
+    # AGAINST the scanned legs above: fused changes what one launch
+    # contains, never how often the host syncs.
+    fused = _fused_legs(quick, reqs, streams["dense"])
+    fcfg = fused["configs"]
+    fused["host_ladder_matches_scanned"] = (
+        fcfg["raggedp"]["dispatches"] == configs["ragged"]["dispatches"]
+        and fcfg["fusedmt1"]["dispatches"]
+        == configs["ragged"]["dispatches"]
+        and fcfg["fusedmt4"]["dispatches"]
+        == configs["mtick4"]["dispatches"]
+        and fcfg["fusedmt8"]["dispatches"]
+        == configs["mtick8"]["dispatches"])
+    fused_ok = bool(
+        fused["streams_equal_to_scanned_legs"]
+        and fused["exact_vs_program_accessors"]
+        and fused["compile_once"]
+        and fused["host_ladder_matches_scanned"]
+        and fused["fused_tick_launch_reduction"]
+        >= ACCEPT_FUSED_REDUCTION
+        and fused["collective_overlap"] is not None
+        and fused["collective_overlap"]["wire_bytes"] > 0)
     return {
         "configs": configs,
         "tokens_equal_across_configs": tokens_equal,
@@ -258,12 +426,14 @@ def measure_dispatch_cost(quick=True, max_new=None):
         "dispatches_per_decoded_token_by_ticks": ladder,
         "multitick_dispatch_reduction": mtick_reduction,
         "accept_multitick_reduction": ACCEPT_MTICK_REDUCTION,
+        "fused": fused,
         "accepted": bool(
             tokens_equal and exact and compile_once
             and mtick_reduction >= ACCEPT_MTICK_REDUCTION
             and overhead["tokens_equal"]
             and overhead["disabled_overhead_ratio"]
-            <= ACCEPT_DISABLED_RATIO),
+            <= ACCEPT_DISABLED_RATIO
+            and fused_ok),
     }
 
 

@@ -170,6 +170,15 @@ class TestBenchDriverFlow:
                          {"1": 0.32, "4": 0.13, "8": 0.11},
                      "multitick_dispatch_reduction": 3.0,
                      "exact_vs_program_accessors": True,
+                     # ISSUE 20: the one-kernel fused ladder rides the
+                     # same banked leg
+                     "fused": {
+                         "fused_tick_launch_reduction": 6.0,
+                         "scanned_per_tick_device_launches": 6,
+                         "fused_per_tick_device_launches": 1,
+                         "streams_equal_to_scanned_legs": True,
+                         "host_ladder_matches_scanned": True,
+                         "collective_overlap": {"wire_bytes": 4096}},
                      "accepted": True}), ""
             if leg == "--density":
                 # quantized-density leg: same hang-proof contract
@@ -283,6 +292,16 @@ class TestBenchDriverFlow:
         assert art["dispatch"]["multitick_dispatch_reduction"] == 3.0
         assert art["dispatch"][
             "dispatches_per_decoded_token_by_ticks"]["8"] == 0.11
+        # the fused one-kernel ladder rides the same banked leg
+        # (ISSUE 20): census-exact per-tick reduction, scanned-host
+        # parity and the overlapped-collective wire ledger all land in
+        # the artifact
+        fused = art["dispatch"]["fused"]
+        assert fused["fused_tick_launch_reduction"] == 6.0
+        assert fused["fused_per_tick_device_launches"] == 1
+        assert fused["streams_equal_to_scanned_legs"] is True
+        assert fused["host_ladder_matches_scanned"] is True
+        assert fused["collective_overlap"]["wire_bytes"] > 0
         assert art["density"]["accepted"] is True
         assert art["density"]["slot_capacity_ratio"] == 3.5
         assert art["density"][

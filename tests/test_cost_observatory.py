@@ -922,6 +922,96 @@ class TestGuardDiscipline:
             assert body.count("tp_reduce(o)") == 1, fn_name
             assert body.count("tp_reduce(m)") == 1, fn_name
 
+    def test_sweep_sees_the_fused_tick_and_overlap_path(self):
+        """ISSUE 20 satellite: the one-kernel decode path stays inside
+        the counted/guarded tree. (a) The fused-tick program launches
+        ONLY through the ``_wrap_prog``-counted ``_ragged_fn``/
+        ``_mtick_fn`` handouts — the ``fk`` tag joins exactly those two
+        keys (never prefill/suffix/spec), so fused dispatches are
+        exactly attributed and the compile pin stays inclusive. (b) The
+        kernel module itself is instrumentation-free (pure program —
+        accounting happens at the engine chokepoint, so the sweep's
+        serving/-scope is sufficient). (c) The overlap schedule is
+        constructed at ONE site (``_tp_allreduce``) and applied at
+        exactly the o-proj + down-proj ``tp_reduce`` pair the
+        per-layer contract already pins — the three DECODE builders
+        pass ``overlap=`` while the prefill/suffix builders cannot
+        (decode latency is the target; prefill keys stay banked). (d)
+        The census accessor rides the ``_wrap_prog`` chokepoint: the
+        ONE ``record_census`` call site is ``_CountedProgram.__call__``
+        — no serving code records a census of its own."""
+        dec_path = SERVING_DIR / "decode.py"
+        dec = dec_path.read_text()
+        tree = ast.parse(dec)
+        top = {n.name: n for n in tree.body
+               if isinstance(n, ast.FunctionDef)}
+
+        def calls_in(fn, callee):
+            return [n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == callee]
+
+        # (c) one construction site: _overlap_reduce/_permute_allreduce
+        # are referenced (outside their own defs) only from
+        # _tp_allreduce and _overlap_reduce respectively
+        for helper, owner in (("_overlap_reduce", "_tp_allreduce"),
+                              ("_permute_allreduce", "_tp_allreduce")):
+            users = [name for name, fn in top.items()
+                     if name != helper
+                     and any(isinstance(n, ast.Name) and n.id == helper
+                             for n in ast.walk(fn))]
+            assert users == [owner], (helper, users)
+        # ...and exactly the decode-step builders request the overlap
+        with_ov, without_ov = [], []
+        for name, fn in top.items():
+            for call in calls_in(fn, "_tp_allreduce"):
+                kwargs = {kw.arg for kw in call.keywords}
+                (with_ov if "overlap" in kwargs
+                 else without_ov).append(name)
+        assert sorted(with_ov) == ["build_multitick_step_fn",
+                                   "build_ragged_step_fn",
+                                   "build_spec_verify_fn"]
+        assert sorted(without_ov) == ["build_paged_suffix_prefill_fn",
+                                      "build_prefill_fn"]
+        # the overlapped reduce lands at the SAME two per-layer sites
+        # the tp contract pins (tp_reduce(o) / tp_reduce(m) above) —
+        # no third application point exists anywhere in the module
+        assert dec.count("tp_reduce(") == dec.count("tp_reduce(o)") \
+            + dec.count("tp_reduce(m)") + dec.count("tp_reduce(x)")
+        # (a) the fused program rides the counted handouts: the kernel
+        # entry point is called ONLY from _fused_decode_tick (lazy
+        # import), and the fk tag joins exactly the ragged+mtick keys
+        assert dec.count("import fused_decode_tick") == 1
+        body = dec.split("def _fused_decode_tick(")[1].split("\ndef ")[0]
+        assert "fused_decode_tick(" in body
+        eng = (SERVING_DIR / "engine.py").read_text()
+        for fn_name, has_fk in (("_ragged_fn", True), ("_mtick_fn", True),
+                                ("_spec_fn", False), ("_suffix_fn", False),
+                                ("_prefill_fn", False)):
+            fbody = eng.split(f"def {fn_name}(")[1].split("\n    def ")[0]
+            assert "_wrap_prog" in fbody, fn_name
+            assert ("_fktag" in fbody) is has_fk, fn_name
+        # and the compile pin counts fk programs as decode programs
+        dc = eng.split("def decode_compilations(")[1].split("\n    def ")[0]
+        assert "_fktag" in dc
+        # (b) the kernel module is pure: no tracer/cost/observatory
+        # touch — accounting stays at the engine chokepoint
+        kern = (SERVING_DIR.parent / "kernels"
+                / "pallas_fused_decode_tick.py").read_text()
+        for needle in ("tracer", "self.cost", "CostObservatory",
+                       "record_"):
+            assert needle not in kern, needle
+        # (d) census recording has ONE call site: the counted-program
+        # chokepoint in the profiler itself
+        cost_src = (SERVING_DIR.parent / "profiler" / "cost.py").read_text()
+        assert cost_src.count("co.record_census(") == 1
+        assert "_CountedProgram" in cost_src.split(
+            "co.record_census(")[0].rsplit("class ", 1)[1]
+        serving_srcs = "".join(p.read_text()
+                               for p in SERVING_DIR.rglob("*.py"))
+        assert "record_census" not in serving_srcs
+
     def test_sweep_sees_the_tier_path(self):
         """ISSUE 16 satellite: the KV-tier spill/readmit/transfer call
         sites live inside the swept tree and stay guard-disciplined.

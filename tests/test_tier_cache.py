@@ -213,6 +213,67 @@ class TestTierCompileDiscipline:
         assert eng.decode_compilations() == 1
 
 
+# ------------------------------------------------------- staging reuse
+class TestStagingReuse:
+    """ISSUE 20 satellite: spills used to land in freshly-allocated
+    pageable numpy per block; they now land in the pool's per-shape
+    staging buffers, recycled when a tier entry dies (trim / replace /
+    readmission-inject). The pin is the allocation COUNT: one real
+    ``np.empty`` per (shape, dtype), not one per spill."""
+
+    def test_unit_one_allocation_per_shape_across_spill_cycles(self):
+        pool = BlockManager(2, 4, 4, 1, 2)
+        for cycle in range(5):
+            for b in range(pool.num_blocks):
+                bufs = pool.read_block(b)
+                assert set(bufs) == {"k", "v"}
+                pool.recycle_staging(bufs)      # entry died
+        alloc = pool.staging.allocations
+        assert alloc and all(n == 1 for n in alloc.values()), alloc
+
+    def test_engine_thrash_allocates_once_per_shape(self, model):
+        """A one-block tier budget under the thrash workload: every
+        spill replaces (= recycles) the previous entry and every
+        readmission injects-then-recycles, so dozens of spills draw on
+        the per-shape steady state. The insert-then-trim window keeps
+        at most TWO entries alive per plane (the incoming spill stages
+        before the LRU victim recycles), so the pin is <= 2 buffers
+        per plane ever allocated — and a repeat wave, spilling just as
+        much again, allocates ZERO more (per shape, not per spill)."""
+        probe = _engine(model, prefix_blocks=2)
+        per_block = (probe.cache.pool.block_nbytes
+                     + probe.cache.pool.scale_block_nbytes)
+        eng = _engine(model, prefix_blocks=2,
+                      host_tier_bytes=per_block)
+        pc = eng.prefix_cache
+        reqs = _thrash(rounds=3)
+        _serial(eng, reqs)
+        warm = dict(pc.pool.staging.allocations)
+        spilled = pc.stats["spilled_blocks"]
+        assert warm and all(n <= 2 for n in warm.values()), warm
+        _serial(eng, reqs)
+        assert pc.stats["spilled_blocks"] > spilled     # kept spilling
+        assert pc.pool.staging.allocations == warm      # zero new
+
+    def test_shared_entries_are_never_recycled(self):
+        """The fleet cache plane holds exported buffers by reference:
+        a shared entry's death must NOT hand its buffers to the
+        recycler (the sibling tier would read the next spill's
+        bytes)."""
+        t = HostTier(capacity_bytes=64)
+        recycled = []
+        t.on_recycle = recycled.append
+        own = {"k": np.full((64,), 1, np.uint8)}
+        t.put(((1,),), own)
+        # export marks shared; the replacement drop must skip recycle
+        assert t.export_digest(HostTier.chain_digests(((1,),))[-1])
+        t.put(((1,),), {"k": np.full((64,), 2, np.uint8)})
+        assert recycled == []
+        # the unshared replacement recycles normally when dropped
+        t.put(((1,),), {"k": np.full((64,), 3, np.uint8)})
+        assert len(recycled) == 1 and recycled[0]["k"][0] == 2
+
+
 # ---------------------------------------------------------- HostTier unit
 class TestHostTierUnit:
     def _bufs(self, fill, nbytes=64):
@@ -247,8 +308,11 @@ class TestHostTierUnit:
         assert not t.has(pa) and not t.has(pb)
         assert t.has(pc_) and t.has(((4,),))
         assert t.bytes_used == 128
-        # pop removes; a second pop misses
-        assert t.pop(pc_)["k"][0] == 3
+        # pop removes (returning the shared flag alongside the
+        # buffers — True here: export_digest handed out pc_'s
+        # buffers by reference above); a second pop misses
+        bufs, shared = t.pop(pc_)
+        assert bufs["k"][0] == 3 and shared is True
         assert t.pop(pc_) is None
         assert t.export_digest("no-such-digest") is None
 
@@ -263,7 +327,7 @@ class TestHostTierUnit:
         t.put(p, self._bufs(1, nbytes=64))
         t.put(p, self._bufs(2, nbytes=128))
         assert t.num_blocks == 1 and t.bytes_used == 128
-        assert t.pop(p)["k"][0] == 2
+        assert t.pop(p)[0]["k"][0] == 2
 
 
 # ------------------------------------------------------ fleet cache plane

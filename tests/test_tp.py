@@ -367,7 +367,9 @@ class TestTPValidation:
                             collective_dtype="int8", start=False)
         jits = model.__dict__["_serving_jit_fleet"]
         (geom,) = jits.keys()
-        assert geom[-2:] == (2, "int8")
+        # tail of the geometry tuple: (tp, collective_dtype,
+        # fused_tick, collective_overlap)
+        assert geom[-4:] == (2, "int8", False, False)
         assert fleet.replicas[0].gateway.engine.tp == 2
         fleet.shutdown(drain=False, timeout=5)
 

@@ -37,6 +37,9 @@ class TestEagerTraining:
                 first = float(loss.value)
         assert float(loss.value) < first * 0.1
 
+    @pytest.mark.slow  # 12 s convergence duplicate (870s cap):
+    # test_regression_converges is the default eager-convergence rep
+    # and test_jit_matches_eager keeps the classification head covered
     def test_classification_eager(self):
         paddle.seed(1)
         rng = np.random.RandomState(1)
